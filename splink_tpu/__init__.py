@@ -1,0 +1,45 @@
+"""splink_tpu: a TPU-native probabilistic record-linkage framework.
+
+A from-scratch JAX/XLA implementation of the Fellegi-Sunter EM record-linkage
+model with the capability surface of early Splink (the reference Spark SQL
+implementation): declarative settings, blocking, comparison-vector
+computation, EM estimation, term-frequency adjustment, model persistence and
+explainability — redesigned for TPU execution (fused jitted EM, vmapped
+string kernels, pair-axis sharding over a device mesh).
+
+Public API mirrors the reference (/root/reference/splink/__init__.py):
+``Splink`` and ``load_from_json``, plus the lower-level building blocks.
+"""
+
+__version__ = "0.1.0"
+
+from . import ops, parallel
+from .em import run_em, score_pairs, score_pairs_with_intermediates
+from .models.fellegi_sunter import FSParams
+from .params import Params, load_params_from_dict, load_params_from_json
+from .settings import complete_settings_dict
+from .validate import validate_settings
+
+__all__ = [
+    "__version__",
+    "ops",
+    "parallel",
+    "run_em",
+    "score_pairs",
+    "score_pairs_with_intermediates",
+    "FSParams",
+    "Params",
+    "load_params_from_dict",
+    "load_params_from_json",
+    "complete_settings_dict",
+    "validate_settings",
+]
+
+
+def __getattr__(name):
+    # Lazy linker import: keeps module import light and cycle-free.
+    if name in ("Splink", "load_from_json", "register_comparison"):
+        from . import linker
+
+        return getattr(linker, name)
+    raise AttributeError(f"module 'splink_tpu' has no attribute {name!r}")

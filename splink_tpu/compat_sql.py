@@ -1,0 +1,177 @@
+"""Translation of reference-splink SQL surface syntax into splink_tpu specs.
+
+The reference configures comparisons with SQL CASE expressions
+(/root/reference/splink/case_statements.py:62-277) and blocking with SQL join
+predicates (/root/reference/splink/blocking.py:95-160). splink_tpu's native
+configuration is declarative spec dicts, but for drop-in compatibility we
+recognise the reference's generated CASE shapes and equality-join blocking
+rules and translate them. Anything unrecognised raises with a pointer to the
+native spec format.
+"""
+
+from __future__ import annotations
+
+import re
+
+_NUM = r"([0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?)"
+
+
+class SqlTranslationError(ValueError):
+    pass
+
+
+def _normalise(expr: str) -> str:
+    s = expr.replace("\n", " ").replace("\r", " ")
+    s = re.sub(r"\s+", " ", s).strip()
+    return s
+
+
+def parse_case_expression(expr: str, num_levels: int) -> dict:
+    """Translate a recognised SQL CASE expression into a comparison spec dict.
+
+    Recognised families (the shapes the reference's generators emit):
+      * strict equality          -> {"kind": "exact"}
+      * jaro_winkler_sim(...) > t chains -> {"kind": "jaro_winkler", "thresholds": [...]}
+      * levenshtein(...)/avg-len <= t chains (with equality top level)
+                                 -> {"kind": "levenshtein", "thresholds": [...]}
+      * abs(a - b) < t chains    -> {"kind": "numeric_abs", "thresholds": [...]}
+      * abs(a - b)/abs(max) < t  -> {"kind": "numeric_perc", "thresholds": [...]}
+
+    thresholds[0] always gates the top similarity level.
+    """
+    s = _normalise(expr).lower()
+
+    if "jaro_winkler_sim" in s:
+        pairs = re.findall(rf"jaro_winkler_sim\([^)]*\)\s*>\s*{_NUM}\s*then\s*(\d+)", s)
+        if pairs:
+            by_level = sorted(pairs, key=lambda p: -int(p[1]))
+            return {"kind": "jaro_winkler", "thresholds": [float(t) for t, _ in by_level]}
+
+    if "levenshtein" in s:
+        pairs = re.findall(rf"<=\s*{_NUM}\s*then\s*(\d+)", s)
+        if pairs:
+            by_level = sorted(pairs, key=lambda p: -int(p[1]))
+            return {"kind": "levenshtein", "thresholds": [float(t) for t, _ in by_level]}
+
+    if re.search(r"abs\(", s) and "/" in s:
+        pairs = re.findall(rf"<\s*{_NUM}\s*then\s*(\d+)", s)
+        if pairs:
+            by_level = sorted(pairs, key=lambda p: -int(p[1]))
+            return {"kind": "numeric_perc", "thresholds": [float(t) for t, _ in by_level]}
+
+    if re.search(r"abs\(", s):
+        pairs = re.findall(rf"<\s*{_NUM}\s*then\s*(\d+)", s)
+        if pairs:
+            by_level = sorted(pairs, key=lambda p: -int(p[1]))
+            return {"kind": "numeric_abs", "thresholds": [float(t) for t, _ in by_level]}
+
+    m = re.search(r"when\s+(\w+)_l\s*=\s*(\w+)_r\s+then\s+(\d+)", s)
+    if m and num_levels == 2:
+        return {"kind": "exact"}
+
+    raise SqlTranslationError(
+        "Could not translate this case_expression into a splink_tpu comparison "
+        f"spec: {expr!r}. Provide a native spec instead, e.g. "
+        '{"comparison": {"kind": "jaro_winkler", "thresholds": [0.94, 0.88]}} '
+        "or register a custom comparison with splink_tpu.register_comparison()."
+    )
+
+
+# --------------------------------------------------------------------------
+# Blocking rules
+# --------------------------------------------------------------------------
+
+_EQ_TERM = re.compile(r"^\s*l\.(\w+)\s*=\s*r\.(\w+)\s*$")
+
+
+def parse_blocking_rule(rule: str):
+    """Parse a blocking rule into (equality_pairs, residual_predicate).
+
+    equality_pairs: list of (left_col, right_col) from top-level AND-ed
+    ``l.col = r.col`` terms; these become hash-join keys (SQL inner-join
+    equality semantics: rows with a null key never match).
+
+    residual_predicate: a compiled python expression (numpy semantics) for any
+    remaining AND-ed terms, or None. Evaluated against dicts ``l``/``r`` of
+    column arrays after the hash join.
+    """
+    s = _normalise(rule)
+    if not s:
+        raise SqlTranslationError("Empty blocking rule")
+    # Split on top-level AND only (no parens handling needed for AND of terms)
+    terms = re.split(r"(?i)\s+and\s+", s) if _is_top_level_and(s) else [s]
+
+    eq_pairs = []
+    residual_terms = []
+    for t in terms:
+        m = _EQ_TERM.match(t)
+        if m:
+            eq_pairs.append((m.group(1), m.group(2)))
+        else:
+            residual_terms.append(t)
+
+    residual = None
+    if residual_terms:
+        residual = sql_predicate_to_python(" and ".join(f"({t})" for t in residual_terms))
+    return eq_pairs, residual
+
+
+def _is_top_level_and(s: str) -> bool:
+    """True if every AND in s sits at paren depth 0 (so splitting is safe)."""
+    depth = 0
+    for i, ch in enumerate(s):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif depth > 0 and s[i : i + 4].lower() == " and":
+            return False
+    return True
+
+
+def sql_predicate_to_python(pred: str) -> str:
+    """Convert a simple SQL boolean predicate to a numpy-evaluable expression.
+
+    Supports: l./r. column refs, = != <> < <= > >=, AND/OR/NOT, abs(),
+    numeric and single-quoted string literals, IS [NOT] NULL via an ``_isna``
+    helper. The returned source expects ``l`` and ``r`` dict-of-array
+    namespaces.
+
+    AND/OR/NOT become the numpy element-wise operators ``& | ~``, which bind
+    *tighter* than comparisons in Python — so every comparison atom is
+    parenthesised during translation to preserve SQL precedence.
+    """
+    s = _normalise(pred)
+    # Tokenise into atoms / boolean operators / parens, so each atom can be
+    # parenthesised independently.
+    parts = re.split(r"(?i)(\(|\)|\band\b|\bor\b|\bnot\b)", s)
+    out: list[str] = []
+    for part in parts:
+        token = part.strip()
+        if not token:
+            continue
+        low = token.lower()
+        if low == "and":
+            out.append("&")
+        elif low == "or":
+            out.append("|")
+        elif low == "not":
+            out.append("~")
+        elif token in "()":
+            out.append(token)
+        else:
+            out.append(f"({_translate_atom(token)})")
+    return " ".join(out)
+
+
+def _translate_atom(atom: str) -> str:
+    """Translate one comparison atom (no boolean operators) to Python."""
+    s = re.sub(r"(?i)\bis\s+not\s+null\b", " __ISNOTNULL__", atom)
+    s = re.sub(r"(?i)\bis\s+null\b", " __ISNULL__", s)
+    s = re.sub(r"\bl\.(\w+)", r'l["\1"]', s)
+    s = re.sub(r"\br\.(\w+)", r'r["\1"]', s)
+    s = re.sub(r"(?<![<>!=])=(?!=)", "==", s)
+    s = s.replace("<>", "!=")
+    s = re.sub(r'((?:l|r)\["\w+"\])\s*__ISNOTNULL__', r"~_isna(\1)", s)
+    s = re.sub(r'((?:l|r)\["\w+"\])\s*__ISNULL__', r"_isna(\1)", s)
+    return s.strip()

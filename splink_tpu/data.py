@@ -1,0 +1,203 @@
+"""Columnar data encoding: pandas/dict input -> device-ready arrays.
+
+The reference keeps data as Spark DataFrames and pushes strings through JVM
+UDFs per row. The TPU design instead encodes every compared column ONCE,
+host-side, into fixed-width device arrays (SURVEY.md section 7):
+
+  * string columns  -> (n, width) uint8 codepoint arrays + int32 lengths,
+                       plus factorised int32 token ids (for exact comparison
+                       and term-frequency adjustment) and a bool null mask
+  * numeric columns -> float64 values + bool null mask
+
+Candidate pairs are then just int32 index arrays into these columns; gathers
+happen on device, so the host never materialises the quadratic pair table.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+DEFAULT_STRING_WIDTH = 24
+
+
+def _pad_width(n: int, multiple: int = 8) -> int:
+    return max(((n + multiple - 1) // multiple) * multiple, multiple)
+
+
+@dataclass
+class EncodedStringColumn:
+    bytes_: np.ndarray  # (n, width) uint8, zero padded
+    lengths: np.ndarray  # (n,) int32 byte lengths (post truncation)
+    token_ids: np.ndarray  # (n,) int32 factorised codes, -1 for null
+    null_mask: np.ndarray  # (n,) bool
+    values: np.ndarray  # (n,) object: original strings (None for null)
+    width: int
+
+    @property
+    def n_tokens(self) -> int:
+        return int(self.token_ids.max()) + 1 if len(self.token_ids) else 0
+
+
+@dataclass
+class EncodedNumericColumn:
+    values_f64: np.ndarray  # (n,) float64, 0 where null
+    null_mask: np.ndarray  # (n,) bool
+    values: np.ndarray  # (n,) object: original values (None for null)
+
+
+@dataclass
+class EncodedTable:
+    """All encoded columns for one (possibly concatenated) input table."""
+
+    n_rows: int
+    unique_id: np.ndarray  # (n,) original ids (any comparable dtype)
+    strings: dict[str, EncodedStringColumn] = field(default_factory=dict)
+    numerics: dict[str, EncodedNumericColumn] = field(default_factory=dict)
+    raw: dict[str, np.ndarray] = field(default_factory=dict)  # passthrough cols
+    source_table: np.ndarray | None = None  # (n,) int8 0/1 for link_and_dedupe
+
+    def column_values(self, name: str) -> np.ndarray:
+        if name in self.strings:
+            return self.strings[name].values
+        if name in self.numerics:
+            return self.numerics[name].values
+        return self.raw[name]
+
+    def is_null(self, name: str) -> np.ndarray:
+        if name in self.strings:
+            return self.strings[name].null_mask
+        if name in self.numerics:
+            return self.numerics[name].null_mask
+        return np.array([v is None for v in self.raw[name]])
+
+
+def _to_object_array(values) -> np.ndarray:
+    import pandas as pd
+
+    s = pd.Series(values)
+    isna = pd.isna(s)
+    out = np.empty(len(s), dtype=object)
+    vals = s.to_numpy(dtype=object, copy=False)
+    for i in range(len(s)):
+        out[i] = None if isna.iloc[i] else vals[i]
+    return out
+
+
+def encode_string_column(values, width: int = DEFAULT_STRING_WIDTH) -> EncodedStringColumn:
+    """Encode a string column into fixed-width codepoint arrays + token ids.
+
+    ASCII-only columns use uint8; columns with any non-ASCII value use uint32
+    Unicode codepoints so lengths and equality are *character*-level, matching
+    the reference's JVM string functions. Values longer than ``width``
+    contribute only their first ``width`` characters to similarity kernels;
+    token ids still distinguish full values, so exact comparison and TF
+    adjustment are unaffected by truncation.
+    """
+    import pandas as pd
+
+    obj = _to_object_array(values)
+    n = len(obj)
+    null_mask = np.array([v is None for v in obj], dtype=bool)
+
+    width = _pad_width(width)
+    ascii_only = all(v is None or str(v).isascii() for v in obj)
+    dtype = np.uint8 if ascii_only else np.uint32
+    bytes_ = np.zeros((n, width), dtype=dtype)
+    lengths = np.zeros(n, dtype=np.int32)
+    for i, v in enumerate(obj):
+        if v is None:
+            continue
+        chars = str(v)[:width]
+        if ascii_only:
+            bytes_[i, : len(chars)] = np.frombuffer(chars.encode(), dtype=np.uint8)
+        else:
+            bytes_[i, : len(chars)] = np.array(
+                [ord(c) for c in chars], dtype=np.uint32
+            )
+        lengths[i] = len(chars)
+
+    codes, _ = pd.factorize(pd.Series([None if v is None else str(v) for v in obj]))
+    token_ids = codes.astype(np.int32)  # pandas gives -1 for null already
+    return EncodedStringColumn(
+        bytes_=bytes_,
+        lengths=lengths,
+        token_ids=token_ids,
+        null_mask=null_mask,
+        values=obj,
+        width=width,
+    )
+
+
+def encode_numeric_column(values) -> EncodedNumericColumn:
+    obj = _to_object_array(values)
+    null_mask = np.array([v is None for v in obj], dtype=bool)
+    f = np.zeros(len(obj), dtype=np.float64)
+    for i, v in enumerate(obj):
+        if v is not None:
+            f[i] = float(v)
+    return EncodedNumericColumn(values_f64=f, null_mask=null_mask, values=obj)
+
+
+def _columns_needed(settings: dict) -> tuple[dict[str, str], list[str]]:
+    """-> ({column_name: data_type}, passthrough_columns)."""
+    typed: dict[str, str] = {}
+    for col in settings["comparison_columns"]:
+        if "col_name" in col:
+            typed[col["col_name"]] = col.get("data_type", "string")
+        for extra in col.get("custom_columns_used", []):
+            typed.setdefault(extra, "string")
+        for extra in col.get("comparison", {}).get("other_columns", []):
+            typed.setdefault(extra, "string")
+    passthrough = [
+        c for c in settings.get("additional_columns_to_retain", []) if c not in typed
+    ]
+    return typed, passthrough
+
+
+def encode_table(df, settings: dict, source_table: np.ndarray | None = None) -> EncodedTable:
+    """Encode the columns of a pandas DataFrame needed by ``settings``."""
+    uid_col = settings["unique_id_column_name"]
+    if uid_col not in df.columns:
+        raise ValueError(f"Input data is missing unique id column {uid_col!r}")
+
+    typed, passthrough = _columns_needed(settings)
+    widths = {
+        col.get("col_name"): col.get("max_string_length", DEFAULT_STRING_WIDTH)
+        for col in settings["comparison_columns"]
+    }
+
+    table = EncodedTable(
+        n_rows=len(df),
+        unique_id=df[uid_col].to_numpy(),
+        source_table=source_table,
+    )
+    for name, dtype in typed.items():
+        if name not in df.columns:
+            raise ValueError(f"Input data is missing comparison column {name!r}")
+        if dtype == "numeric":
+            table.numerics[name] = encode_numeric_column(df[name])
+        else:
+            table.strings[name] = encode_string_column(
+                df[name], widths.get(name, DEFAULT_STRING_WIDTH)
+            )
+    for name in passthrough:
+        if name not in df.columns:
+            raise ValueError(f"Input data is missing retained column {name!r}")
+        table.raw[name] = df[name].to_numpy()
+    return table
+
+
+def concat_tables(left: EncodedTable, right: EncodedTable, settings: dict, df_l, df_r) -> EncodedTable:
+    """Vertically concatenate two inputs with a _source_table tag (0 = left,
+    1 = right), the link_and_dedupe preparation step
+    (/root/reference/splink/blocking.py:70-93). Re-encodes from the raw
+    frames so token ids share one vocabulary."""
+    import pandas as pd
+
+    combined = pd.concat([df_l, df_r], ignore_index=True)
+    source = np.concatenate(
+        [np.zeros(len(df_l), np.int8), np.ones(len(df_r), np.int8)]
+    )
+    return encode_table(combined, settings, source_table=source)

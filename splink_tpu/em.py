@@ -1,0 +1,162 @@
+"""EM training loop: one jit-compiled program, params resident on device.
+
+The reference's EM driver round-trips driver <-> cluster every iteration and
+re-plans a fresh SQL query with the parameters baked in as literals
+(/root/reference/splink/iterate.py:20, expectation_step.py:212). Here the
+whole loop is a single ``lax.while_loop`` compiled once: parameters are traced
+arguments that stay in device memory, the convergence predicate evaluates on
+device, and per-iteration parameter history is written into preallocated
+buffers so the host reads everything back in one transfer after convergence.
+
+Two execution modes:
+  * run_em:        gamma matrix resident in HBM (optionally sharded over a
+                   mesh 'data' axis) — the fast path.
+  * run_em_streamed (see splink_tpu/parallel/streaming.py): gamma batches
+    stream host->device and sufficient statistics accumulate across
+    micro-batches before each parameter update, for datasets larger than HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .models.fellegi_sunter import (
+    FSParams,
+    log_likelihood,
+    match_probability,
+    sufficient_stats,
+    update_params,
+)
+
+
+class EMResult(NamedTuple):
+    params: FSParams  # final parameters
+    n_updates: jnp.ndarray  # number of M-step updates performed
+    converged: jnp.ndarray  # bool: stopped because delta < tol
+    lam_history: jnp.ndarray  # (max_iter + 1,), entry 0 = initial
+    m_history: jnp.ndarray  # (max_iter + 1, C, L)
+    u_history: jnp.ndarray  # (max_iter + 1, C, L)
+    ll_history: jnp.ndarray  # (max_iter + 1,) log likelihood under params i (nan if not computed)
+
+
+class _LoopState(NamedTuple):
+    params: FSParams
+    it: jnp.ndarray
+    converged: jnp.ndarray
+    lam_hist: jnp.ndarray
+    m_hist: jnp.ndarray
+    u_hist: jnp.ndarray
+    ll_hist: jnp.ndarray
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_iterations", "max_levels", "compute_ll")
+)
+def run_em(
+    G,
+    init: FSParams,
+    *,
+    max_iterations: int,
+    max_levels: int,
+    em_convergence,
+    weights=None,
+    compute_ll: bool = False,
+) -> EMResult:
+    """Run EM to convergence in one compiled program.
+
+    Convergence matches the reference (/root/reference/splink/params.py:316-336):
+    the largest absolute change across all pi probabilities (lambda excluded)
+    must drop below ``em_convergence``. The history layout matches the
+    reference's ``param_history``: index i holds the parameters *before*
+    update i+1, so index 0 is the initial state.
+    """
+    C, L = init.m.shape
+    dtype = init.m.dtype
+    n_hist = max_iterations + 1
+
+    lam_hist = jnp.full((n_hist,), jnp.nan, dtype).at[0].set(init.lam)
+    m_hist = jnp.zeros((n_hist, C, L), dtype).at[0].set(init.m)
+    u_hist = jnp.zeros((n_hist, C, L), dtype).at[0].set(init.u)
+    ll_hist = jnp.full((n_hist,), jnp.nan, dtype)
+
+    def cond(state: _LoopState):
+        return (state.it < max_iterations) & (~state.converged)
+
+    def body(state: _LoopState):
+        p = match_probability(G, state.params)
+        stats = sufficient_stats(G, p, max_levels, weights)
+        new = update_params(stats)
+        delta = jnp.maximum(
+            jnp.max(jnp.abs(new.m - state.params.m)),
+            jnp.max(jnp.abs(new.u - state.params.u)),
+        )
+        it = state.it + 1
+        lam_h = state.lam_hist.at[it].set(new.lam)
+        m_h = state.m_hist.at[it].set(new.m)
+        u_h = state.u_hist.at[it].set(new.u)
+        ll_h = state.ll_hist
+        if compute_ll:
+            # Log likelihood under the *pre-update* params, stored at the
+            # pre-update index — the reference computes ll in the E-step and
+            # archives it with those params (expectation_step.py:52-57).
+            ll_h = ll_h.at[state.it].set(log_likelihood(G, state.params, weights))
+        return _LoopState(
+            params=new,
+            it=it,
+            converged=delta < em_convergence,
+            lam_hist=lam_h,
+            m_hist=m_h,
+            u_hist=u_h,
+            ll_hist=ll_h,
+        )
+
+    init_state = _LoopState(
+        params=init,
+        it=jnp.zeros((), jnp.int32),
+        converged=jnp.zeros((), bool),
+        lam_hist=lam_hist,
+        m_hist=m_hist,
+        u_hist=u_hist,
+        ll_hist=ll_hist,
+    )
+    final = lax.while_loop(cond, body, init_state)
+
+    ll_hist = final.ll_hist
+    if compute_ll:
+        ll_hist = ll_hist.at[final.it].set(
+            log_likelihood(G, final.params, weights)
+        )
+
+    return EMResult(
+        params=final.params,
+        n_updates=final.it,
+        converged=final.converged,
+        lam_history=final.lam_hist,
+        m_history=final.m_hist,
+        u_history=final.u_hist,
+        ll_history=ll_hist,
+    )
+
+
+@jax.jit
+def score_pairs(G, params: FSParams):
+    """Final E-step scoring: match probability for every pair."""
+    return match_probability(G, params)
+
+
+@jax.jit
+def score_pairs_with_intermediates(G, params: FSParams):
+    """Scoring plus the per-column m/u lookup probabilities the reference
+    retains as prob_gamma_<col>_match / _non_match columns
+    (/root/reference/splink/expectation_step.py:196-221)."""
+    from .models.fellegi_sunter import gamma_prob_lookup
+
+    p = match_probability(G, params)
+    prob_m = gamma_prob_lookup(G, params.m)
+    prob_u = gamma_prob_lookup(G, params.u)
+    return p, prob_m, prob_u

@@ -1,0 +1,23 @@
+from .fellegi_sunter import (
+    FSParams,
+    SufficientStats,
+    em_step,
+    gamma_prob_lookup,
+    log_bayes_factor,
+    log_likelihood,
+    match_probability,
+    sufficient_stats,
+    update_params,
+)
+
+__all__ = [
+    "FSParams",
+    "SufficientStats",
+    "em_step",
+    "gamma_prob_lookup",
+    "log_bayes_factor",
+    "log_likelihood",
+    "match_probability",
+    "sufficient_stats",
+    "update_params",
+]

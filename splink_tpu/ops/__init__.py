@@ -1,0 +1,30 @@
+from . import gamma, numeric, phonetic, qgram, strings
+from .phonetic import double_metaphone, double_metaphone_primary
+from .qgram import qgram_cosine_distance, qgram_jaccard, qgram_tokenise
+from .strings import (
+    exact_equal,
+    jaro_winkler,
+    jaro_winkler_single,
+    levenshtein,
+    levenshtein_ratio,
+    levenshtein_single,
+)
+
+__all__ = [
+    "gamma",
+    "numeric",
+    "phonetic",
+    "qgram",
+    "strings",
+    "double_metaphone",
+    "double_metaphone_primary",
+    "qgram_cosine_distance",
+    "qgram_jaccard",
+    "qgram_tokenise",
+    "exact_equal",
+    "jaro_winkler",
+    "jaro_winkler_single",
+    "levenshtein",
+    "levenshtein_ratio",
+    "levenshtein_single",
+]

@@ -1,0 +1,21 @@
+"""Numeric comparison kernels (absolute and relative difference).
+
+Semantics follow the reference's numeric CASE generators
+(/root/reference/splink/case_statements.py:158-246): relative difference is
+|a - b| / |max(a, b)| and thresholds are strict ``<`` comparisons.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def abs_difference(a, b):
+    return jnp.abs(a - b)
+
+
+def relative_difference(a, b):
+    """|a - b| / |max(a, b)| with a safe 0/0 -> 0."""
+    denom = jnp.abs(jnp.maximum(a, b))
+    diff = jnp.abs(a - b)
+    return jnp.where(denom > 0, diff / denom, jnp.where(diff > 0, jnp.inf, 0.0))

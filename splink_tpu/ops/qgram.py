@@ -1,0 +1,83 @@
+"""Q-gram set similarities (Jaccard, cosine) on device.
+
+TPU-native equivalents of the reference jar's JaccardSimilarity,
+CosineDistance and Q2-Q6gramTokeniser UDFs
+(/root/reference/tests/test_spark.py:46-52). Rather than materialising
+variable-length token sets (hostile to XLA's static shapes), each string's
+q-gram multiset is hashed into a fixed-width count profile on device; Jaccard
+and cosine are then cheap vector reductions. With the default 256 buckets,
+collisions are rare for the short identifier strings record linkage compares.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BUCKETS = 256
+
+
+def qgram_profile_single(s, length, q: int, n_buckets: int = DEFAULT_BUCKETS):
+    """Hashed q-gram count profile of one fixed-width byte string."""
+    L = s.shape[0]
+    n_windows = L - q + 1
+    win = jnp.arange(n_windows)[:, None] + jnp.arange(q)[None, :]
+    grams = s[win].astype(jnp.uint32)  # (n_windows, q)
+    # Polynomial rolling hash with wraparound uint32 arithmetic.
+    weights = jnp.power(jnp.uint32(257), jnp.arange(q, dtype=jnp.uint32))
+    h = jnp.sum(grams * weights[None, :], axis=1, dtype=jnp.uint32)
+    # murmur3 finaliser for good low-bit avalanche before the bucket mod
+    h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+    h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    bucket = (h % jnp.uint32(n_buckets)).astype(jnp.int32)
+    valid = (jnp.arange(n_windows) <= (length - q)).astype(jnp.float32)
+    return jnp.zeros(n_buckets, jnp.float32).at[bucket].add(valid)
+
+
+def jaccard_from_profiles(p1, p2):
+    """Multiset Jaccard: sum(min)/sum(max); both-empty -> 1 by convention? No:
+    the commons-text JaccardSimilarity of two empty sets is 1 only for
+    identical empties; we return 0 when both profiles are empty to stay
+    conservative, matching set-of-tokens behaviour for blank strings."""
+    inter = jnp.sum(jnp.minimum(p1, p2))
+    union = jnp.sum(jnp.maximum(p1, p2))
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def cosine_distance_from_profiles(p1, p2):
+    dot = jnp.sum(p1 * p2)
+    n1 = jnp.sqrt(jnp.sum(p1 * p1))
+    n2 = jnp.sqrt(jnp.sum(p2 * p2))
+    sim = jnp.where((n1 > 0) & (n2 > 0), dot / (n1 * n2), 0.0)
+    return 1.0 - sim
+
+
+def qgram_jaccard_single(s1, s2, l1, l2, q: int = 2, n_buckets: int = DEFAULT_BUCKETS):
+    return jaccard_from_profiles(
+        qgram_profile_single(s1, l1, q, n_buckets),
+        qgram_profile_single(s2, l2, q, n_buckets),
+    )
+
+
+def qgram_cosine_distance_single(
+    s1, s2, l1, l2, q: int = 2, n_buckets: int = DEFAULT_BUCKETS
+):
+    return cosine_distance_from_profiles(
+        qgram_profile_single(s1, l1, q, n_buckets),
+        qgram_profile_single(s2, l2, q, n_buckets),
+    )
+
+
+qgram_jaccard = jax.vmap(qgram_jaccard_single, in_axes=(0, 0, 0, 0, None, None))
+qgram_cosine_distance = jax.vmap(
+    qgram_cosine_distance_single, in_axes=(0, 0, 0, 0, None, None)
+)
+
+
+def qgram_tokenise(value: str, q: int) -> list[str]:
+    """Host-side q-gram tokeniser (the displayable analogue of the jar's
+    QgramTokeniser UDFs)."""
+    if value is None:
+        return []
+    return [value[i : i + q] for i in range(max(len(value) - q + 1, 0))]

@@ -1,0 +1,23 @@
+from .distributed import global_pair_slice, initialize_multihost
+from .mesh import (
+    DATA_AXIS,
+    make_mesh,
+    mesh_from_settings,
+    pair_sharding,
+    replicated,
+    shard_pairs,
+)
+from .streaming import run_em_streamed, score_stream
+
+__all__ = [
+    "DATA_AXIS",
+    "make_mesh",
+    "mesh_from_settings",
+    "pair_sharding",
+    "replicated",
+    "shard_pairs",
+    "run_em_streamed",
+    "score_stream",
+    "initialize_multihost",
+    "global_pair_slice",
+]

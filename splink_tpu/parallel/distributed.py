@@ -1,0 +1,46 @@
+"""Multi-host (multi-slice) initialisation.
+
+The reference's multi-machine story is "submit to a Spark cluster". The
+splink_tpu analogue is JAX multi-controller: each host runs the same program,
+``jax.distributed.initialize`` wires the hosts together, and the global mesh
+spans every chip; XLA routes the M-step psum over ICI within a slice and DCN
+across slices. EM's collective traffic is tiny (the SufficientStats pytree,
+a few KB), so DCN latency is irrelevant — the design scales to any slice
+count the pair stream can feed.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialise JAX's multi-controller runtime (no-op if single-process).
+
+    On TPU pods the arguments are auto-detected from the environment; pass
+    them explicitly for manual bring-up.
+    """
+    if jax.process_count() > 1:
+        return  # already initialised
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        # Single-process environment (no coordinator): run locally.
+        pass
+
+
+def global_pair_slice(n_pairs_global: int) -> slice:
+    """The half-open range of global pair indices this host is responsible
+    for feeding. Hosts stream disjoint slices; the psum in the EM stats makes
+    the union behave like one global aggregate."""
+    per = -(-n_pairs_global // jax.process_count())
+    start = jax.process_index() * per
+    return slice(start, min(start + per, n_pairs_global))

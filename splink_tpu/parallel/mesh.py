@@ -1,0 +1,78 @@
+"""Device-mesh helpers: the TPU replacement for Spark's partitioning layer.
+
+The reference delegates all distribution to Spark (SURVEY.md section 2:
+"Parallelism & distributed-communication components"). Here the single
+distributed axis is the candidate-pair axis — this framework's "sequence
+length" — sharded over a 1-D ``data`` mesh axis. M-step reductions then lower
+to psum collectives over ICI; parameters are replicated.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def mesh_from_settings(settings: dict) -> Mesh | None:
+    """Build the mesh described by the settings ``mesh`` dict, or None.
+
+    ``{"data": 8}`` means: shard the pair axis over 8 devices. An empty dict
+    (the default) means single-device execution.
+    """
+    spec = settings.get("mesh") or {}
+    if not spec:
+        return None
+    if list(spec.keys()) != [DATA_AXIS]:
+        raise ValueError(
+            f"Only a 1-D {{'data': N}} mesh is supported for EM; got {spec!r}"
+        )
+    return make_mesh(spec[DATA_AXIS])
+
+
+def pair_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (n_pairs, ...) arrays: split the leading pair axis."""
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def shard_pairs(mesh: Mesh, *arrays):
+    """Pad the leading axis to a multiple of the mesh size and device_put with
+    pair sharding. Returns (padded_arrays..., weights) where weights is 1.0
+    for real rows and 0.0 for padding — thread it into EM so padding rows
+    contribute nothing (gamma padding value -1 + weight 0)."""
+    import numpy as np
+
+    n = arrays[0].shape[0]
+    n_dev = mesh.devices.size
+    n_pad = pad_to_multiple(max(n, n_dev), n_dev)
+    sharding = pair_sharding(mesh)
+
+    out = []
+    for a in arrays:
+        if n_pad != n:
+            pad_shape = (n_pad - n,) + a.shape[1:]
+            fill = -1 if np.issubdtype(a.dtype, np.signedinteger) else 0
+            a = np.concatenate([a, np.full(pad_shape, fill, a.dtype)])
+        out.append(jax.device_put(a, sharding))
+    weights = np.zeros(n_pad, np.float32)
+    weights[:n] = 1.0
+    out.append(jax.device_put(weights, sharding))
+    return tuple(out)

@@ -1,0 +1,126 @@
+"""Streaming EM: sufficient statistics accumulated across micro-batches.
+
+For pair sets too large for HBM the reference gets global aggregation for
+free from Spark's shuffle (/root/reference/splink/maximisation_step.py:54-57).
+The TPU equivalent: stream gamma batches host->device (double-buffered via
+jax's async dispatch), accumulate ``SufficientStats`` on device per batch,
+and apply the parameter update once per pass over the data. The per-batch
+kernel is a single jit; with a mesh, batches are sharded over the pair axis
+and the stats reduction rides ICI psum.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.fellegi_sunter import (
+    FSParams,
+    SufficientStats,
+    log_likelihood,
+    match_probability,
+    sufficient_stats,
+    update_params,
+)
+from .mesh import pair_sharding, shard_pairs
+
+
+@functools.partial(jax.jit, static_argnames=("max_levels", "compute_ll"))
+def _batch_stats(G, params: FSParams, max_levels: int, weights=None, compute_ll=False):
+    p = match_probability(G, params)
+    stats = sufficient_stats(G, p, max_levels, weights)
+    ll = log_likelihood(G, params, weights) if compute_ll else jnp.zeros((), p.dtype)
+    return stats, ll
+
+
+def run_em_streamed(
+    batch_iter_factory: Callable[[], Iterable],
+    init: FSParams,
+    *,
+    max_iterations: int,
+    max_levels: int,
+    em_convergence: float,
+    mesh=None,
+    compute_ll: bool = False,
+    on_iteration=None,
+):
+    """EM over a re-iterable stream of gamma batches.
+
+    Args:
+        batch_iter_factory: zero-arg callable returning an iterable of either
+            ``G`` arrays or ``(G, weights)`` tuples, each (b, C) int8. Called
+            once per EM iteration (the stream is re-read every pass, like the
+            reference re-scans the persisted df_gammas).
+        init: starting parameters.
+        mesh: optional Mesh; batches are padded + sharded over the pair axis.
+        on_iteration: optional callback(iteration_index, FSParams, ll) run
+            after each update — the save_state_fn hook's internal analogue.
+
+    Returns (params, histories, n_updates, converged) mirroring run_em.
+    """
+    params = init
+    C, L = init.m.shape
+    lam_hist = [float(init.lam)]
+    m_hist = [np.asarray(init.m)]
+    u_hist = [np.asarray(init.u)]
+    ll_hist = []
+    converged = False
+    it = 0
+
+    for it in range(1, max_iterations + 1):
+        acc = SufficientStats.zeros(C, L, dtype=init.m.dtype)
+        ll_total = 0.0
+        for batch in batch_iter_factory():
+            if isinstance(batch, tuple):
+                G, w = batch
+            else:
+                G, w = batch, None
+            if mesh is not None:
+                if w is None:
+                    G, w = shard_pairs(mesh, np.asarray(G))
+                else:
+                    G, _auto_w = shard_pairs(mesh, np.asarray(G))
+                    w = jax.device_put(np.asarray(w), pair_sharding(mesh))
+            stats, ll = _batch_stats(
+                jnp.asarray(G), params, max_levels, w, compute_ll
+            )
+            acc = acc + stats
+            ll_total += float(ll)
+
+        new = update_params(acc)
+        delta = max(
+            float(jnp.max(jnp.abs(new.m - params.m))),
+            float(jnp.max(jnp.abs(new.u - params.u))),
+        )
+        params = new
+        lam_hist.append(float(params.lam))
+        m_hist.append(np.asarray(params.m))
+        u_hist.append(np.asarray(params.u))
+        if compute_ll:
+            ll_hist.append(ll_total)
+        if on_iteration is not None:
+            on_iteration(it, params, ll_total if compute_ll else None)
+        if delta < em_convergence:
+            converged = True
+            break
+
+    histories = {
+        "lam": np.asarray(lam_hist),
+        "m": np.stack(m_hist),
+        "u": np.stack(u_hist),
+        "ll": np.asarray(ll_hist) if compute_ll else None,
+    }
+    return params, histories, it, converged
+
+
+def score_stream(batch_iter, params: FSParams):
+    """Yield match probabilities for each gamma batch in the stream."""
+    from ..em import score_pairs
+
+    for batch in batch_iter:
+        G = batch[0] if isinstance(batch, tuple) else batch
+        yield np.asarray(score_pairs(jnp.asarray(G), params))

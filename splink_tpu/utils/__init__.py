@@ -1,0 +1,4 @@
+from .logging_utils import format_stage_log
+from .profiling import StageTimer, stage_timings
+
+__all__ = ["format_stage_log", "StageTimer", "stage_timings"]

@@ -1,0 +1,31 @@
+"""Structured debug logging for pipeline stages.
+
+The reference logs each generated SQL string at debug level
+(/root/reference/splink/logging_utils.py:10). The splink_tpu analogue is to log
+the *compiled artifact*: each stage can log its jaxpr / lowered HLO text plus
+shapes at debug level, which serves the same "inspect exactly what will run"
+purpose.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("splink_tpu")
+
+
+def format_stage_log(stage: str, **info) -> str:
+    parts = ", ".join(f"{k}={v}" for k, v in info.items())
+    return f"[{stage}] {parts}"
+
+
+def log_jaxpr(stage: str, fn, *example_args) -> None:
+    """Log the jaxpr of a stage function at debug level (cheap no-op otherwise)."""
+    if logger.isEnabledFor(logging.DEBUG):
+        import jax
+
+        try:
+            jaxpr = jax.make_jaxpr(fn)(*example_args)
+            logger.debug("[%s] jaxpr:\n%s", stage, jaxpr)
+        except Exception as e:  # pragma: no cover - logging must never break the run
+            logger.debug("[%s] jaxpr unavailable: %s", stage, e)

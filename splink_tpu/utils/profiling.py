@@ -1,0 +1,49 @@
+"""Per-stage wall-clock timing and optional jax profiler trace hooks."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+_TIMINGS: dict[str, list[float]] = defaultdict(list)
+
+
+class StageTimer(contextlib.AbstractContextManager):
+    """Context manager recording wall time for a named pipeline stage.
+
+    Usage::
+
+        with StageTimer("blocking"):
+            ...
+    """
+
+    def __init__(self, stage: str, trace_dir: str | None = None):
+        self.stage = stage
+        self.trace_dir = trace_dir
+        self._trace = None
+
+    def __enter__(self):
+        if self.trace_dir:  # pragma: no cover - needs a profiler consumer
+            import jax
+
+            self._trace = jax.profiler.trace(self.trace_dir)
+            self._trace.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        _TIMINGS[self.stage].append(self.elapsed)
+        if self._trace is not None:  # pragma: no cover
+            self._trace.__exit__(*exc)
+        return False
+
+
+def stage_timings() -> dict[str, list[float]]:
+    """All recorded stage timings for this process (stage -> list of seconds)."""
+    return dict(_TIMINGS)
+
+
+def reset_timings() -> None:
+    _TIMINGS.clear()

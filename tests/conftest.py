@@ -1,0 +1,97 @@
+"""Test configuration: run everything on CPU with 8 virtual devices.
+
+This is the JAX analogue of the reference's "multi-node without a cluster"
+strategy (sqlite unit tier + local Spark, /root/reference/tests/conftest.py):
+kernels and EM are validated on CPU against independent numpy oracles, and
+multi-chip sharding is exercised on a virtual 8-device mesh.
+
+Must run before jax is imported anywhere in the test process.
+"""
+
+import os
+
+# Force CPU: the environment pre-sets JAX_PLATFORMS=axon (real TPU) and
+# pre-imports jax at interpreter startup, so the env var alone is ignored —
+# jax.config.update is the reliable override. The test tier runs on 8 virtual
+# CPU devices; x64 (needed for oracle-exact comparisons) is also unavailable
+# on TPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def basic_settings():
+    """A small two-column dedupe settings dict used across tests."""
+    return {
+        "link_type": "dedupe_only",
+        "proportion_of_matches": 0.3,
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 2, "comparison": {"kind": "exact"}},
+            {"col_name": "surname", "num_levels": 2, "comparison": {"kind": "exact"}},
+        ],
+        "blocking_rules": [],
+    }
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+# ----------------------------------------------------------------------
+# Independent Python oracles (deliberately separate implementations from the
+# JAX kernels they validate).
+# ----------------------------------------------------------------------
+
+
+def py_jaro_winkler(s1, s2, p=0.1, boost_threshold=0.0):
+    if not s1 and not s2:
+        return 1.0
+    if not s1 or not s2:
+        return 0.0
+    l1, l2 = len(s1), len(s2)
+    window = max(max(l1, l2) // 2 - 1, 0)
+    used2 = [False] * l2
+    matched1 = []
+    for i, c in enumerate(s1):
+        for j in range(max(0, i - window), min(l2, i + window + 1)):
+            if not used2[j] and s2[j] == c:
+                used2[j] = True
+                matched1.append(i)
+                break
+    m = len(matched1)
+    if m == 0:
+        return 0.0
+    seq1 = [s1[i] for i in matched1]
+    seq2 = [s2[j] for j in range(l2) if used2[j]]
+    t = sum(a != b for a, b in zip(seq1, seq2)) / 2
+    jaro = (m / l1 + m / l2 + (m - t) / m) / 3
+    ell = 0
+    for a, b in zip(s1, s2):
+        if a == b and ell < 4:
+            ell += 1
+        else:
+            break
+    return jaro + ell * p * (1 - jaro) if jaro > boost_threshold else jaro
+
+
+def py_levenshtein(s1, s2):
+    d = list(range(len(s2) + 1))
+    for i, c1 in enumerate(s1):
+        nd = [i + 1]
+        for j, c2 in enumerate(s2):
+            nd.append(min(d[j + 1] + 1, nd[j] + 1, d[j] + (c1 != c2)))
+        d = nd
+    return d[-1]

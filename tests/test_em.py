@@ -1,0 +1,247 @@
+"""EM correctness: hand-computable golden case, an independent numpy EM
+oracle, and the known-DGP parameter-recovery test (the analogue of the
+reference's most important statistical test,
+/root/reference/tests/test_spark.py:428-468)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from splink_tpu.em import run_em, score_pairs, score_pairs_with_intermediates
+from splink_tpu.models.fellegi_sunter import (
+    FSParams,
+    log_likelihood,
+    match_probability,
+    sufficient_stats,
+    update_params,
+)
+
+
+def numpy_em_step(G, lam, m, u):
+    """Independent oracle for one EM iteration, straight from the formulas in
+    the fastLink paper (and the reference's SQL: expectation_step.py:170-176,
+    maximisation_step.py:41-90)."""
+    n, C = G.shape
+    prod_m = np.ones(n)
+    prod_u = np.ones(n)
+    for c in range(C):
+        g = G[:, c]
+        mask = g >= 0
+        prod_m[mask] *= m[c][g[mask]]
+        prod_u[mask] *= u[c][g[mask]]
+    p = lam * prod_m / (lam * prod_m + (1 - lam) * prod_u)
+
+    new_lam = p.sum() / n
+    new_m, new_u = [], []
+    for c in range(C):
+        g = G[:, c]
+        valid = g >= 0
+        mden = p[valid].sum()
+        uden = (1 - p)[valid].sum()
+        levels = len(m[c])
+        nm = np.zeros(levels)
+        nu = np.zeros(levels)
+        for lv in range(levels):
+            sel = g == lv
+            nm[lv] = p[sel].sum() / mden
+            nu[lv] = (1 - p)[sel].sum() / uden
+        new_m.append(nm)
+        new_u.append(nu)
+    return p, new_lam, new_m, new_u
+
+
+def _pack(dists, Lmax):
+    out = np.zeros((len(dists), Lmax))
+    for c, d in enumerate(dists):
+        out[c, : len(d)] = d
+    return out
+
+
+def test_single_step_matches_hand_calculation():
+    # Two binary exact-match columns, lambda = 0.5, hand-checkable numbers.
+    G = np.array([[1, 1], [1, 0], [0, 1], [0, 0], [-1, 1]], np.int8)
+    lam = 0.5
+    m = [np.array([0.1, 0.9]), np.array([0.2, 0.8])]
+    u = [np.array([0.8, 0.2]), np.array([0.7, 0.3])]
+
+    # Row 0: p = .5*.9*.8 / (.5*.9*.8 + .5*.2*.3) = .72/.78
+    expected_p0 = 0.72 / 0.78
+    # Row 4: first col null -> contributes 1 to both sides
+    expected_p4 = (0.5 * 0.8) / (0.5 * 0.8 + 0.5 * 0.3)
+
+    params = FSParams(
+        lam=jnp.asarray(lam), m=jnp.asarray(_pack(m, 2)), u=jnp.asarray(_pack(u, 2))
+    )
+    p = np.asarray(match_probability(jnp.asarray(G), params))
+    assert p[0] == pytest.approx(expected_p0, rel=1e-12)
+    assert p[4] == pytest.approx(expected_p4, rel=1e-12)
+
+    # Full step vs the numpy oracle
+    p_oracle, new_lam, new_m, new_u = numpy_em_step(G, lam, m, u)
+    np.testing.assert_allclose(p, p_oracle, rtol=1e-12)
+    stats = sufficient_stats(jnp.asarray(G), jnp.asarray(p_oracle), 2)
+    new = update_params(stats)
+    assert float(new.lam) == pytest.approx(new_lam, rel=1e-12)
+    np.testing.assert_allclose(np.asarray(new.m), _pack(new_m, 2), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(new.u), _pack(new_u, 2), rtol=1e-10)
+
+
+def test_null_exclusion_from_normaliser():
+    # A column that is null in some rows: the m/u normaliser for that column
+    # must exclude those rows (reference maximisation_step.py:68-69).
+    G = np.array([[1, -1], [0, 1], [1, 0]], np.int8)
+    lam = 0.3
+    m = [np.array([0.2, 0.8]), np.array([0.4, 0.6])]
+    u = [np.array([0.9, 0.1]), np.array([0.6, 0.4])]
+    p_oracle, new_lam, new_m, new_u = numpy_em_step(G, lam, m, u)
+    params = FSParams(
+        lam=jnp.asarray(lam), m=jnp.asarray(_pack(m, 2)), u=jnp.asarray(_pack(u, 2))
+    )
+    p = np.asarray(match_probability(jnp.asarray(G), params))
+    stats = sufficient_stats(jnp.asarray(G), jnp.asarray(p), 2)
+    new = update_params(stats)
+    np.testing.assert_allclose(np.asarray(new.m), _pack(new_m, 2), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(new.u), _pack(new_u, 2), rtol=1e-10)
+    # lambda denominator counts *all* rows including the null one
+    assert float(new.lam) == pytest.approx(p.sum() / 3, rel=1e-12)
+
+
+def test_multi_iteration_matches_oracle():
+    rng = np.random.default_rng(7)
+    n = 5000
+    G = np.stack(
+        [rng.integers(0, 2, n), rng.integers(0, 3, n), rng.integers(0, 2, n)],
+        axis=1,
+    ).astype(np.int8)
+    G[rng.random(n) < 0.1, 0] = -1
+    lam = 0.3
+    m = [np.array([0.3, 0.7]), np.array([0.2, 0.3, 0.5]), np.array([0.4, 0.6])]
+    u = [np.array([0.7, 0.3]), np.array([0.5, 0.3, 0.2]), np.array([0.6, 0.4])]
+
+    lam_o, m_o, u_o = lam, [d.copy() for d in m], [d.copy() for d in u]
+    for _ in range(5):
+        _, lam_o, m_o, u_o = numpy_em_step(G, lam_o, m_o, u_o)
+
+    init = FSParams(
+        lam=jnp.asarray(lam), m=jnp.asarray(_pack(m, 3)), u=jnp.asarray(_pack(u, 3))
+    )
+    res = run_em(
+        jnp.asarray(G), init, max_iterations=5, max_levels=3, em_convergence=1e-300
+    )
+    assert int(res.n_updates) == 5
+    assert float(res.params.lam) == pytest.approx(lam_o, rel=1e-9)
+    np.testing.assert_allclose(np.asarray(res.params.m), _pack(m_o, 3), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(res.params.u), _pack(u_o, 3), atol=1e-9)
+    # history: index 0 = initial params, index 1 = after first update
+    assert float(res.lam_history[0]) == pytest.approx(lam)
+    _, lam_1, _, _ = numpy_em_step(G, lam, m, u)
+    assert float(res.lam_history[1]) == pytest.approx(lam_1, rel=1e-9)
+
+
+def test_known_dgp_parameter_recovery():
+    """EM must recover the true generating m/u/lambda within +-0.01 and
+    converge in well under the iteration cap."""
+    rng = np.random.default_rng(0)
+    lam_true = 0.25
+    m = np.array(
+        [[0.1, 0.9, 0.0], [0.2, 0.1, 0.7], [0.05, 0.95, 0.0], [0.3, 0.7, 0.0]]
+    )
+    u = np.array(
+        [[0.8, 0.2, 0.0], [0.7, 0.2, 0.1], [0.9, 0.1, 0.0], [0.8, 0.2, 0.0]]
+    )
+    n = 300_000
+    is_match = rng.random(n) < lam_true
+    G = np.zeros((n, 4), np.int8)
+    for c in range(4):
+        probs = np.where(is_match[:, None], m[c], u[c])
+        G[:, c] = (rng.random(n)[:, None] > probs.cumsum(1)).sum(1)
+
+    m0 = np.array([[0.4, 0.6, 0], [0.2, 0.3, 0.5], [0.4, 0.6, 0], [0.4, 0.6, 0]])
+    u0 = np.array([[0.6, 0.4, 0], [0.5, 0.3, 0.2], [0.6, 0.4, 0], [0.6, 0.4, 0]])
+    init = FSParams(lam=jnp.asarray(0.5), m=jnp.asarray(m0), u=jnp.asarray(u0))
+    res = run_em(
+        jnp.asarray(G),
+        init,
+        max_iterations=60,
+        max_levels=3,
+        em_convergence=1e-6,
+        compute_ll=True,
+    )
+    assert bool(res.converged)
+    assert int(res.n_updates) < 60
+    assert abs(float(res.params.lam) - lam_true) < 0.01
+    assert np.abs(np.asarray(res.params.m) - m).max() < 0.01
+    assert np.abs(np.asarray(res.params.u) - u).max() < 0.01
+    # log-likelihood must be monotone non-decreasing (to numerical noise)
+    ll = np.asarray(res.ll_history)[: int(res.n_updates) + 1]
+    assert np.all(np.diff(ll) > -1e-2)
+
+
+def test_padding_weights_do_not_affect_results():
+    rng = np.random.default_rng(3)
+    n = 1000
+    G = rng.integers(0, 2, (n, 2)).astype(np.int8)
+    lam = 0.3
+    m0 = np.array([[0.3, 0.7], [0.2, 0.8]])
+    u0 = np.array([[0.7, 0.3], [0.8, 0.2]])
+    init = FSParams(lam=jnp.asarray(lam), m=jnp.asarray(m0), u=jnp.asarray(u0))
+
+    res_plain = run_em(
+        jnp.asarray(G), init, max_iterations=4, max_levels=2, em_convergence=0.0
+    )
+    # pad to 1536 rows with weight-0 garbage
+    pad = 536
+    G_pad = np.concatenate([G, np.full((pad, 2), 1, np.int8)])
+    w = np.concatenate([np.ones(n), np.zeros(pad)])
+    res_pad = run_em(
+        jnp.asarray(G_pad),
+        init,
+        max_iterations=4,
+        max_levels=2,
+        em_convergence=0.0,
+        weights=jnp.asarray(w),
+    )
+    assert float(res_pad.params.lam) == pytest.approx(float(res_plain.params.lam), rel=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(res_pad.params.m), np.asarray(res_plain.params.m), rtol=1e-12
+    )
+
+
+def test_zero_max_iterations_scores_without_em():
+    # max_iterations = 0: score with the supplied priors (reference
+    # manually_apply_fellegi_sunter_weights semantics).
+    G = np.array([[1, 1], [0, 0]], np.int8)
+    init = FSParams(
+        lam=jnp.asarray(0.5),
+        m=jnp.asarray([[0.1, 0.9], [0.2, 0.8]]),
+        u=jnp.asarray([[0.8, 0.2], [0.7, 0.3]]),
+    )
+    res = run_em(jnp.asarray(G), init, max_iterations=0, max_levels=2, em_convergence=1e-4)
+    assert int(res.n_updates) == 0
+    p = np.asarray(score_pairs(jnp.asarray(G), res.params))
+    assert p[0] == pytest.approx(0.72 / 0.78)
+
+
+def test_score_intermediates_null_gives_one():
+    G = np.array([[-1, 1]], np.int8)
+    params = FSParams(
+        lam=jnp.asarray(0.5),
+        m=jnp.asarray([[0.1, 0.9], [0.2, 0.8]]),
+        u=jnp.asarray([[0.8, 0.2], [0.7, 0.3]]),
+    )
+    p, pm, pu = score_pairs_with_intermediates(jnp.asarray(G), params)
+    assert float(pm[0, 0]) == 1.0 and float(pu[0, 0]) == 1.0
+    assert float(pm[0, 1]) == pytest.approx(0.8)
+
+
+def test_log_likelihood_matches_direct_computation():
+    G = np.array([[1, 0], [0, 1]], np.int8)
+    lam = 0.4
+    m = np.array([[0.3, 0.7], [0.2, 0.8]])
+    u = np.array([[0.6, 0.4], [0.9, 0.1]])
+    params = FSParams(lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u))
+    want = np.log(lam * 0.7 * 0.2 + 0.6 * 0.4 * 0.9) + np.log(
+        lam * 0.3 * 0.8 + 0.6 * 0.6 * 0.1
+    )
+    got = float(log_likelihood(jnp.asarray(G), params))
+    assert got == pytest.approx(want, rel=1e-12)

@@ -1,0 +1,136 @@
+"""Params object invariants: construction, update cycle, convergence,
+persistence — the behaviours pinned by the reference's params tests
+(/root/reference/tests/test_params.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from splink_tpu.params import Params, load_params_from_dict, load_params_from_json
+
+
+def _settings():
+    return {
+        "link_type": "dedupe_only",
+        "proportion_of_matches": 0.4,
+        "comparison_columns": [
+            {"col_name": "fname", "num_levels": 2},
+            {"col_name": "sname", "num_levels": 3},
+        ],
+        "blocking_rules": ["l.dob = r.dob"],
+    }
+
+
+def test_initial_structure_and_normalisation():
+    p = Params(_settings())
+    assert p.params["λ"] == 0.4
+    assert set(p.params["π"].keys()) == {"gamma_fname", "gamma_sname"}
+    fname = p.params["π"]["gamma_fname"]
+    assert fname["gamma_index"] == 0
+    assert fname["num_levels"] == 2
+    probs = [
+        lv["probability"] for lv in fname["prob_dist_match"].values()
+    ]
+    assert sum(probs) == pytest.approx(1.0)
+
+
+def test_to_arrays_roundtrip():
+    p = Params(_settings())
+    lam, m, u, mask = p.to_arrays()
+    assert m.shape == (2, 3)
+    assert mask[0].tolist() == [True, True, False]
+    assert m[0, 2] == 0.0  # padding beyond num_levels
+    assert lam == pytest.approx(0.4)
+    # roundtrip through an update
+    p.update_from_arrays(0.25, m * 0 + 0.5, u * 0 + 0.25)
+    assert p.params["λ"] == 0.25
+    assert p.iteration == 2
+    assert len(p.param_history) == 1
+    assert p.param_history[0]["λ"] == 0.4
+
+
+def test_update_cycle_history_semantics():
+    p = Params(_settings())
+    lam, m, u, _ = p.to_arrays()
+    for k in range(3):
+        p.update_from_arrays(0.1 * (k + 1), m, u)
+    assert len(p.param_history) == 3
+    assert p.iteration == 4
+    assert p.param_history[0]["λ"] == 0.4
+    assert p.params["λ"] == pytest.approx(0.3)
+
+
+def test_convergence_on_pi_only():
+    p = Params(_settings())
+    lam, m, u, _ = p.to_arrays()
+    # big lambda move, identical pi: converged (lambda is not inspected,
+    # matching the reference /root/reference/splink/params.py:321-324)
+    p.update_from_arrays(0.9, m, u)
+    assert p.is_converged()
+    # now move one pi probability by more than the threshold
+    m2 = m.copy()
+    m2[0, 0] += 0.05
+    m2[0, 1] -= 0.05
+    p.update_from_arrays(0.9, m2, u)
+    assert not p.is_converged()
+
+
+def test_zero_fill_unseen_levels():
+    p = Params(_settings())
+    lam, m, u, _ = p.to_arrays()
+    m2 = m.copy()
+    m2[1] = [0.3, 0.7, 0.0]  # level 2 never observed
+    p.update_from_arrays(0.2, m2, u)
+    assert (
+        p.params["π"]["gamma_sname"]["prob_dist_match"]["level_2"]["probability"] == 0.0
+    )
+
+
+def test_json_roundtrip(tmp_path):
+    p = Params(_settings())
+    lam, m, u, _ = p.to_arrays()
+    p.update_from_arrays(0.2, m, u)
+    path = tmp_path / "model.json"
+    p.save_params_to_json_file(str(path))
+    with open(path) as f:
+        d = json.load(f)
+    assert set(d.keys()) == {"current_params", "historical_params", "settings"}
+    p2 = load_params_from_json(str(path))
+    assert p2.params["λ"] == pytest.approx(p.params["λ"])
+    assert p2.param_history[0]["λ"] == pytest.approx(0.4)
+    lam2, m2, u2, _ = p2.to_arrays()
+    np.testing.assert_allclose(m2, m)
+
+
+def test_save_refuses_overwrite(tmp_path):
+    p = Params(_settings())
+    path = tmp_path / "model.json"
+    p.save_params_to_json_file(str(path))
+    with pytest.raises(ValueError, match="already exists"):
+        p.save_params_to_json_file(str(path))
+    p.save_params_to_json_file(str(path), overwrite=True)
+
+
+def test_corrupted_dict_rejected():
+    with pytest.raises(ValueError, match="corrupted"):
+        load_params_from_dict({"current_params": {}, "settings": {}})
+
+
+def test_describe_gammas():
+    p = Params(_settings())
+    d = p.describe_gammas()
+    assert d["gamma_fname"] == "Comparison of fname"
+
+
+def test_iteration_history_dataframes():
+    p = Params(_settings())
+    lam, m, u, _ = p.to_arrays()
+    p.update_from_arrays(0.2, m, u)
+    lam_rows = p._iteration_history_df_lambdas()
+    assert [r["iteration"] for r in lam_rows] == [0, 1]
+    assert lam_rows[0]["λ"] == 0.4
+    gamma_rows = p._iteration_history_df_gammas()
+    assert {r["iteration"] for r in gamma_rows} == {0, 1}
+    # 2 levels * 2 dists + 3 levels * 2 dists = 10 rows per iteration
+    assert len(gamma_rows) == 20

@@ -1,0 +1,141 @@
+"""Settings completion and validation semantics.
+
+Mirrors the behaviours pinned by the reference's settings layer
+(/root/reference/splink/settings.py): schema defaults, gamma_index
+assignment, default m/u priors and their normalisation, default comparison
+selection by (data_type, num_levels), and validation errors.
+"""
+
+import pytest
+
+from splink_tpu.settings import complete_settings_dict
+from splink_tpu.validate import ValidationError, validate_settings
+
+
+def _minimal(**over):
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "fname"}],
+        "blocking_rules": ["l.dob = r.dob"],
+    }
+    s.update(over)
+    return s
+
+
+def test_non_column_defaults_filled():
+    s = complete_settings_dict(_minimal())
+    assert s["em_convergence"] == 0.0001
+    assert s["max_iterations"] == 25
+    assert s["proportion_of_matches"] == 0.3
+    assert s["unique_id_column_name"] == "unique_id"
+    assert s["retain_matching_columns"] is True
+    assert s["retain_intermediate_calculation_columns"] is True
+    assert s["additional_columns_to_retain"] == []
+    assert s["backend"] == "jax"
+
+
+def test_column_defaults_and_gamma_index():
+    s = complete_settings_dict(
+        _minimal(comparison_columns=[{"col_name": "a"}, {"col_name": "b"}])
+    )
+    for i, col in enumerate(s["comparison_columns"]):
+        assert col["gamma_index"] == i
+        assert col["num_levels"] == 2
+        assert col["data_type"] == "string"
+        assert col["term_frequency_adjustments"] is False
+
+
+def test_default_m_u_priors_normalised():
+    s = complete_settings_dict(
+        _minimal(
+            comparison_columns=[
+                {"col_name": "a", "num_levels": 2},
+                {"col_name": "b", "num_levels": 3},
+                {"col_name": "c", "num_levels": 4},
+            ]
+        )
+    )
+    cols = s["comparison_columns"]
+    assert cols[0]["m_probabilities"] == pytest.approx([0.1, 0.9])
+    assert cols[0]["u_probabilities"] == pytest.approx([0.9, 0.1])
+    assert cols[1]["m_probabilities"] == pytest.approx([0.1, 0.2, 0.7])
+    assert cols[1]["u_probabilities"] == pytest.approx([0.7, 0.2, 0.1])
+    assert cols[2]["m_probabilities"] == pytest.approx([0.1, 0.1, 0.1, 0.7])
+    assert cols[2]["u_probabilities"] == pytest.approx([0.7, 0.1, 0.1, 0.1])
+
+
+def test_user_probabilities_normalised():
+    s = complete_settings_dict(
+        _minimal(
+            comparison_columns=[{"col_name": "a", "m_probabilities": [2, 6]}]
+        )
+    )
+    assert s["comparison_columns"][0]["m_probabilities"] == pytest.approx([0.25, 0.75])
+
+
+def test_wrong_length_probabilities_raises():
+    with pytest.raises(ValueError, match="not equal to the number of levels"):
+        complete_settings_dict(
+            _minimal(
+                comparison_columns=[
+                    {"col_name": "a", "num_levels": 3, "m_probabilities": [0.5, 0.5]}
+                ]
+            )
+        )
+
+
+def test_default_comparisons_by_type_and_levels():
+    s = complete_settings_dict(
+        _minimal(
+            comparison_columns=[
+                {"col_name": "a", "num_levels": 3},
+                {"col_name": "b", "data_type": "numeric", "num_levels": 2},
+                {"col_name": "c", "data_type": "numeric", "num_levels": 3},
+            ]
+        )
+    )
+    cols = s["comparison_columns"]
+    assert cols[0]["comparison"] == {"kind": "jaro_winkler", "thresholds": [0.94, 0.88]}
+    assert cols[1]["comparison"] == {"kind": "numeric_abs", "thresholds": [0.00001]}
+    assert cols[2]["comparison"] == {"kind": "numeric_perc", "thresholds": [0.0001, 0.05]}
+
+
+def test_case_expression_translated():
+    expr = """case
+    when fname_l is null or fname_r is null then -1
+    when jaro_winkler_sim(fname_l, fname_r) > 0.94 then 2
+    when jaro_winkler_sim(fname_l, fname_r) > 0.88 then 1
+    else 0 end"""
+    s = complete_settings_dict(
+        _minimal(
+            comparison_columns=[
+                {"col_name": "fname", "num_levels": 3, "case_expression": expr}
+            ]
+        )
+    )
+    assert s["comparison_columns"][0]["comparison"] == {
+        "kind": "jaro_winkler",
+        "thresholds": [0.94, 0.88],
+    }
+
+
+def test_invalid_link_type_rejected():
+    with pytest.raises(ValidationError):
+        validate_settings(_minimal(link_type="nope"))
+
+
+def test_unknown_top_level_key_rejected():
+    with pytest.raises(ValidationError):
+        validate_settings(_minimal(blocking_rulez=[]))
+
+
+def test_empty_blocking_rules_warns():
+    with pytest.warns(UserWarning, match="blocking"):
+        complete_settings_dict(_minimal(blocking_rules=[]))
+
+
+def test_levels_above_four_need_explicit_config():
+    with pytest.raises(ValueError, match="num_levels > 4"):
+        complete_settings_dict(
+            _minimal(comparison_columns=[{"col_name": "a", "num_levels": 5}])
+        )

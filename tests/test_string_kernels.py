@@ -1,0 +1,171 @@
+"""String-similarity kernels vs independent Python oracles.
+
+The reference ships these as JVM UDFs (jars/scala-udf-similarity-0.0.6.jar);
+here the JAX kernels are validated against from-scratch Python
+implementations plus published worked examples (MARTHA/MARHTA = 0.9611 etc.,
+from the Winkler literature).
+"""
+
+import numpy as np
+import pytest
+
+from splink_tpu.ops import qgram, strings
+from splink_tpu.ops.phonetic import double_metaphone
+
+from conftest import py_jaro_winkler, py_levenshtein
+
+L = 16
+
+
+def enc(s, width=L):
+    b = s.encode()[:width]
+    a = np.zeros(width, np.uint8)
+    a[: len(b)] = np.frombuffer(b, np.uint8)
+    return a, len(b)
+
+
+def batch(pairs, width=L):
+    s1 = np.stack([enc(a, width)[0] for a, _ in pairs])
+    s2 = np.stack([enc(b, width)[0] for _, b in pairs])
+    l1 = np.array([len(a.encode()[:width]) for a, _ in pairs], np.int32)
+    l2 = np.array([len(b.encode()[:width]) for _, b in pairs], np.int32)
+    return s1, s2, l1, l2
+
+
+CASES = [
+    ("MARTHA", "MARHTA"),
+    ("DIXON", "DICKSONX"),
+    ("DWAYNE", "DUANE"),
+    ("JELLYFISH", "SMELLYFISH"),
+    ("apple", "apple"),
+    ("", "a"),
+    ("", ""),
+    ("kitten", "sitting"),
+    ("abc", "cba"),
+    ("CRATE", "TRACE"),
+    ("a", "b"),
+    ("robert", "rupert"),
+    ("aaaaaa", "aaaaaa"),
+    ("ab", "ba"),
+    ("abcdefgh", "abcdefgh"),
+    ("abcdefgh", "hgfedcba"),
+]
+
+
+def test_jaro_winkler_matches_oracle():
+    s1, s2, l1, l2 = batch(CASES)
+    got = np.asarray(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.0))
+    want = [py_jaro_winkler(a, b) for a, b in CASES]
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_jaro_winkler_known_values():
+    s1, s2, l1, l2 = batch([("MARTHA", "MARHTA"), ("DIXON", "DICKSONX")])
+    got = np.asarray(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.0))
+    assert got[0] == pytest.approx(0.9611, abs=1e-4)
+    assert got[1] == pytest.approx(0.8133, abs=1e-4)
+
+
+def test_jaro_winkler_boost_threshold():
+    s1, s2, l1, l2 = batch([("abc", "cba")])
+    boosted = float(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.0)[0])
+    gated = float(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.7)[0])
+    # jaro of abc/cba is 5/9 < 0.7: no boost when gated (and no common prefix
+    # anyway, so values agree); sanity only
+    assert gated <= boosted + 1e-9
+
+
+def test_jaro_winkler_random_fuzz(rng):
+    alphabet = list("abcdefg")
+    pairs = []
+    for _ in range(300):
+        n1 = rng.integers(0, 10)
+        n2 = rng.integers(0, 10)
+        pairs.append(
+            (
+                "".join(rng.choice(alphabet, n1)),
+                "".join(rng.choice(alphabet, n2)),
+            )
+        )
+    s1, s2, l1, l2 = batch(pairs)
+    got = np.asarray(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.0))
+    want = [py_jaro_winkler(a, b) for a, b in pairs]
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_levenshtein_matches_oracle(rng):
+    fixed = CASES + [("saturday", "sunday"), ("flaw", "lawn")]
+    alphabet = list("abcd")
+    fuzz = [
+        (
+            "".join(rng.choice(alphabet, rng.integers(0, 12))),
+            "".join(rng.choice(alphabet, rng.integers(0, 12))),
+        )
+        for _ in range(300)
+    ]
+    pairs = fixed + fuzz
+    s1, s2, l1, l2 = batch(pairs)
+    got = np.asarray(strings.levenshtein(s1, s2, l1, l2))
+    want = [py_levenshtein(a, b) for a, b in pairs]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_levenshtein_ratio():
+    s1, s2, l1, l2 = batch([("abcd", "abcf")])
+    # distance 1, mean length 4 -> 0.25
+    assert float(strings.levenshtein_ratio(s1, s2, l1, l2)[0]) == pytest.approx(0.25)
+
+
+def test_exact_equal():
+    s1, s2, l1, l2 = batch([("ab", "ab"), ("ab", "abc"), ("", ""), ("ab", "aB")])
+    got = np.asarray(strings.exact_equal(s1, s2, l1, l2))
+    assert got.tolist() == [True, False, True, False]
+
+
+def test_qgram_jaccard_identical_and_disjoint():
+    s1, s2, l1, l2 = batch([("hello", "hello"), ("abcd", "wxyz"), ("", "")])
+    got = np.asarray(qgram.qgram_jaccard(s1, s2, l1, l2, 2, 256))
+    assert got[0] == pytest.approx(1.0)
+    assert got[1] == pytest.approx(0.0, abs=1e-6)
+    assert got[2] == pytest.approx(0.0)
+
+
+def test_qgram_jaccard_partial_overlap():
+    # "night" vs "nacht": bigrams {ni ig gh ht} vs {na ac ch ht} -> 1/7
+    s1, s2, l1, l2 = batch([("night", "nacht")])
+    got = float(qgram.qgram_jaccard(s1, s2, l1, l2, 2, 256)[0])
+    assert got == pytest.approx(1 / 7, abs=0.02)  # small collision tolerance
+
+
+def test_qgram_cosine_distance():
+    s1, s2, l1, l2 = batch([("hello", "hello"), ("abcd", "wxyz")])
+    got = np.asarray(qgram.qgram_cosine_distance(s1, s2, l1, l2, 2, 256))
+    assert got[0] == pytest.approx(0.0, abs=1e-6)
+    assert got[1] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_qgram_tokenise_host():
+    assert qgram.qgram_tokenise("abcd", 2) == ["ab", "bc", "cd"]
+    assert qgram.qgram_tokenise("a", 2) == []
+    assert qgram.qgram_tokenise(None, 2) == []
+
+
+def test_double_metaphone_clusters_similar_names():
+    # The point of the encoder is stable phonetic keys: similar-sounding
+    # variants collide, dissimilar names don't.
+    same = [("Smith", "Smyth"), ("Catherine", "Katherine"), ("Jon", "John")]
+    for a, b in same:
+        pa, _ = double_metaphone(a)
+        pb, altb = double_metaphone(b)
+        assert pa in (pb, altb), (a, b, double_metaphone(a), double_metaphone(b))
+    pa, _ = double_metaphone("Smith")
+    pb, _ = double_metaphone("Jones")
+    assert pa != pb
+
+
+def test_double_metaphone_basic_rules():
+    assert double_metaphone("PHONE")[0].startswith("F")
+    assert double_metaphone("KNIGHT")[0].startswith("N")
+    assert double_metaphone("WRIGHT")[0].startswith("R")
+    assert double_metaphone("")[0] == ""
+    assert double_metaphone(None) == ("", "")
