@@ -33,6 +33,10 @@ __all__ = [
     "load_params_from_json",
     "complete_settings_dict",
     "validate_settings",
+    # provided lazily from splink_tpu.linker (kept lazy to keep import light):
+    "Splink",
+    "load_from_json",
+    "register_comparison",
 ]
 
 

@@ -101,7 +101,11 @@ def encode_string_column(values, width: int = DEFAULT_STRING_WIDTH) -> EncodedSt
     n = len(obj)
     null_mask = np.array([v is None for v in obj], dtype=bool)
 
-    width = _pad_width(width)
+    # Width = observed max length rounded up to 8, capped by the configured
+    # budget — short name columns then pad to 8 chars instead of 24, which
+    # directly scales the O(width^2) similarity-kernel cost.
+    max_len = max((len(str(v)) for v in obj if v is not None), default=1)
+    width = min(_pad_width(max_len), _pad_width(width))
     ascii_only = all(v is None or str(v).isascii() for v in obj)
     dtype = np.uint8 if ascii_only else np.uint32
     bytes_ = np.zeros((n, width), dtype=dtype)
@@ -142,6 +146,8 @@ def encode_numeric_column(values) -> EncodedNumericColumn:
 
 def _columns_needed(settings: dict) -> tuple[dict[str, str], list[str]]:
     """-> ({column_name: data_type}, passthrough_columns)."""
+    import re
+
     typed: dict[str, str] = {}
     for col in settings["comparison_columns"]:
         if "col_name" in col:
@@ -153,6 +159,11 @@ def _columns_needed(settings: dict) -> tuple[dict[str, str], list[str]]:
     passthrough = [
         c for c in settings.get("additional_columns_to_retain", []) if c not in typed
     ]
+    # Columns referenced only by blocking rules (join keys / predicates)
+    for rule in settings.get("blocking_rules") or []:
+        for ref in re.findall(r"\b[lr]\.(\w+)", rule):
+            if ref not in typed and ref not in passthrough:
+                passthrough.append(ref)
     return typed, passthrough
 
 
@@ -189,11 +200,11 @@ def encode_table(df, settings: dict, source_table: np.ndarray | None = None) -> 
     return table
 
 
-def concat_tables(left: EncodedTable, right: EncodedTable, settings: dict, df_l, df_r) -> EncodedTable:
+def concat_tables(df_l, df_r, settings: dict) -> EncodedTable:
     """Vertically concatenate two inputs with a _source_table tag (0 = left,
-    1 = right), the link_and_dedupe preparation step
-    (/root/reference/splink/blocking.py:70-93). Re-encodes from the raw
-    frames so token ids share one vocabulary."""
+    1 = right), the link-type preparation step
+    (/root/reference/splink/blocking.py:70-93). Encodes the combined frame so
+    token ids share one vocabulary across both inputs."""
     import pandas as pd
 
     combined = pd.concat([df_l, df_r], ignore_index=True)

@@ -1,0 +1,291 @@
+"""User-facing linker: the TPU-native counterpart of the reference's Splink
+class (/root/reference/splink/__init__.py:33-195).
+
+Same API shape — ``Splink(settings, df=... | df_l=..., df_r=...)``,
+``get_scored_comparisons()``, ``manually_apply_fellegi_sunter_weights()``,
+``make_term_frequency_adjustments()``, ``save_model_as_json()`` and module
+level ``load_from_json`` — but the inputs/outputs are pandas DataFrames and
+the execution pipeline is: host encode -> host hash-join blocking -> device
+gamma program -> one fused jitted EM -> device scoring, instead of generated
+Spark SQL.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from .blocking import PairIndex, block_using_rules
+from .data import EncodedTable, concat_tables, encode_table
+from .em import run_em, score_pairs_with_intermediates
+from .gammas import GammaProgram, register_comparison  # noqa: F401 (re-export)
+from .models.fellegi_sunter import FSParams
+from .params import Params, load_params_from_json
+from .parallel.mesh import mesh_from_settings, shard_pairs
+from .settings import comparison_column_name, complete_settings_dict
+from .utils.profiling import StageTimer
+
+logger = logging.getLogger("splink_tpu")
+
+try:  # pandas is required for the linker facade (not for the kernels)
+    import pandas as pd
+except ImportError:  # pragma: no cover
+    pd = None
+
+
+class Splink:
+    def __init__(
+        self,
+        settings: dict,
+        df=None,
+        df_l=None,
+        df_r=None,
+        save_state_fn: Callable = None,
+        spark=None,  # accepted and ignored: reference-API compatibility
+    ):
+        """TPU-native probabilistic data linker.
+
+        Args:
+            settings: splink settings dictionary (same schema as the
+                reference plus TPU keys; see files/settings_jsonschema.json).
+            df: the single input DataFrame when link_type == dedupe_only.
+            df_l, df_r: the two inputs for link_only / link_and_dedupe.
+            save_state_fn: callable(params, settings) run after every EM
+                iteration — the restart hook for very large jobs
+                (/root/reference/splink/iterate.py:54-55).
+            spark: ignored (the reference's SparkSession slot).
+        """
+        self.settings = complete_settings_dict(settings)
+        self.params = Params(self.settings, complete=False)
+        self.df = df
+        self.df_l = df_l
+        self.df_r = df_r
+        self.save_state_fn = save_state_fn
+        self._check_args()
+
+        self._table: EncodedTable | None = None
+        self._pairs: PairIndex | None = None
+        self._G: np.ndarray | None = None
+
+    # ------------------------------------------------------------------
+
+    def _check_args(self):
+        link_type = self.settings["link_type"]
+        is_df = lambda x: pd is not None and isinstance(x, pd.DataFrame)  # noqa: E731
+        if link_type == "dedupe_only":
+            if not (is_df(self.df) and self.df_l is None and self.df_r is None):
+                raise ValueError(
+                    "For link_type = 'dedupe_only', pass a single DataFrame via "
+                    "df=; omit df_l and df_r. e.g. Splink(settings, df=my_df)"
+                )
+        else:
+            if not (is_df(self.df_l) and is_df(self.df_r) and self.df is None):
+                raise ValueError(
+                    f"For link_type = '{link_type}', pass two DataFrames via "
+                    "df_l= and df_r=; omit df. "
+                    "e.g. Splink(settings, df_l=first, df_r=second)"
+                )
+
+    # ------------------------------------------------------------------
+    # Pipeline stages
+    # ------------------------------------------------------------------
+
+    @property
+    def _n_left(self) -> int | None:
+        return None if self.settings["link_type"] == "dedupe_only" else len(self.df_l)
+
+    def _ensure_encoded(self) -> EncodedTable:
+        if self._table is None:
+            with StageTimer("encode"):
+                if self.settings["link_type"] == "dedupe_only":
+                    self._table = encode_table(self.df, self.settings)
+                else:
+                    self._table = concat_tables(self.df_l, self.df_r, self.settings)
+        return self._table
+
+    def _ensure_pairs(self) -> PairIndex:
+        if self._pairs is None:
+            table = self._ensure_encoded()
+            with StageTimer("blocking"):
+                self._pairs = block_using_rules(self.settings, table, self._n_left)
+            logger.info("blocking produced %d candidate pairs", self._pairs.n_pairs)
+        return self._pairs
+
+    def _ensure_gammas(self) -> np.ndarray:
+        if self._G is None:
+            table = self._ensure_encoded()
+            pairs = self._ensure_pairs()
+            with StageTimer("gammas"):
+                program = GammaProgram(self.settings, table)
+                self._G = program.compute(
+                    pairs.idx_l,
+                    pairs.idx_r,
+                    batch_size=self.settings["pair_batch_size"],
+                )
+        return self._G
+
+    # ------------------------------------------------------------------
+    # Public API (reference parity)
+    # ------------------------------------------------------------------
+
+    def manually_apply_fellegi_sunter_weights(self):
+        """Score using the m/u values in the settings, without running EM
+        (/root/reference/splink/__init__.py:111-119)."""
+        G = self._ensure_gammas()
+        return self._build_df_e(G)
+
+    def get_scored_comparisons(self, compute_ll: bool = False):
+        """Estimate parameters by EM and return scored comparisons
+        (/root/reference/splink/__init__.py:121-145)."""
+        G = self._ensure_gammas()
+        dtype = np.float64 if self.settings["float64"] else np.float32
+        lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
+
+        mesh = mesh_from_settings(self.settings)
+        weights = None
+        G_dev = jnp.asarray(G)
+        if mesh is not None:
+            G_dev, weights = shard_pairs(mesh, G)
+            weights = weights.astype(dtype)
+
+        init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
+        max_iterations = int(self.settings["max_iterations"])
+        em_kwargs = dict(
+            max_levels=self.params.max_levels,
+            em_convergence=self.settings["em_convergence"],
+            weights=weights,
+            compute_ll=compute_ll,
+        )
+
+        with StageTimer("em"):
+            if self.save_state_fn is None:
+                result = run_em(
+                    G_dev, init, max_iterations=max_iterations, **em_kwargs
+                )
+                self._replay_history(result, compute_ll)
+                converged = bool(result.converged)
+            else:
+                # Per-iteration checkpoint hook: step the fused EM one update
+                # at a time so save_state_fn really runs between iterations
+                # (the restart semantics of /root/reference/splink/iterate.py:54-55).
+                converged = False
+                params_dev = init
+                for _ in range(max_iterations):
+                    result = run_em(G_dev, params_dev, max_iterations=1, **em_kwargs)
+                    params_dev = result.params
+                    self._replay_history(result, compute_ll)
+                    self.save_state_fn(self.params, self.settings)
+                    if bool(result.converged):
+                        converged = True
+                        break
+        if converged:
+            logger.info("EM algorithm has converged")
+
+        return self._build_df_e(G)
+
+    def _replay_history(self, result, compute_ll: bool) -> None:
+        """Install a run_em result's device-side history into the Params
+        object so history, convergence logging, charts and save/load match
+        the reference's per-iteration bookkeeping."""
+        n_updates = int(result.n_updates)
+        ll_hist = np.asarray(result.ll_history)
+        for k in range(1, n_updates + 1):
+            if compute_ll:
+                self.params.params["log_likelihood"] = float(ll_hist[k - 1])
+            self.params.update_from_arrays(
+                float(result.lam_history[k]),
+                np.asarray(result.m_history[k]),
+                np.asarray(result.u_history[k]),
+            )
+        if compute_ll and n_updates >= 0:
+            self.params.params["log_likelihood"] = float(ll_hist[n_updates])
+            self.params.log_likelihood_exists = True
+
+    def make_term_frequency_adjustments(self, df_e):
+        """Ex-post term-frequency adjustment of scored comparisons
+        (/root/reference/splink/__init__.py:147-163)."""
+        from .term_frequencies import make_adjustment_for_term_frequencies
+
+        return make_adjustment_for_term_frequencies(
+            df_e,
+            self.params,
+            self.settings,
+            retain_adjustment_columns=True,
+        )
+
+    def save_model_as_json(self, path: str, overwrite: bool = False):
+        self.params.save_params_to_json_file(path, overwrite=overwrite)
+
+    # ------------------------------------------------------------------
+    # Output assembly
+    # ------------------------------------------------------------------
+
+    def _build_df_e(self, G: np.ndarray):
+        """Assemble the scored comparisons DataFrame with the reference's
+        column layout (/root/reference/splink/expectation_step.py:128-165)."""
+        table = self._ensure_encoded()
+        pairs = self._ensure_pairs()
+        settings = self.settings
+
+        dtype = np.float64 if settings["float64"] else np.float32
+        lam, m, u, _ = self.params.to_arrays(dtype=dtype)
+        with StageTimer("score"):
+            p, prob_m, prob_u = score_pairs_with_intermediates(
+                jnp.asarray(G),
+                FSParams(lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)),
+            )
+        p = np.asarray(p)
+        prob_m = np.asarray(prob_m)
+        prob_u = np.asarray(prob_u)
+
+        il, ir = pairs.idx_l, pairs.idx_r
+        uid = settings["unique_id_column_name"]
+        cols: dict[str, np.ndarray] = {"match_probability": p}
+
+        def add_lr(name, values):
+            cols.setdefault(f"{name}_l", values[il])
+            cols.setdefault(f"{name}_r", values[ir])
+
+        add_lr(uid, table.unique_id)
+        for c, col in enumerate(settings["comparison_columns"]):
+            name = comparison_column_name(col)
+            if "col_name" in col:
+                if settings["retain_matching_columns"] or col["term_frequency_adjustments"]:
+                    add_lr(name, table.column_values(name))
+            else:
+                if settings["retain_matching_columns"]:
+                    for used in col["custom_columns_used"]:
+                        add_lr(used, table.column_values(used))
+            cols[f"gamma_{name}"] = G[:, c].astype(np.int64)
+            if settings["retain_intermediate_calculation_columns"]:
+                cols[f"prob_gamma_{name}_non_match"] = prob_u[:, c]
+                cols[f"prob_gamma_{name}_match"] = prob_m[:, c]
+
+        if settings["link_type"] == "link_and_dedupe":
+            src = np.array(["left", "right"], dtype=object)[table.source_table]
+            add_lr("_source_table", src)
+        for extra in settings["additional_columns_to_retain"]:
+            add_lr(extra, table.column_values(extra))
+
+        return pd.DataFrame(cols)
+
+
+def load_from_json(
+    path: str,
+    df=None,
+    df_l=None,
+    df_r=None,
+    save_state_fn: Callable = None,
+    spark=None,
+):
+    """Load a model saved with save_model_as_json and return a ready linker
+    (/root/reference/splink/__init__.py:175-195)."""
+    params = load_params_from_json(path)
+    linker = Splink(
+        params.settings, df=df, df_l=df_l, df_r=df_r, save_state_fn=save_state_fn
+    )
+    linker.params = params
+    return linker

@@ -67,11 +67,22 @@ def _safe_log(x):
     return jnp.log(jnp.maximum(x, jnp.finfo(x.dtype).tiny))
 
 
+def _select_levels(G, table):
+    """(n, C) table[c, G[n, c]] via unrolled compare-and-mask (gather-free).
+
+    TPU gathers serialise badly; with max_levels <= ~4 a masked sum over the
+    static level axis is pure VPU work: out = sum_l table[:, l] * [G == l].
+    Entries where G = -1 come out as 0."""
+    L = table.shape[1]
+    out = jnp.zeros(G.shape, table.dtype)
+    for lv in range(L):
+        out = out + jnp.where(G == lv, table[None, :, lv], jnp.zeros((), table.dtype))
+    return out
+
+
 def gamma_log_probs(G, probs):
     """(n, C) log prob of each row's gamma level under `probs`; 0 where null."""
-    C = probs.shape[0]
-    levels = jnp.clip(G, 0).astype(jnp.int32)
-    lp = _safe_log(probs)[jnp.arange(C)[None, :], levels]
+    lp = _select_levels(G, _safe_log(probs))
     return jnp.where(G >= 0, lp, jnp.zeros((), lp.dtype))
 
 
@@ -94,9 +105,7 @@ def gamma_prob_lookup(G, probs):
 
     This is the reference's per-column prob_gamma_* lookup column
     (/root/reference/splink/expectation_step.py:196-221)."""
-    C = probs.shape[0]
-    levels = jnp.clip(G, 0).astype(jnp.int32)
-    p = probs[jnp.arange(C)[None, :], levels]
+    p = _select_levels(G, probs)
     return jnp.where(G >= 0, p, jnp.ones((), p.dtype))
 
 

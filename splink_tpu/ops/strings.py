@@ -40,6 +40,12 @@ def jaro_winkler_single(
     boost is applied unconditionally; set boost_threshold=0.7 for the
     original Winkler variant). Returns 0.0 when exactly one string is empty,
     1.0 when both are empty.
+
+    The greedy matching pass is sequential in the s1 index (shared used2
+    state), but every per-step operation is a dense (L,) vector op — the
+    "first eligible partner" is selected with a cumsum-based first-true mask
+    and consumed with a one-hot OR, never a scatter or argmax, so the vmapped
+    batch runs entirely on the VPU.
     """
     L = s1.shape[0]
     idx = jnp.arange(L)
@@ -48,34 +54,30 @@ def jaro_winkler_single(
     valid2 = idx < l2
     window = jnp.maximum(jnp.maximum(l1, l2) // 2 - 1, 0)
 
-    def body(i, carry):
-        used2, matched1 = carry
+    def step(used2, xs):
+        ch, i = xs
         cand = (
-            (s2 == s1[i])
-            & (jnp.abs(idx - i) <= window)
-            & valid2
-            & (~used2)
-            & (i < l1)
+            (s2 == ch) & (jnp.abs(idx - i) <= window) & valid2 & (~used2) & (i < l1)
         )
-        j = jnp.argmax(cand)  # first eligible partner in s2
-        found = cand[j]
-        used2 = used2.at[j].set(used2[j] | found)
-        matched1 = matched1.at[i].set(found)
-        return used2, matched1
+        first = cand & (jnp.cumsum(cand) == 1)  # one-hot of first eligible j
+        return used2 | first, first.any()
 
-    used2, matched1 = lax.fori_loop(
-        0, L, body, (jnp.zeros(L, bool), jnp.zeros(L, bool))
+    used2, matched1 = lax.scan(
+        step, jnp.zeros(L, bool), (s1, jnp.arange(L, dtype=jnp.int32))
     )
     m = jnp.sum(matched1).astype(jnp.int32)
 
-    # Compact the matched characters of each string, preserving order, into
-    # the first m slots of an (L+1,) buffer; unmatched chars all land in the
-    # spare final slot which the comparison mask below never reads.
-    pos1 = jnp.where(matched1, jnp.cumsum(matched1) - 1, L)
-    seq1 = jnp.zeros(L + 1, s1.dtype).at[pos1].set(jnp.where(matched1, s1, 0))
-    pos2 = jnp.where(used2, jnp.cumsum(used2) - 1, L)
-    seq2 = jnp.zeros(L + 1, s2.dtype).at[pos2].set(jnp.where(used2, s2, 0))
-    in_match = jnp.arange(L + 1) < m
+    # Order-preserving compaction of each side's matched characters via a
+    # rank-indicator matmul (MXU work, no scatters): seq[k] = sum_i
+    # s[i] * [rank(i) == k], rank = prefix count of matches.
+    def compact(s, matched):
+        rank = jnp.cumsum(matched) - 1
+        ind = (rank[:, None] == idx[None, :]) & matched[:, None]  # (L, L)
+        return (s.astype(jnp.float32) * matched) @ ind.astype(jnp.float32)
+
+    seq1 = compact(s1, matched1)
+    seq2 = compact(s2, used2)
+    in_match = idx < m
     half_transpositions = jnp.sum((seq1 != seq2) & in_match)
 
     mf = _f(m)

@@ -1,0 +1,116 @@
+"""Ex-post term-frequency adjustment of match scores.
+
+Implements the same formulas as the reference
+(/root/reference/splink/term_frequencies.py, after moj-analytical-services
+issue #17): for each flagged column, pairs that AGREE on a token get a
+token-specific lambda (mean match probability among agreeing pairs),
+Bayes-combined with (1 - lambda); disagreeing or null pairs are neutral
+(0.5); the final ``tf_adjusted_match_prob`` Bayes-combines the base match
+probability with every column adjustment.
+
+The aggregation is a segment mean over token ids — tiny relative to scoring —
+so it runs host-side on the scored frame; the result is a per-token lookup
+(the analogue of the reference's BROADCAST join lookup tables,
+/root/reference/splink/term_frequencies.py:84-86).
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from .params import Params
+
+
+def bayes_combine(probs: list[np.ndarray]) -> np.ndarray:
+    """prod(p) / (prod(p) + prod(1-p)) — the reference's sql_gen_bayes_string
+    (/root/reference/splink/term_frequencies.py:21-46)."""
+    num = np.ones_like(np.asarray(probs[0], dtype=np.float64))
+    den = np.ones_like(num)
+    for p in probs:
+        p = np.asarray(p, dtype=np.float64)
+        num = num * p
+        den = den * (1.0 - p)
+    return num / (num + den)
+
+
+def compute_token_adjustment(values_l, values_r, match_probability, base_lambda):
+    """Per-pair adjustment for one column.
+
+    Returns (adj, lookup) where adj is 0.5 for pairs that disagree or are
+    null, else the token's Bayes-adjusted lambda; lookup maps token value ->
+    adjusted lambda (for diagnostics).
+    """
+    import pandas as pd
+
+    values_l = np.asarray(values_l, dtype=object)
+    values_r = np.asarray(values_r, dtype=object)
+    p = np.asarray(match_probability, dtype=np.float64)
+
+    agree = np.array(
+        [
+            (a is not None and not pd.isna(a)) and a == b
+            for a, b in zip(values_l, values_r)
+        ]
+    )
+    adj = np.full(len(p), 0.5)
+    if not agree.any():
+        return adj, {}
+
+    s = pd.Series(p[agree])
+    keys = pd.Series(values_l[agree])
+    adj_lambda = s.groupby(keys, sort=False).mean()
+    # Bayes-combine each token lambda with (1 - base lambda)
+    # (/root/reference/splink/term_frequencies.py:60)
+    adjusted = bayes_combine(
+        [adj_lambda.to_numpy(), np.full(len(adj_lambda), 1.0 - base_lambda)]
+    )
+    lookup = dict(zip(adj_lambda.index, adjusted))
+    adj[agree] = keys.map(lookup).to_numpy(dtype=np.float64)
+    return adj, lookup
+
+
+def make_adjustment_for_term_frequencies(
+    df_e,
+    params: Params,
+    settings: dict,
+    retain_adjustment_columns: bool = False,
+):
+    """Add ``tf_adjusted_match_prob`` to a scored comparisons frame."""
+    tf_cols = [
+        c["col_name"]
+        for c in settings["comparison_columns"]
+        if c.get("term_frequency_adjustments")
+    ]
+    if not tf_cols:
+        warnings.warn(
+            "No term frequency adjustment columns are specified in your "
+            "settings object. Returning original df"
+        )
+        return df_e
+
+    df = df_e.copy()
+    base_lambda = params.params["λ"]
+    adj_arrays = []
+    for col in tf_cols:
+        adj, _ = compute_token_adjustment(
+            df[f"{col}_l"].to_numpy(dtype=object),
+            df[f"{col}_r"].to_numpy(dtype=object),
+            df["match_probability"].to_numpy(),
+            base_lambda,
+        )
+        df[f"{col}_adj"] = adj
+        adj_arrays.append(adj)
+
+    df["tf_adjusted_match_prob"] = bayes_combine(
+        [df["match_probability"].to_numpy()] + adj_arrays
+    )
+    if not retain_adjustment_columns:
+        df = df.drop(columns=[f"{c}_adj" for c in tf_cols])
+
+    # Column order: tf_adjusted_match_prob leads, as in the reference
+    # (/root/reference/splink/term_frequencies.py:108-115).
+    lead = ["tf_adjusted_match_prob", "match_probability"]
+    rest = [c for c in df.columns if c not in lead]
+    return df[lead + rest]

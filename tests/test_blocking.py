@@ -1,0 +1,231 @@
+"""Blocking semantics vs a brute-force oracle.
+
+Pins the behaviours from the reference's blocking tests
+(/root/reference/tests/test_blocks.py, test_link_options.py): null keys never
+join, sequential rules are deduplicated with null-safe NOT semantics, the
+three link types orient pairs correctly, and the cartesian fallback covers
+everything.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu.blocking import PairIndex, block_using_rules, cartesian_block
+from splink_tpu.comparison_evaluation import get_largest_blocks
+from splink_tpu.data import encode_table
+from splink_tpu.settings import complete_settings_dict
+
+
+def _settings(rules, link_type="dedupe_only", extra_cols=()):
+    cols = [{"col_name": "first_name"}, {"col_name": "surname"}]
+    cols += [{"col_name": c} for c in extra_cols]
+    s = {
+        "link_type": link_type,
+        "comparison_columns": cols,
+        "blocking_rules": list(rules),
+    }
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return complete_settings_dict(s)
+
+
+def _pairs_set(pairs: PairIndex, table):
+    uid = table.unique_id
+    return {(uid[i], uid[j]) for i, j in zip(pairs.idx_l, pairs.idx_r)}
+
+
+def brute_force_dedupe(df, rules):
+    """Oracle: evaluate the reference SQL semantics row-pair by row-pair."""
+    out = set()
+    rows = df.to_dict("records")
+    for a in rows:
+        for b in rows:
+            if not (a["unique_id"] < b["unique_id"]):
+                continue
+            satisfied = [_rule_holds(rule, a, b) for rule in rules]
+            for k, sat in enumerate(satisfied):
+                if sat and not any(satisfied[:k]):
+                    out.add((a["unique_id"], b["unique_id"]))
+                    break
+    return out
+
+
+def _rule_holds(rule, a, b):
+    # only equality conjunctions used in oracle tests
+    import re
+
+    for term in re.split(r"(?i)\s+and\s+", rule):
+        m = re.match(r"\s*l\.(\w+)\s*=\s*r\.(\w+)\s*", term)
+        lv, rv = a[m.group(1)], b[m.group(2)]
+        if pd.isna(lv) or pd.isna(rv) or lv != rv:
+            return False
+    return True
+
+
+@pytest.fixture
+def df():
+    return pd.DataFrame(
+        {
+            "unique_id": [0, 1, 2, 3, 4, 5, 6],
+            "first_name": ["john", "john", "mary", None, "mary", "bob", "john"],
+            "surname": ["smith", "smith", "jones", "jones", None, "brown", "jones"],
+            "dob": ["1990", "1990", "1985", "1985", "1985", "1970", "1990"],
+        }
+    )
+
+
+def test_single_rule_matches_oracle(df):
+    rules = ["l.first_name = r.first_name"]
+    s = _settings(rules, extra_cols=["dob"])
+    table = encode_table(df, s)
+    got = _pairs_set(block_using_rules(s, table), table)
+    assert got == brute_force_dedupe(df, rules)
+    # nulls never join: row 3 (first_name None) appears in no pair
+    assert not any(3 in p for p in got)
+
+
+def test_multi_rule_sequential_dedup(df):
+    rules = ["l.first_name = r.first_name", "l.dob = r.dob"]
+    s = _settings(rules, extra_cols=["dob"])
+    table = encode_table(df, s)
+    got = _pairs_set(block_using_rules(s, table), table)
+    want = brute_force_dedupe(df, rules)
+    assert got == want
+    # null-safety of NOT(previous): pair (2,3) fails rule 1 only via null,
+    # but satisfies rule 2 -> must be present
+    assert (2, 3) in got
+
+
+def test_conjunction_rule(df):
+    rules = ["l.first_name = r.first_name AND l.surname = r.surname"]
+    s = _settings(rules)
+    table = encode_table(df, s)
+    got = _pairs_set(block_using_rules(s, table), table)
+    assert got == brute_force_dedupe(df, rules) == {(0, 1)}
+
+
+def test_no_duplicate_pairs_across_rules(df):
+    rules = ["l.dob = r.dob", "l.first_name = r.first_name"]
+    s = _settings(rules, extra_cols=["dob"])
+    table = encode_table(df, s)
+    pairs = block_using_rules(s, table)
+    packed = pairs.idx_l * table.n_rows + pairs.idx_r
+    assert len(np.unique(packed)) == len(packed)
+
+
+def test_dedupe_orientation_uid_ordering(df):
+    s = _settings(["l.dob = r.dob"], extra_cols=["dob"])
+    table = encode_table(df, s)
+    pairs = block_using_rules(s, table)
+    uid = table.unique_id
+    assert (uid[pairs.idx_l] < uid[pairs.idx_r]).all()
+
+
+def test_link_only_crosses_tables_only():
+    df_l = pd.DataFrame(
+        {"unique_id": [0, 1], "first_name": ["john", "mary"], "surname": ["a", "b"]}
+    )
+    df_r = pd.DataFrame(
+        {"unique_id": [0, 1, 2], "first_name": ["john", "john", "zoe"], "surname": ["c", "d", "e"]}
+    )
+    s = _settings(["l.first_name = r.first_name"], link_type="link_only")
+    combined = pd.concat([df_l, df_r], ignore_index=True)
+    src = np.array([0, 0, 1, 1, 1], np.int8)
+    table = encode_table(combined, s, source_table=src)
+    pairs = block_using_rules(s, table, n_left=2)
+    # l side strictly from left table, r side strictly from right table
+    assert (pairs.idx_l < 2).all() and (pairs.idx_r >= 2).all()
+    got = {(int(i), int(j)) for i, j in zip(pairs.idx_l, pairs.idx_r)}
+    assert got == {(0, 2), (0, 3)}
+
+
+def test_link_and_dedupe_includes_within_and_across():
+    df_l = pd.DataFrame({"unique_id": [0, 1], "first_name": ["john", "john"], "surname": ["a", "b"]})
+    df_r = pd.DataFrame({"unique_id": [0], "first_name": ["john"], "surname": ["c"]})
+    s = _settings(["l.first_name = r.first_name"], link_type="link_and_dedupe")
+    combined = pd.concat([df_l, df_r], ignore_index=True)
+    src = np.array([0, 0, 1], np.int8)
+    table = encode_table(combined, s, source_table=src)
+    pairs = block_using_rules(s, table, n_left=2)
+    got = {(int(i), int(j)) for i, j in zip(pairs.idx_l, pairs.idx_r)}
+    # rows 0,1 from left, row 2 from right: all three pairs, left side first
+    assert got == {(0, 1), (0, 2), (1, 2)}
+    st = table.source_table
+    uid = table.unique_id
+    for i, j in got:
+        assert (st[i], uid[i]) < (st[j], uid[j])
+
+
+def test_cartesian_fallback(df):
+    s = _settings([])
+    table = encode_table(df, s)
+    pairs = cartesian_block(s, table)
+    n = len(df)
+    assert pairs.n_pairs == n * (n - 1) // 2
+
+
+def test_rule_with_residual_predicate():
+    df = pd.DataFrame(
+        {
+            "unique_id": [0, 1, 2, 3],
+            "first_name": ["ann", "ann", "ann", "ann"],
+            "surname": ["x", "x", "x", "x"],
+            "age": [10, 12, 40, None],
+        }
+    )
+    s = _settings(
+        ["l.first_name = r.first_name and l.age < r.age and r.age < 30"],
+        extra_cols=[],
+    )
+    # age referenced only in the rule -> retained as raw column
+    table = encode_table(df, s)
+    pairs = block_using_rules(s, table)
+    got = {(int(i), int(j)) for i, j in zip(pairs.idx_l, pairs.idx_r)}
+    # oriented by uid; predicate l.age < r.age < 30 holds only for (0,1);
+    # null age (row 3) joins nothing
+    assert got == {(0, 1)}
+
+
+def test_get_largest_blocks(df):
+    out = get_largest_blocks("l.dob = r.dob", df)
+    assert out.iloc[0]["dob"] in ("1990", "1985")
+    assert out.iloc[0]["count"] == 3
+    assert list(out["count"]) == sorted(out["count"], reverse=True)
+
+
+def test_cross_column_equality_rule():
+    # l.a = r.b joins different key vocabularies: must filter, not degrade to
+    # a cartesian product
+    df = pd.DataFrame(
+        {
+            "unique_id": [0, 1, 2, 3],
+            "first_name": ["smith", "ann", "bob", "cat"],
+            "surname": ["x", "smith", "y", "z"],
+        }
+    )
+    s = _settings(["l.first_name = r.surname"])
+    table = encode_table(df, s)
+    pairs = block_using_rules(s, table)
+    got = {(int(i), int(j)) for i, j in zip(pairs.idx_l, pairs.idx_r)}
+    # only first_name[0]='smith' == surname[1]='smith'; orientation uid 0 < 1
+    assert got == {(0, 1)}
+
+
+def test_mixed_same_and_cross_column_rule():
+    df = pd.DataFrame(
+        {
+            "unique_id": [0, 1, 2],
+            "first_name": ["ann", "ann", "ann"],
+            "surname": ["ann", "ann", "zzz"],
+        }
+    )
+    s = _settings(["l.first_name = r.first_name AND l.first_name = r.surname"])
+    table = encode_table(df, s)
+    pairs = block_using_rules(s, table)
+    got = {(int(i), int(j)) for i, j in zip(pairs.idx_l, pairs.idx_r)}
+    # all share first_name; cross condition l.first_name == r.surname keeps
+    # pairs whose r side has surname 'ann' -> r in {0,1}
+    assert got == {(0, 1)}

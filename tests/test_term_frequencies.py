@@ -1,0 +1,144 @@
+"""Term-frequency adjustment formulas vs hand computation
+(reference: /root/reference/splink/term_frequencies.py, tests
+/root/reference/tests/test_term_frequencies.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu.params import Params
+from splink_tpu.term_frequencies import (
+    bayes_combine,
+    compute_token_adjustment,
+    make_adjustment_for_term_frequencies,
+)
+
+
+def test_bayes_combine_formula():
+    # p1*p2 / (p1*p2 + (1-p1)(1-p2))
+    got = bayes_combine([np.array([0.9]), np.array([0.3])])
+    want = 0.9 * 0.3 / (0.9 * 0.3 + 0.1 * 0.7)
+    assert got[0] == pytest.approx(want, rel=1e-12)
+    # 0.5 is neutral
+    got = bayes_combine([np.array([0.7]), np.array([0.5])])
+    assert got[0] == pytest.approx(0.7, rel=1e-12)
+
+
+def test_token_adjustment_hand_case():
+    # Two tokens: "smith" (common, low evidential value) and "zorro" (rare).
+    values_l = np.array(["smith", "smith", "zorro", "smith", None], dtype=object)
+    values_r = np.array(["smith", "smith", "zorro", "jones", "x"], dtype=object)
+    p = np.array([0.2, 0.4, 0.9, 0.99, 0.5])
+    lam = 0.3
+    adj, lookup = compute_token_adjustment(values_l, values_r, p, lam)
+
+    # smith: adj_lambda = mean(0.2, 0.4) = 0.3; bayes with 1-lam = 0.7:
+    want_smith = 0.3 * 0.7 / (0.3 * 0.7 + 0.7 * 0.3)  # = 0.5
+    assert lookup["smith"] == pytest.approx(want_smith, rel=1e-12)
+    # zorro: adj_lambda = 0.9
+    want_zorro = 0.9 * 0.7 / (0.9 * 0.7 + 0.1 * 0.3)
+    assert lookup["zorro"] == pytest.approx(want_zorro, rel=1e-12)
+    np.testing.assert_allclose(adj, [want_smith, want_smith, want_zorro, 0.5, 0.5])
+
+
+def _params():
+    return Params(
+        {
+            "link_type": "dedupe_only",
+            "proportion_of_matches": 0.3,
+            "comparison_columns": [
+                {"col_name": "name", "term_frequency_adjustments": True}
+            ],
+            "blocking_rules": ["l.name = r.name"],
+        }
+    )
+
+
+def test_make_adjustment_end_to_end():
+    params = _params()
+    df_e = pd.DataFrame(
+        {
+            "match_probability": [0.8, 0.6, 0.9, 0.2],
+            "name_l": ["ann", "ann", "bo", "ann"],
+            "name_r": ["ann", "ann", "bo", "cat"],
+        }
+    )
+    out = make_adjustment_for_term_frequencies(
+        df_e, params, params.settings, retain_adjustment_columns=True
+    )
+    assert out.columns[0] == "tf_adjusted_match_prob"
+    assert "name_adj" in out.columns
+    lam = 0.3
+    ann_lambda = (0.8 + 0.6) / 2
+    ann_adj = ann_lambda * (1 - lam) / (ann_lambda * (1 - lam) + (1 - ann_lambda) * lam)
+    # row 0: combine(0.8, ann_adj)
+    want0 = 0.8 * ann_adj / (0.8 * ann_adj + 0.2 * (1 - ann_adj))
+    assert out.tf_adjusted_match_prob.iloc[0] == pytest.approx(want0, rel=1e-10)
+    # disagreeing pair is neutral: tf_adjusted == match_probability
+    assert out.tf_adjusted_match_prob.iloc[3] == pytest.approx(0.2, rel=1e-10)
+
+
+def test_no_tf_columns_warns_and_passes_through():
+    params = Params(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [{"col_name": "name"}],
+            "blocking_rules": ["l.name = r.name"],
+        }
+    )
+    df_e = pd.DataFrame({"match_probability": [0.5]})
+    with pytest.warns(UserWarning, match="No term frequency"):
+        out = make_adjustment_for_term_frequencies(df_e, params, params.settings)
+    assert out is df_e
+
+
+def test_linker_tf_integration():
+    from splink_tpu import Splink
+
+    rng = np.random.default_rng(0)
+    common = ["smith"] * 30
+    rare = ["zorro"] * 2
+    names = common + rare
+    df = pd.DataFrame(
+        {
+            "unique_id": range(len(names)),
+            "name": names,
+            "dob": rng.choice(["a", "b"], len(names)),
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "name", "term_frequency_adjustments": True, "comparison": {"kind": "exact"}},
+            {"col_name": "dob", "comparison": {"kind": "exact"}},
+        ],
+        "blocking_rules": [],
+        "max_iterations": 3,
+    }
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        linker = Splink(s, df=df)
+        df_e = linker.get_scored_comparisons()
+        out = linker.make_term_frequency_adjustments(df_e)
+    assert "tf_adjusted_match_prob" in out.columns
+    # mechanical consistency: tf_adjusted == bayes(match_probability, name_adj)
+    from splink_tpu.term_frequencies import bayes_combine
+
+    want = bayes_combine(
+        [out.match_probability.to_numpy(), out.name_adj.to_numpy()]
+    )
+    np.testing.assert_allclose(out.tf_adjusted_match_prob.to_numpy(), want, rtol=1e-9)
+    # disagreeing pairs are neutral (adj exactly 0.5)
+    disagree = out[out.name_l != out.name_r]
+    assert (disagree.name_adj == 0.5).all()
+    # agreeing pairs on a token carry that token's adjusted lambda, which is
+    # the Bayes combination of the token's mean match probability with 1-λ
+    lam = linker.params.params["λ"]
+    smith = out[(out.name_l == "smith") & (out.name_r == "smith")]
+    adj_lambda = smith.match_probability.mean()
+    want_adj = (adj_lambda * (1 - lam)) / (
+        adj_lambda * (1 - lam) + (1 - adj_lambda) * lam
+    )
+    np.testing.assert_allclose(smith.name_adj.to_numpy(), want_adj, rtol=1e-6)
