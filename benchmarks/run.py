@@ -1,0 +1,232 @@
+"""Benchmark runner for the five BASELINE.md configs.
+
+Usage: python benchmarks/run.py --config N [--scale F]
+
+Each config prints one JSON line with end-to-end wall-clock, pairs scored,
+throughput and EM statistics, plus a simple match-quality check against the
+generator's ground-truth clusters. --scale shrinks row counts for smoke runs
+(e.g. --scale 0.01 for config 4 runs 100k rows instead of 10M).
+
+Configs (BASELINE.json):
+  1. FEBRL-style 1k dedupe, 2 exact-match columns
+  2. FEBRL-style 10k dedupe, jaro-winkler on first_name/surname
+  3. 1M x 1M link_only, one blocking rule + term-frequency adjustment
+  4. 10M dedupe, 3 blocking rules / 6 comparison columns, full jit EM
+  5. 100M-pair-scale dedupe, streamed gamma batches + streaming EM
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo root
+from benchmarks.datagen import make_people, split_for_linking  # noqa: E402
+
+
+def _quality(df_e, threshold=0.8):
+    """Precision/recall of predicted matches vs generator clusters."""
+    if "cluster_l" not in df_e.columns or not len(df_e):
+        return {}
+    pred = df_e.match_probability >= threshold
+    truth = df_e.cluster_l == df_e.cluster_r
+    tp = int((pred & truth).sum())
+    return {
+        "pairs_truth": int(truth.sum()),
+        "precision": round(tp / max(int(pred.sum()), 1), 4),
+        "recall_blocked": round(tp / max(int(truth.sum()), 1), 4),
+    }
+
+
+def _run_linker(settings, t0, **inputs):
+    from splink_tpu import Splink
+
+    linker = Splink(settings, **inputs)
+    df_e = linker.get_scored_comparisons()
+    elapsed = time.perf_counter() - t0
+    out = {
+        "rows": sum(len(v) for v in inputs.values()),
+        "pairs": len(df_e),
+        "seconds": round(elapsed, 3),
+        "pairs_per_sec": round(len(df_e) / elapsed),
+        "em_iterations": len(linker.params.param_history),
+        "lambda": round(linker.params.params["λ"], 5),
+    }
+    out.update(_quality(df_e))
+    return linker, df_e, out
+
+
+def config_1(scale):
+    n = max(int(1000 * scale), 100)
+    df = make_people(n, seed=1)
+    t0 = time.perf_counter()
+    settings = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "comparison": {"kind": "exact"}},
+            {"col_name": "surname", "comparison": {"kind": "exact"}},
+        ],
+        "blocking_rules": ["l.dob = r.dob"],
+        "additional_columns_to_retain": ["cluster"],
+    }
+    _, _, out = _run_linker(settings, t0, df=df)
+    return out
+
+
+def config_2(scale):
+    n = max(int(10_000 * scale), 100)
+    df = make_people(n, seed=2)
+    t0 = time.perf_counter()
+    settings = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {"col_name": "surname", "num_levels": 3},
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.postcode = r.postcode"],
+        "additional_columns_to_retain": ["cluster"],
+    }
+    _, _, out = _run_linker(settings, t0, df=df)
+    return out
+
+
+def config_3(scale):
+    n = max(int(1_000_000 * scale), 1000)
+    df = make_people(n, duplicate_rate=0.5, seed=3)
+    df_l, df_r = split_for_linking(df)
+    t0 = time.perf_counter()
+    settings = {
+        "link_type": "link_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3,
+             "term_frequency_adjustments": True},
+            {"col_name": "surname", "num_levels": 3},
+            {"col_name": "city", "comparison": {"kind": "exact"}},
+        ],
+        "blocking_rules": ["l.dob = r.dob"],
+        "additional_columns_to_retain": ["cluster"],
+    }
+    linker, df_e, out = _run_linker(settings, t0, df_l=df_l, df_r=df_r)
+    t1 = time.perf_counter()
+    linker.make_term_frequency_adjustments(df_e)
+    out["tf_seconds"] = round(time.perf_counter() - t1, 3)
+    return out
+
+
+def config_4(scale):
+    n = max(int(10_000_000 * scale), 1000)
+    df = make_people(n, seed=4)
+    t0 = time.perf_counter()
+    settings = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {"col_name": "surname", "num_levels": 3},
+            {"col_name": "dob", "comparison": {"kind": "exact"}},
+            {"col_name": "city", "comparison": {"kind": "exact"}},
+            {"col_name": "postcode", "num_levels": 2},
+            {"custom_name": "surname_qgram", "custom_columns_used": ["surname"],
+             "num_levels": 2,
+             "comparison": {"kind": "qgram_jaccard", "column": "surname",
+                            "thresholds": [0.6]}},
+        ],
+        "blocking_rules": [
+            "l.dob = r.dob",
+            "l.postcode = r.postcode AND l.surname = r.surname",
+            "l.first_name = r.first_name AND l.city = r.city",
+        ],
+        "retain_matching_columns": False,
+        "retain_intermediate_calculation_columns": False,
+        "additional_columns_to_retain": ["cluster"],
+    }
+    _, _, out = _run_linker(settings, t0, df=df)
+    return out
+
+
+def config_5(scale):
+    """Streamed EM: gamma batches too large to keep as one resident array."""
+    import jax.numpy as jnp
+
+    from splink_tpu.blocking import block_using_rules
+    from splink_tpu.data import encode_table
+    from splink_tpu.em import score_pairs
+    from splink_tpu.gammas import GammaProgram
+    from splink_tpu.models.fellegi_sunter import FSParams
+    from splink_tpu.parallel.streaming import run_em_streamed
+    from splink_tpu.params import Params
+    from splink_tpu.settings import complete_settings_dict
+
+    n = max(int(20_000_000 * scale), 1000)  # pair count scales with blocking density
+    df = make_people(n, seed=5)
+    settings = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {"col_name": "first_name", "num_levels": 3},
+                {"col_name": "surname", "num_levels": 3},
+                {"col_name": "city", "comparison": {"kind": "exact"}},
+            ],
+            "blocking_rules": ["l.dob = r.dob", "l.postcode = r.postcode"],
+        }
+    )
+    t0 = time.perf_counter()
+    table = encode_table(df, settings)
+    pairs = block_using_rules(settings, table)
+    program = GammaProgram(settings, table)
+    params = Params(settings, complete=False)
+    lam0, m0, u0, _ = params.to_arrays(dtype=np.float32)
+    init = FSParams(jnp.asarray(lam0), jnp.asarray(m0), jnp.asarray(u0))
+
+    batch = 1 << 20
+
+    def batches():
+        for s in range(0, pairs.n_pairs, batch):
+            yield program.compute(
+                pairs.idx_l[s : s + batch], pairs.idx_r[s : s + batch]
+            )
+
+    final, hist, n_updates, converged = run_em_streamed(
+        batches,
+        init,
+        max_iterations=int(settings["max_iterations"]),
+        max_levels=3,
+        em_convergence=settings["em_convergence"],
+    )
+    # final scoring pass, streamed
+    scored = 0
+    for G in batches():
+        p = score_pairs(jnp.asarray(G), final)
+        scored += len(p)
+    elapsed = time.perf_counter() - t0
+    return {
+        "rows": len(df),
+        "pairs": pairs.n_pairs,
+        "seconds": round(elapsed, 3),
+        "pairs_per_sec": round(scored / elapsed),
+        "em_iterations": n_updates,
+        "converged": converged,
+        "lambda": round(float(final.lam), 5),
+        "streamed": True,
+    }
+
+
+CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, required=True, choices=sorted(CONFIGS))
+    ap.add_argument("--scale", type=float, default=1.0)
+    args = ap.parse_args()
+    out = CONFIGS[args.config](args.scale)
+    out["config"] = args.config
+    out["scale"] = args.scale
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,17 +41,27 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
     """
     s = _normalise(expr).lower()
 
+    if "jaro_winkler_sim" in s and "ifnull" in s:
+        # The reference's name-inversion generator
+        # (/root/reference/splink/case_statements.py:254-277): an OR-list of
+        # jw(col_l, ifnull(other_r, ...)) terms at level 2.
+        spec = _parse_name_inversion(s)
+        if spec is not None:
+            return spec
+
     if "jaro_winkler_sim" in s:
         pairs = re.findall(rf"jaro_winkler_sim\([^)]*\)\s*>\s*{_NUM}\s*then\s*(\d+)", s)
         if pairs:
+            _check_level_coverage(expr, pairs, num_levels)
             by_level = sorted(pairs, key=lambda p: -int(p[1]))
             return {"kind": "jaro_winkler", "thresholds": [float(t) for t, _ in by_level]}
 
     if "levenshtein" in s:
         pairs = re.findall(rf"<=\s*{_NUM}\s*then\s*(\d+)", s)
         if pairs:
-            by_level = sorted(pairs, key=lambda p: -int(p[1]))
-            return {"kind": "levenshtein", "thresholds": [float(t) for t, _ in by_level]}
+            return {"kind": "levenshtein", "thresholds": [
+                float(t) for t, _ in sorted(pairs, key=lambda p: -int(p[1]))
+            ]}
 
     if re.search(r"abs\(", s) and "/" in s:
         pairs = re.findall(rf"<\s*{_NUM}\s*then\s*(\d+)", s)
@@ -75,6 +85,34 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
         '{"comparison": {"kind": "jaro_winkler", "thresholds": [0.94, 0.88]}} '
         "or register a custom comparison with splink_tpu.register_comparison()."
     )
+
+
+def _check_level_coverage(expr: str, pairs, num_levels: int) -> None:
+    """Every level 1..num_levels-1 must be gated by an extracted threshold;
+    a partial extraction means an unrecognised CASE shape and silent
+    mistranslation, so raise instead."""
+    levels = {int(lv) for _, lv in pairs}
+    if levels != set(range(1, num_levels)):
+        raise SqlTranslationError(
+            f"case_expression gates levels {sorted(levels)} but num_levels="
+            f"{num_levels} requires levels {list(range(1, num_levels))}; this "
+            f"CASE shape is not fully recognised: {expr!r}. Provide a native "
+            "'comparison' spec instead."
+        )
+
+
+def _parse_name_inversion(s: str) -> dict | None:
+    main = re.search(rf"jaro_winkler_sim\((\w+)_l,\s*\1_r\)\s*>\s*{_NUM}\s*then\s*3", s)
+    low = re.search(rf"jaro_winkler_sim\((\w+)_l,\s*\1_r\)\s*>\s*{_NUM}\s*then\s*1", s)
+    others = re.findall(r"ifnull\((\w+)_r", s)
+    if not (main and low and others):
+        return None
+    return {
+        "kind": "name_inversion",
+        "column": main.group(1),
+        "other_columns": sorted(set(others)),
+        "thresholds": [float(main.group(2)), float(low.group(2))],
+    }
 
 
 # --------------------------------------------------------------------------
@@ -142,6 +180,10 @@ def sql_predicate_to_python(pred: str) -> str:
     parenthesised during translation to preserve SQL precedence.
     """
     s = _normalise(pred)
+    # Substitute IS [NOT] NULL before tokenising — its NOT must not be taken
+    # as a boolean operator.
+    s = re.sub(r"(?i)\bis\s+not\s+null\b", " __ISNOTNULL__", s)
+    s = re.sub(r"(?i)\bis\s+null\b", " __ISNULL__", s)
     # Tokenise into atoms / boolean operators / parens, so each atom can be
     # parenthesised independently.
     parts = re.split(r"(?i)(\(|\)|\band\b|\bor\b|\bnot\b)", s)
@@ -166,9 +208,7 @@ def sql_predicate_to_python(pred: str) -> str:
 
 def _translate_atom(atom: str) -> str:
     """Translate one comparison atom (no boolean operators) to Python."""
-    s = re.sub(r"(?i)\bis\s+not\s+null\b", " __ISNOTNULL__", atom)
-    s = re.sub(r"(?i)\bis\s+null\b", " __ISNULL__", s)
-    s = re.sub(r"\bl\.(\w+)", r'l["\1"]', s)
+    s = re.sub(r"\bl\.(\w+)", r'l["\1"]', atom)
     s = re.sub(r"\br\.(\w+)", r'r["\1"]', s)
     s = re.sub(r"(?<![<>!=])=(?!=)", "==", s)
     s = s.replace("<>", "!=")

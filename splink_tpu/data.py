@@ -107,20 +107,26 @@ def encode_string_column(values, width: int = DEFAULT_STRING_WIDTH) -> EncodedSt
     max_len = max((len(str(v)) for v in obj if v is not None), default=1)
     width = min(_pad_width(max_len), _pad_width(width))
     ascii_only = all(v is None or str(v).isascii() for v in obj)
-    dtype = np.uint8 if ascii_only else np.uint32
-    bytes_ = np.zeros((n, width), dtype=dtype)
-    lengths = np.zeros(n, dtype=np.int32)
-    for i, v in enumerate(obj):
-        if v is None:
-            continue
-        chars = str(v)[:width]
-        if ascii_only:
-            bytes_[i, : len(chars)] = np.frombuffer(chars.encode(), dtype=np.uint8)
-        else:
+    if ascii_only:
+        # flat buffer + offsets, packed by the native kernel when available
+        from . import native
+
+        strs = ["" if v is None else str(v) for v in obj]
+        flat = np.frombuffer("".join(strs).encode("ascii"), dtype=np.uint8)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(s) for s in strs], out=offsets[1:])
+        bytes_, lengths = native.encode_fixed_width(flat, offsets, width)
+    else:
+        bytes_ = np.zeros((n, width), dtype=np.uint32)
+        lengths = np.zeros(n, dtype=np.int32)
+        for i, v in enumerate(obj):
+            if v is None:
+                continue
+            chars = str(v)[:width]
             bytes_[i, : len(chars)] = np.array(
                 [ord(c) for c in chars], dtype=np.uint32
             )
-        lengths[i] = len(chars)
+            lengths[i] = len(chars)
 
     codes, _ = pd.factorize(pd.Series([None if v is None else str(v) for v in obj]))
     token_ids = codes.astype(np.int32)  # pandas gives -1 for null already

@@ -61,6 +61,8 @@ class PairColumn:
     num_l: jnp.ndarray | None = None  # (b,) float
     num_r: jnp.ndarray | None = None
     null: jnp.ndarray | None = None  # (b,) bool: either side null
+    null_l: jnp.ndarray | None = None  # (b,) bool: left side null
+    null_r: jnp.ndarray | None = None  # (b,) bool: right side null
 
 
 class PairContext:
@@ -86,8 +88,18 @@ class PairContext:
             out.num_l = src["values"][il]
             out.num_r = src["values"][ir]
         null = src["null"]
-        out.null = null[il] | null[ir]
+        out.null_l = null[il]
+        out.null_r = null[ir]
+        out.null = out.null_l | out.null_r
         return out
+
+
+def _pad_chars(chars, width: int):
+    """Zero-pad a (b, w) char array to (b, width) and unify the dtype."""
+    out = chars.astype(jnp.uint32) if chars.dtype != jnp.uint8 else chars
+    if out.shape[1] < width:
+        out = jnp.pad(out, ((0, 0), (0, width - out.shape[1])))
+    return out
 
 
 def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
@@ -151,6 +163,37 @@ def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
             pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, spec.get("q", 2), 256
         )
         return bucket_similarity(sim, thresholds, pc.null)
+
+    if kind == "name_inversion":
+        # 4-level cross-column comparison handling inverted name fields
+        # (/root/reference/splink/case_statements.py:248-277):
+        #   3: jw(col_l, col_r) > t1
+        #   2: jw(col_l, other_r) > t1 for any other name column (inversion)
+        #   1: jw(col_l, col_r) > t2
+        #   0: otherwise; null(col) -> -1. The reference only null-guards the
+        #      *right* side of the other column (ifnull({n}_r, '1234')), so a
+        #      null other_l does not suppress the inversion check.
+        if not thresholds:
+            thresholds = (0.94, 0.88)  # the reference's defaults
+        t1, t2 = thresholds[0], thresholds[1]
+        sim_self = string_ops.jaro_winkler(
+            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, 0.1, 0.0
+        )
+        inverted = jnp.zeros(sim_self.shape, bool)
+        for other in spec.get("other_columns", []):
+            oc = ctx.col(other)
+            # columns may be encoded at different widths/dtypes: align them
+            width = max(pc.chars_l.shape[1], oc.chars_r.shape[1])
+            a = _pad_chars(pc.chars_l, width)
+            b = _pad_chars(oc.chars_r, width)
+            sim_o = string_ops.jaro_winkler(a, b, pc.len_l, oc.len_r, 0.1, 0.0)
+            inverted = inverted | ((sim_o > t1) & ~oc.null_r)
+        gamma = jnp.where(
+            sim_self > t1,
+            jnp.int8(3),
+            jnp.where(inverted, jnp.int8(2), jnp.where(sim_self > t2, jnp.int8(1), jnp.int8(0))),
+        )
+        return apply_null(gamma, pc.null)
 
     raise ValueError(f"Unknown comparison kind {kind!r}")
 

@@ -83,8 +83,10 @@ def run_em_streamed(
                 if w is None:
                     G, w = shard_pairs(mesh, np.asarray(G))
                 else:
-                    G, _auto_w = shard_pairs(mesh, np.asarray(G))
-                    w = jax.device_put(np.asarray(w), pair_sharding(mesh))
+                    # pad user weights alongside G (padding weight 0)
+                    G, w, _auto_w = shard_pairs(
+                        mesh, np.asarray(G), np.asarray(w, np.float32)
+                    )
             stats, ll = _batch_stats(
                 jnp.asarray(G), params, max_levels, w, compute_ll
             )

@@ -137,6 +137,16 @@ def complete_settings_dict(settings_dict: dict) -> dict:
             "generally be intractable."
         )
 
+    names = [comparison_column_name(c) for c in settings_dict["comparison_columns"]]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(
+            f"Duplicate comparison column name(s) {sorted(dupes)}: each "
+            "comparison needs a distinct name. To compare the same input "
+            "column twice, give the second comparison a 'custom_name' and "
+            "'custom_columns_used'."
+        )
+
     for gamma_index, col_settings in enumerate(settings_dict["comparison_columns"]):
         col_settings["gamma_index"] = gamma_index
         for key in ("num_levels", "data_type", "term_frequency_adjustments"):

@@ -1,0 +1,59 @@
+"""Reference SQL surface translation (compat_sql)."""
+
+import numpy as np
+import pytest
+
+from splink_tpu.compat_sql import (
+    SqlTranslationError,
+    parse_blocking_rule,
+    parse_case_expression,
+)
+
+
+def test_name_inversion_case_translated():
+    # the exact shape sql_gen_gammas_name_inversion_4 emits
+    expr = """case
+    when surname_l is null or surname_r is null then -1
+    when jaro_winkler_sim(surname_l, surname_r) > 0.94 then 3
+    when (jaro_winkler_sim(surname_l, ifnull(forename1_r, '1234')) > 0.94 OR jaro_winkler_sim(surname_l, ifnull(forename2_r, '1234')) > 0.94) then 2
+    when jaro_winkler_sim(surname_l, surname_r) > 0.88 then 1
+    else 0 end"""
+    spec = parse_case_expression(expr, 4)
+    assert spec["kind"] == "name_inversion"
+    assert spec["column"] == "surname"
+    assert spec["other_columns"] == ["forename1", "forename2"]
+    assert spec["thresholds"] == [0.94, 0.88]
+
+
+def test_incomplete_level_coverage_raises():
+    # only level 2 gated but num_levels = 4: must not silently mistranslate
+    expr = """case
+    when a_l is null or a_r is null then -1
+    when jaro_winkler_sim(a_l, a_r) > 0.94 then 2
+    else 0 end"""
+    with pytest.raises(SqlTranslationError, match="gates levels"):
+        parse_case_expression(expr, 4)
+
+
+def test_jaro_chain_still_translates():
+    expr = """case when a_l is null or a_r is null then -1
+    when jaro_winkler_sim(a_l, a_r) > 0.94 then 2
+    when jaro_winkler_sim(a_l, a_r) > 0.88 then 1
+    else 0 end"""
+    assert parse_case_expression(expr, 3) == {
+        "kind": "jaro_winkler",
+        "thresholds": [0.94, 0.88],
+    }
+
+
+def test_blocking_rule_is_null_predicates():
+    eq, residual = parse_blocking_rule(
+        "l.city = r.city and l.age is not null and r.age is null"
+    )
+    assert eq == [("city", "city")]
+    import pandas as pd
+
+    l = {"age": np.array([1.0, np.nan])}
+    r = {"age": np.array([np.nan, np.nan])}
+    out = eval(residual, {"_isna": pd.isna}, {"l": l, "r": r})
+    assert list(out) == [True, False]

@@ -155,3 +155,37 @@ def test_unicode_strings_character_semantics():
     G = prog.compute(il, ir)
     assert G[0, 0] == 2  # identical
     assert G[1, 0] >= 1  # one-character difference, high jw
+
+
+def test_name_inversion_levels():
+    # (reference case_statements.py:248-277): detect surname/forename swaps
+    df = pd.DataFrame(
+        {
+            "unique_id": range(5),
+            "surname": ["smith", "smith", "john", "zzz", None],
+            "forename": ["john", "john", "smith", "qqq", "x"],
+        }
+    )
+    cols = [
+        {
+            "custom_name": "surname_inv",
+            "custom_columns_used": ["surname", "forename"],
+            "num_levels": 4,
+            "comparison": {
+                "kind": "name_inversion",
+                "column": "surname",
+                "other_columns": ["forename"],
+                "thresholds": [0.94, 0.88],
+            },
+        },
+        {"col_name": "surname", "num_levels": 2},
+    ]
+    prog, _ = _program(cols, df)
+    il, ir = _pairs_vs_first(df)
+    G = prog.compute(il, ir)
+    # pair (0,1): identical surname -> 3
+    # pair (0,2): surname_l 'smith' vs surname_r 'john' low, but matches
+    #   forename_r 'smith' -> inversion level 2
+    # pair (0,3): nothing matches -> 0
+    # pair (0,4): surname_r null -> -1
+    assert G[:, 0].tolist() == [3, 2, 0, -1]
