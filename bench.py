@@ -1,10 +1,11 @@
 """Benchmark: scored record-pairs/sec through the full device pipeline.
 
 Measures the production path on whatever accelerator jax exposes (one TPU v5e
-chip under the driver): device gathers from encoded columns -> vmapped
-comparison kernels (2x jaro-winkler, exact, numeric) -> gamma bucketing ->
-log-space Fellegi-Sunter scoring, streamed in pair batches; plus a fused-EM
-convergence run on the resulting gamma matrix.
+chip under the driver): pandas input -> host encode -> packed uint32 row
+table (one gather per pair side, splink_tpu/gammas.py) -> vmapped comparison
+kernels (2x jaro-winkler, exact, numeric) -> gamma bucketing -> log-space
+Fellegi-Sunter scoring, streamed in pair batches; plus a fused-EM convergence
+run on the resulting gamma matrix.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 vs_baseline is measured against the BASELINE.md north-star target of 50M
@@ -22,12 +23,35 @@ TARGET_PAIRS_PER_SEC_PER_CHIP = 50e6 / 8  # north star: 50M/s on a v5e-8
 N_ROWS = 1_000_000
 N_PAIRS = 8 * (1 << 20)  # ~8.4M pairs
 BATCH = 1 << 20
-STRING_WIDTH = 8  # longest synthetic name is 8 chars; mirrors the
-# data-driven width selection in splink_tpu.data.encode_string_column
+
+SETTINGS = {
+    "link_type": "dedupe_only",
+    "comparison_columns": [
+        {
+            "col_name": "first_name",
+            "num_levels": 3,
+            "comparison": {"kind": "jaro_winkler", "thresholds": [0.94, 0.88]},
+        },
+        {
+            "col_name": "surname",
+            "num_levels": 3,
+            "comparison": {"kind": "jaro_winkler", "thresholds": [0.94, 0.88]},
+        },
+        {"col_name": "city", "num_levels": 2, "comparison": {"kind": "exact"}},
+        {
+            "col_name": "dob",
+            "num_levels": 2,
+            "data_type": "numeric",
+            "comparison": {"kind": "numeric_abs", "thresholds": [1.0]},
+        },
+    ],
+    "blocking_rules": [],
+}
 
 
-def _make_encoded_rows(rng, n_rows):
-    """Synthetic name-like string columns + a numeric column, pre-encoded."""
+def _make_df(rng, n_rows):
+    import pandas as pd
+
     firsts = np.array(
         ["amelia", "oliver", "isla", "george", "ava", "noah", "emily", "arthur",
          "sophia", "lily", "freya", "leo", "ivy", "oscar", "grace", "archie"]
@@ -36,45 +60,36 @@ def _make_encoded_rows(rng, n_rows):
         ["smith", "jones", "taylor", "brown", "wilson", "evans", "thomas",
          "roberts", "johnson", "lewis", "walker", "robinson"]
     )
-
-    def enc(values):
-        b = np.zeros((n_rows, STRING_WIDTH), np.uint8)
-        ln = np.zeros(n_rows, np.int32)
-        uniq, inv = np.unique(values, return_inverse=True)
-        enc_uniq = np.zeros((len(uniq), STRING_WIDTH), np.uint8)
-        len_uniq = np.zeros(len(uniq), np.int32)
-        for k, v in enumerate(uniq):
-            e = v.encode()[:STRING_WIDTH]
-            enc_uniq[k, : len(e)] = np.frombuffer(e, np.uint8)
-            len_uniq[k] = len(e)
-        return enc_uniq[inv], len_uniq[inv], inv.astype(np.int32)
-
-    f_vals = firsts[rng.integers(0, len(firsts), n_rows)]
-    l_vals = lasts[rng.integers(0, len(lasts), n_rows)]
-    fb, fl, ft = enc(f_vals)
-    lb, ll, lt = enc(l_vals)
-    dob = rng.integers(1940, 2000, n_rows).astype(np.float32)
-    return (fb, fl, ft), (lb, ll, lt), dob
+    cities = np.array([f"city{k:03d}" for k in range(200)])
+    return pd.DataFrame(
+        {
+            "unique_id": np.arange(n_rows),
+            "first_name": firsts[rng.integers(0, len(firsts), n_rows)],
+            "surname": lasts[rng.integers(0, len(lasts), n_rows)],
+            "city": cities[rng.integers(0, len(cities), n_rows)],
+            "dob": rng.integers(1940, 2000, n_rows).astype(np.float64),
+        }
+    )
 
 
 def main():
     import jax
     import jax.numpy as jnp
 
+    from splink_tpu.data import encode_table
     from splink_tpu.em import run_em
+    from splink_tpu.gammas import GammaProgram
     from splink_tpu.models.fellegi_sunter import FSParams, match_probability
-    from splink_tpu.ops.gamma import bucket_similarity
-    from splink_tpu.ops.strings import jaro_winkler
-    from splink_tpu.ops.numeric import abs_difference
+    from splink_tpu.settings import complete_settings_dict
 
     rng = np.random.default_rng(0)
-    (fb, fl, ft), (lb, ll, lt), dob = _make_encoded_rows(rng, N_ROWS)
+    settings = complete_settings_dict(dict(SETTINGS))
 
-    dev = {
-        "fb": jnp.asarray(fb), "fl": jnp.asarray(fl), "ft": jnp.asarray(ft),
-        "lb": jnp.asarray(lb), "ll": jnp.asarray(ll), "lt": jnp.asarray(lt),
-        "dob": jnp.asarray(dob),
-    }
+    df = _make_df(rng, N_ROWS)
+    t_enc = time.perf_counter()
+    table = encode_table(df, settings)
+    encode_time = time.perf_counter() - t_enc
+    prog = GammaProgram(settings, table)
 
     n_cols, max_levels = 4, 3
     m = np.array([[0.05, 0.15, 0.8], [0.1, 0.2, 0.7], [0.1, 0.9, 0.0], [0.2, 0.8, 0.0]])
@@ -87,22 +102,13 @@ def main():
 
     @jax.jit
     def score_batch(idx_l, idx_r, params):
-        """gathers -> kernels -> gammas -> FS scoring for one pair batch."""
-        jw1 = jaro_winkler(dev["fb"][idx_l], dev["fb"][idx_r],
-                           dev["fl"][idx_l], dev["fl"][idx_r], 0.1, 0.0)
-        g0 = bucket_similarity(jw1, (0.94, 0.88), None)
-        jw2 = jaro_winkler(dev["lb"][idx_l], dev["lb"][idx_r],
-                           dev["ll"][idx_l], dev["ll"][idx_r], 0.1, 0.0)
-        g1 = bucket_similarity(jw2, (0.94, 0.88), None)
-        g2 = (dev["ft"][idx_l] == dev["ft"][idx_r]).astype(jnp.int8)
-        g3 = (abs_difference(dev["dob"][idx_l], dev["dob"][idx_r]) < 1.0).astype(jnp.int8)
-        G = jnp.stack([g0, g1, g2, g3], axis=1)
+        """packed row gathers -> comparison kernels -> gammas -> FS score."""
+        G = prog._gamma_batch(idx_l, idx_r)
         return G, match_probability(G, params)
 
     # pair batches (simulating blocked-pair index streams)
     idx_l = rng.integers(0, N_ROWS, N_PAIRS).astype(np.int32)
     idx_r = rng.integers(0, N_ROWS, N_PAIRS).astype(np.int32)
-
     batches = [
         (jnp.asarray(idx_l[s : s + BATCH]), jnp.asarray(idx_r[s : s + BATCH]))
         for s in range(0, N_PAIRS, BATCH)
@@ -148,6 +154,7 @@ def main():
         "score_seconds": round(score_time, 3),
         "em_seconds": round(em_time, 3),
         "em_updates": int(res.n_updates),
+        "encode_seconds": round(encode_time, 3),
         "device": str(jax.devices()[0]),
     }))
 

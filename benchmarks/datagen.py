@@ -136,7 +136,7 @@ def make_people(
     return df
 
 
-def split_for_linking(df: pd.DataFrame, seed: int = 0):
+def split_for_linking(df: pd.DataFrame):
     """Split a deduped frame into two overlapping 'datasets' for link_only."""
     first = df.drop_duplicates("cluster", keep="first")
     rest = df[~df.index.isin(first.index)]

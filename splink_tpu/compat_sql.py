@@ -47,6 +47,11 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
         # jw(col_l, ifnull(other_r, ...)) terms at level 2.
         spec = _parse_name_inversion(s)
         if spec is not None:
+            if num_levels != 4:
+                raise SqlTranslationError(
+                    "name-inversion case_expression emits gamma levels 0-3 "
+                    f"but num_levels={num_levels}; set num_levels to 4: {expr!r}"
+                )
             return spec
 
     if "jaro_winkler_sim" in s:
@@ -57,8 +62,24 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
             return {"kind": "jaro_winkler", "thresholds": [float(t) for t, _ in by_level]}
 
     if "levenshtein" in s:
+        # Reference shape (/root/reference/splink/case_statements.py:117-141):
+        # strict equality gates the TOP level, levenshtein-ratio thresholds
+        # gate levels num_levels-2 .. 1.
         pairs = re.findall(rf"<=\s*{_NUM}\s*then\s*(\d+)", s)
         if pairs:
+            levels = {int(lv) for _, lv in pairs}
+            eq = re.search(r"when\s+(\w+)_l\s*=\s*\1_r\s+then\s+(\d+)", s)
+            if (
+                levels != set(range(1, num_levels - 1))
+                or not eq
+                or int(eq.group(2)) != num_levels - 1
+            ):
+                raise SqlTranslationError(
+                    f"levenshtein case_expression gates levels {sorted(levels)} "
+                    f"(equality level: {eq.group(2) if eq else 'missing'}) but "
+                    f"num_levels={num_levels}; this CASE shape is not fully "
+                    f"recognised: {expr!r}. Provide a native 'comparison' spec."
+                )
             return {"kind": "levenshtein", "thresholds": [
                 float(t) for t, _ in sorted(pairs, key=lambda p: -int(p[1]))
             ]}
@@ -66,12 +87,14 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
     if re.search(r"abs\(", s) and "/" in s:
         pairs = re.findall(rf"<\s*{_NUM}\s*then\s*(\d+)", s)
         if pairs:
+            _check_level_coverage(expr, pairs, num_levels)
             by_level = sorted(pairs, key=lambda p: -int(p[1]))
             return {"kind": "numeric_perc", "thresholds": [float(t) for t, _ in by_level]}
 
     if re.search(r"abs\(", s):
         pairs = re.findall(rf"<\s*{_NUM}\s*then\s*(\d+)", s)
         if pairs:
+            _check_level_coverage(expr, pairs, num_levels)
             by_level = sorted(pairs, key=lambda p: -int(p[1]))
             return {"kind": "numeric_abs", "thresholds": [float(t) for t, _ in by_level]}
 

@@ -77,11 +77,8 @@ def _to_object_array(values) -> np.ndarray:
     import pandas as pd
 
     s = pd.Series(values)
-    isna = pd.isna(s)
-    out = np.empty(len(s), dtype=object)
-    vals = s.to_numpy(dtype=object, copy=False)
-    for i in range(len(s)):
-        out[i] = None if isna.iloc[i] else vals[i]
+    out = s.to_numpy(dtype=object, copy=True)
+    out[pd.isna(s).to_numpy()] = None
     return out
 
 
@@ -141,12 +138,18 @@ def encode_string_column(values, width: int = DEFAULT_STRING_WIDTH) -> EncodedSt
 
 
 def encode_numeric_column(values) -> EncodedNumericColumn:
+    import pandas as pd
+
     obj = _to_object_array(values)
     null_mask = np.array([v is None for v in obj], dtype=bool)
-    f = np.zeros(len(obj), dtype=np.float64)
-    for i, v in enumerate(obj):
-        if v is not None:
-            f[i] = float(v)
+    s = pd.to_numeric(pd.Series(values), errors="coerce")
+    coerced = s.isna().to_numpy()
+    if (bad := coerced & ~null_mask).any():
+        i = int(np.argmax(bad))
+        raise ValueError(
+            f"numeric column contains unparseable value {obj[i]!r} at row {i}"
+        )
+    f = s.fillna(0.0).to_numpy(np.float64)
     return EncodedNumericColumn(values_f64=f, null_mask=null_mask, values=obj)
 
 
