@@ -98,6 +98,29 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
             by_level = sorted(pairs, key=lambda p: -int(p[1]))
             return {"kind": "numeric_abs", "thresholds": [float(t) for t, _ in by_level]}
 
+    if "dmetaphone" in s:
+        # DoubleMetaphone-UDF comparison shapes: phonetic equality at level 1,
+        # optionally under strict equality at level 2.
+        m3 = re.search(
+            r"when\s+(\w+)_l\s*=\s*\1_r\s+then\s+2\s+when\s+"
+            r"dmetaphone\(\s*\1_l\s*\)\s*=\s*dmetaphone\(\s*\1_r\s*\)\s*then\s+1",
+            s,
+        )
+        if m3 and num_levels == 3:
+            return {"kind": "dmetaphone"}
+        m2 = re.search(
+            r"when\s+dmetaphone\(\s*(\w+)_l\s*\)\s*=\s*"
+            r"dmetaphone\(\s*\1_r\s*\)\s*then\s+1",
+            s,
+        )
+        if m2 and num_levels == 2:
+            return {"kind": "dmetaphone"}
+        raise SqlTranslationError(
+            f"Unrecognised dmetaphone case_expression shape: {expr!r}. "
+            'Provide a native spec {"comparison": {"kind": "dmetaphone"}} '
+            "with num_levels 2 (phonetic equality) or 3 (exact, then phonetic)."
+        )
+
     m = re.search(r"when\s+(\w+)_l\s*=\s*(\w+)_r\s+then\s+(\d+)", s)
     if m and num_levels == 2:
         return {"kind": "exact"}
@@ -155,8 +178,13 @@ def parse_blocking_rule(rule: str):
     residual_predicate: a compiled python expression (numpy semantics) for any
     remaining AND-ed terms, or None. Evaluated against dicts ``l``/``r`` of
     column arrays after the hash join.
+
+    ``dmetaphone(l.col)`` terms resolve to the host-precomputed derived
+    column ``__dm_col`` (splink_tpu/data.py), so phonetic blocking keys are
+    ordinary hash-join keys.
     """
     s = _normalise(rule)
+    s = re.sub(r"(?i)\bdmetaphone\(\s*(l|r)\.(\w+)\s*\)", r"\1.__dm_\2", s)
     if not s:
         raise SqlTranslationError("Empty blocking rule")
     # Split on top-level AND only (no parens handling needed for AND of terms)

@@ -143,13 +143,16 @@ def encode_numeric_column(values) -> EncodedNumericColumn:
     obj = _to_object_array(values)
     null_mask = np.array([v is None for v in obj], dtype=bool)
     s = pd.to_numeric(pd.Series(values), errors="coerce")
-    coerced = s.isna().to_numpy()
-    if (bad := coerced & ~null_mask).any():
-        i = int(np.argmax(bad))
-        raise ValueError(
-            f"numeric column contains unparseable value {obj[i]!r} at row {i}"
-        )
     f = s.fillna(0.0).to_numpy(np.float64)
+    # Rows to_numeric refused but float() accepts (e.g. the string 'nan')
+    # keep their float value; anything neither parses is a real error.
+    for i in np.flatnonzero(s.isna().to_numpy() & ~null_mask):
+        try:
+            f[i] = float(obj[i])
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"numeric column contains unparseable value {obj[i]!r} at row {i}"
+            ) from None
     return EncodedNumericColumn(values_f64=f, null_mask=null_mask, values=obj)
 
 
@@ -174,6 +177,30 @@ def _columns_needed(settings: dict) -> tuple[dict[str, str], list[str]]:
             if ref not in typed and ref not in passthrough:
                 passthrough.append(ref)
     return typed, passthrough
+
+
+def _phonetic_columns_needed(settings: dict) -> set[str]:
+    """Columns whose double-metaphone encoding is compared or blocked on,
+    via the 'dmetaphone' comparison kind or ``dmetaphone(l.col)`` blocking
+    terms (the reference's DoubleMetaphone-UDF use cases,
+    /root/reference/tests/test_spark.py:48)."""
+    import re
+
+    need: set[str] = set()
+    for col in settings["comparison_columns"]:
+        spec = col.get("comparison") or {}
+        if spec.get("kind") == "dmetaphone":
+            name = col.get("col_name") or spec.get("column")
+            if name:
+                need.add(name)
+    for rule in settings.get("blocking_rules") or []:
+        for ref in re.findall(r"(?i)\bdmetaphone\(\s*[lr]\.(\w+)\s*\)", rule):
+            need.add(ref)
+    return need
+
+
+def phonetic_column_name(col: str) -> str:
+    return f"__dm_{col}"
 
 
 def encode_table(df, settings: dict, source_table: np.ndarray | None = None) -> EncodedTable:
@@ -206,6 +233,17 @@ def encode_table(df, settings: dict, source_table: np.ndarray | None = None) -> 
         if name not in df.columns:
             raise ValueError(f"Input data is missing retained column {name!r}")
         table.raw[name] = df[name].to_numpy()
+
+    # Derived phonetic columns: double-metaphone codes computed once per
+    # record on the host, then compared on device as ordinary token ids.
+    for name in _phonetic_columns_needed(settings):
+        if name not in df.columns:
+            raise ValueError(f"Input data is missing phonetic column {name!r}")
+        from .ops.phonetic import double_metaphone_primary
+
+        src = _to_object_array(df[name])
+        codes = [None if v is None else double_metaphone_primary(str(v)) for v in src]
+        table.strings[phonetic_column_name(name)] = encode_string_column(codes)
     return table
 
 

@@ -119,13 +119,39 @@ class _NumericField:
         self.null_bit = null_bit
 
 
-def pack_table(table: EncodedTable, float_dtype=jnp.float32):
-    """Pack every encoded column into one (n_rows, n_lanes) uint32 matrix.
+def comparison_columns_used(settings: dict) -> set[str] | None:
+    """Encoded-column names the gamma program reads, or None for 'all'
+    (a registered custom comparison may touch any column)."""
+    from .data import phonetic_column_name
+
+    used: set[str] = set()
+    for col in settings["comparison_columns"]:
+        spec = col.get("comparison") or {}
+        kind = spec.get("kind")
+        if kind == "custom":
+            return None
+        name = col.get("col_name") or spec.get("column")
+        if name is None:
+            name = (col.get("custom_columns_used") or [None])[0]
+        if name:
+            used.add(name)
+            if kind == "dmetaphone":
+                used.add(phonetic_column_name(name))
+        used.update(spec.get("other_columns", []))
+    return used
+
+
+def pack_table(table: EncodedTable, float_dtype=jnp.float32, include=None):
+    """Pack encoded columns into one (n_rows, n_lanes) uint32 matrix.
 
     Layout per string column: chars (width/4 lanes for ASCII, width lanes for
     wide-unicode), then a length lane and a token-id lane (token -1 doubles as
     the null flag). Numeric columns contribute one (f32) or two (f64) bitcast
     value lanes; their null bits are packed 32-per-lane at the end.
+
+    ``include`` limits packing to those column names (row gathers are the
+    measured bottleneck, so columns used only host-side — e.g. derived
+    phonetic blocking keys — must not ride along); None packs everything.
 
     Returns (packed uint32 ndarray, {name: field layout}).
     """
@@ -145,6 +171,8 @@ def pack_table(table: EncodedTable, float_dtype=jnp.float32):
         return s
 
     for name, sc in table.strings.items():
+        if include is not None and name not in include:
+            continue
         if sc.bytes_.dtype == np.uint8:
             w = sc.width
             if w % 4:  # pad to a whole number of lanes
@@ -162,7 +190,9 @@ def pack_table(table: EncodedTable, float_dtype=jnp.float32):
         layout[name] = _StringField(kind, sc.width, chars, len_lane, tok_lane)
 
     f64 = float_dtype == jnp.float64
-    num_names = list(table.numerics)
+    num_names = [
+        c for c in table.numerics if include is None or c in include
+    ]
     null_words = np.zeros((n, max(1, (len(num_names) + 31) // 32)), np.uint32)
     num_fields = {}
     for i, name in enumerate(num_names):
@@ -278,6 +308,27 @@ def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
         gamma = eq.astype(GAMMA_DTYPE)
         return apply_null(gamma, pc.null)
 
+    if kind == "dmetaphone":
+        # Phonetic comparison against the host-precomputed double-metaphone
+        # column (the reference jar's DoubleMetaphone UDF use case):
+        # num_levels 2 -> phonetic equality; 3 -> exact match above phonetic.
+        from .data import phonetic_column_name
+
+        if levels not in (2, 3):
+            raise ValueError(
+                f"dmetaphone comparison supports num_levels 2 or 3, got {levels}"
+            )
+        dm = ctx.col(phonetic_column_name(name))
+        phon_eq = dm.tok_l == dm.tok_r
+        if levels >= 3:
+            exact = pc.tok_l == pc.tok_r
+            gamma = jnp.where(
+                exact, jnp.int8(2), jnp.where(phon_eq, jnp.int8(1), jnp.int8(0))
+            )
+        else:
+            gamma = phon_eq.astype(GAMMA_DTYPE)
+        return apply_null(gamma, pc.null)
+
     if kind == "jaro_winkler":
         sim = string_ops.jaro_winkler(
             pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, 0.1, 0.0
@@ -352,9 +403,11 @@ class GammaProgram:
         self.max_levels = max(
             c["num_levels"] for c in settings["comparison_columns"]
         )
-        # Pack every encoded column into one uint32 matrix and push it to
+        # Pack the compared columns into one uint32 matrix and push it to
         # device once: each pair batch then costs exactly two row gathers.
-        packed, layout = pack_table(table, float_dtype)
+        packed, layout = pack_table(
+            table, float_dtype, include=comparison_columns_used(settings)
+        )
         self._packed = jnp.asarray(packed)
         self._layout = layout
         reverse = _bitcast_reverses_bytes()

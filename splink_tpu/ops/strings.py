@@ -139,10 +139,20 @@ def exact_equal_single(s1, s2, l1, l2):
 
 
 # Batched versions: vmap over the leading pair axis.
-jaro_winkler = jax.vmap(jaro_winkler_single, in_axes=(0, 0, 0, 0, None, None))
+jaro_winkler_vmapped = jax.vmap(jaro_winkler_single, in_axes=(0, 0, 0, 0, None, None))
 levenshtein = jax.vmap(levenshtein_single)
 levenshtein_ratio = jax.vmap(levenshtein_ratio_single)
 exact_equal = jax.vmap(exact_equal_single)
+
+
+def jaro_winkler(s1, s2, l1, l2, prefix_scale=0.1, boost_threshold=0.0):
+    """Batched Jaro-Winkler: Pallas lane-tile kernel on TPU for ASCII
+    fixed-width columns, vmapped pure-JAX elsewhere (wide unicode, CPU)."""
+    from .strings_pallas import jaro_winkler_pallas, pallas_supported
+
+    if pallas_supported(s1):
+        return jaro_winkler_pallas(s1, s2, l1, l2, prefix_scale, boost_threshold)
+    return jaro_winkler_vmapped(s1, s2, l1, l2, prefix_scale, boost_threshold)
 
 
 def jaro_winkler_batch(s1, s2, l1, l2, prefix_scale=0.1, boost_threshold=0.0):

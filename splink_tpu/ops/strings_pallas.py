@@ -1,0 +1,163 @@
+"""Pallas TPU kernel for batched Jaro-Winkler similarity.
+
+The pure-JAX implementation (splink_tpu/ops/strings.py) runs the greedy
+matching scan as vmapped (L,)-vector steps, which XLA executes as L
+sequential HBM-resident kernels. This kernel instead keeps the whole working
+set of a lane-tile of pairs in VMEM/registers:
+
+  * layout: the PAIR axis rides the 128 VPU lanes, the character axis rides
+    sublanes — inputs arrive transposed as (L, B) float32 so one (L, T) tile
+    holds T complete pairs;
+  * the greedy pass unrolls the L (static, <= 32) steps in-register;
+  * every prefix count ("first eligible partner", match ranks, common-prefix
+    run) is a small lower-triangular (L, L) x (L, T) matmul on the MXU —
+    no cumsum primitive, no scatters, no per-pair control flow;
+  * transposition counting walks the L match ranks, selecting each side's
+    k-th matched character with compare-and-mask sublane reductions.
+
+Semantics are identical to strings.jaro_winkler (commons-text style: boost
+applied unconditionally at boost_threshold=0.0), which the tests enforce
+against the same oracle. ASCII-width-<=32 columns dispatch here on TPU;
+wide-unicode or long columns fall back to the vmapped implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE_TILE = 512  # pairs per grid step
+MAX_PALLAS_WIDTH = 32
+
+
+def _tril(L: int, strict: bool) -> jnp.ndarray:
+    r = jnp.arange(L)
+    return (r[:, None] > r[None, :] if strict else r[:, None] >= r[None, :]).astype(
+        jnp.float32
+    )
+
+
+def _jw_kernel(s1_ref, s2_ref, l1_ref, l2_ref, out_ref, *, L, prefix_scale,
+               boost_threshold):
+    s1 = s1_ref[:]  # (L, T) f32 character codes (0 = padding)
+    s2 = s2_ref[:]
+    l1 = l1_ref[:]  # (1, T) f32 lengths
+    l2 = l2_ref[:]
+
+    incl = _tril(L, strict=False)  # inclusive prefix-count operator
+    iota = jax.lax.broadcasted_iota(jnp.float32, (L, s1.shape[1]), 0)
+    valid2 = iota < l2
+    maxlen = jnp.maximum(l1, l2)
+    window = jnp.maximum(jnp.floor(maxlen * 0.5) - 1.0, 0.0)
+
+    # Greedy matching: step i claims the first in-window unused s2 position
+    # with the same character. used2/matched1 are (L, T) f32 0/1 masks.
+    used2 = jnp.zeros_like(s1)
+    matched1_rows = []
+    for i in range(L):
+        ch = s1[i : i + 1, :]  # (1, T)
+        cand = (
+            (s2 == ch)
+            & (jnp.abs(iota - i) <= window)
+            & valid2
+            & (used2 < 0.5)
+            & (i < l1)
+        ).astype(jnp.float32)
+        prefix = jnp.dot(incl, cand, preferred_element_type=jnp.float32)
+        first = cand * (prefix == 1.0)
+        used2 = used2 + first
+        matched1_rows.append(jnp.sum(first, axis=0, keepdims=True))
+    matched1 = jnp.concatenate(matched1_rows, axis=0)  # (L, T)
+    m = jnp.sum(matched1, axis=0, keepdims=True)  # (1, T)
+
+    # Half transpositions: compare the k-th matched character of each side.
+    # rank = exclusive prefix count of the match mask (MXU matmul).
+    strict = _tril(L, strict=True)
+    r1 = jnp.dot(strict, matched1, preferred_element_type=jnp.float32)
+    r2 = jnp.dot(strict, used2, preferred_element_type=jnp.float32)
+    t_half = jnp.zeros_like(m)
+    for k in range(L):
+        sel1 = matched1 * (r1 == k)  # one-hot over sublanes per lane
+        sel2 = used2 * (r2 == k)
+        c1 = jnp.sum(s1 * sel1, axis=0, keepdims=True)
+        c2 = jnp.sum(s2 * sel2, axis=0, keepdims=True)
+        t_half = t_half + ((c1 != c2) & (k < m)).astype(jnp.float32)
+
+    t = t_half * 0.5
+    safe = jnp.maximum(m, 1.0)
+    jaro = (
+        m / jnp.maximum(l1, 1.0) + m / jnp.maximum(l2, 1.0) + (m - t) / safe
+    ) / 3.0
+    jaro = jnp.where(m > 0, jaro, 0.0)
+
+    # Winkler boost: ell = length of the common prefix (capped at 4), found as
+    # the count of positions whose inclusive prefix of mismatches is zero.
+    neq = ((s1 != s2) | (iota >= l1) | (iota >= l2)).astype(jnp.float32)
+    mismatches_before = jnp.dot(incl, neq, preferred_element_type=jnp.float32)
+    prefix_run = jnp.sum(
+        (mismatches_before == 0.0).astype(jnp.float32), axis=0, keepdims=True
+    )
+    ell = jnp.minimum(prefix_run, 4.0)
+    boosted = jaro + ell * prefix_scale * (1.0 - jaro)
+    jw = jnp.where(jaro > boost_threshold, boosted, jaro)
+
+    both_empty = (l1 == 0) & (l2 == 0)
+    out_ref[:] = jnp.where(both_empty, 1.0, jw)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("prefix_scale", "boost_threshold", "interpret")
+)
+def jaro_winkler_pallas(
+    s1, s2, l1, l2, prefix_scale=0.1, boost_threshold=0.0, interpret=False
+):
+    """Batched Jaro-Winkler via the Pallas lane-tile kernel.
+
+    Args: s1, s2 (B, L) integer character codes (<= 2^23 so float32 equality
+    is exact); l1, l2 (B,) lengths. Returns (B,) float32.
+    """
+    B, L = s1.shape
+    T = min(LANE_TILE, max(B, 1))
+    pad = (-B) % T
+    if pad:
+        zf = lambda a, v=0: jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))  # noqa: E731
+        s1, s2, l1, l2 = zf(s1), zf(s2), zf(l1), zf(l2)
+    n = s1.shape[0]
+
+    s1T = s1.astype(jnp.float32).T  # (L, n)
+    s2T = s2.astype(jnp.float32).T
+    l1r = l1.astype(jnp.float32).reshape(1, n)
+    l2r = l2.astype(jnp.float32).reshape(1, n)
+
+    kernel = functools.partial(
+        _jw_kernel, L=L, prefix_scale=prefix_scale, boost_threshold=boost_threshold
+    )
+    col = lambda i: (0, i)  # noqa: E731
+    out = pl.pallas_call(
+        kernel,
+        grid=(n // T,),
+        in_specs=[
+            pl.BlockSpec((L, T), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, T), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), col, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, T), col, memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
+        interpret=interpret,
+    )(s1T, s2T, l1r, l2r)
+    return out[0, :B]
+
+
+def pallas_supported(s1) -> bool:
+    """Whether the Pallas path handles this input on the current backend."""
+    return (
+        jax.default_backend() in ("tpu", "axon")  # axon = tunnelled TPU plugin
+        and s1.ndim == 2
+        and s1.shape[1] <= MAX_PALLAS_WIDTH
+        and s1.dtype == jnp.uint8
+    )

@@ -1,0 +1,77 @@
+"""Pallas Jaro-Winkler kernel vs the Python oracle (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+from splink_tpu.ops.strings_pallas import jaro_winkler_pallas
+
+from conftest import py_jaro_winkler
+
+
+def _encode(strings, width):
+    b = np.zeros((len(strings), width), np.uint8)
+    ln = np.zeros(len(strings), np.int32)
+    for i, s in enumerate(strings):
+        e = s.encode()[:width]
+        b[i, : len(e)] = np.frombuffer(e, np.uint8)
+        ln[i] = len(e)
+    return b, ln
+
+
+CASES = [
+    ("martha", "marhta"),
+    ("dixon", "dicksonx"),
+    ("jellyfish", "smellyfish"),
+    ("", ""),
+    ("", "abc"),
+    ("abc", ""),
+    ("a", "a"),
+    ("ab", "ba"),
+    ("abcdefgh", "abcdefgh"),
+    ("crate", "trace"),
+    ("dwayne", "duane"),
+    ("aaaaaaaa", "aaaa"),
+]
+
+
+@pytest.mark.parametrize("width", [8, 16])
+def test_matches_oracle_on_known_cases(width):
+    s1 = [a for a, _ in CASES]
+    s2 = [b for _, b in CASES]
+    b1, l1 = _encode(s1, width)
+    b2, l2 = _encode(s2, width)
+    got = np.asarray(
+        jaro_winkler_pallas(b1, b2, l1, l2, 0.1, 0.0, interpret=True)
+    )
+    want = np.array(
+        [py_jaro_winkler(a[:width], b[:width]) for a, b in CASES], np.float32
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_matches_oracle_random(rng):
+    n, width = 700, 8  # > one lane tile so the grid has multiple steps
+    letters = np.array(list("abcdefgh"))
+    strs1 = ["".join(letters[rng.integers(0, 8, rng.integers(0, 9))]) for _ in range(n)]
+    strs2 = ["".join(letters[rng.integers(0, 8, rng.integers(0, 9))]) for _ in range(n)]
+    b1, l1 = _encode(strs1, width)
+    b2, l2 = _encode(strs2, width)
+    got = np.asarray(jaro_winkler_pallas(b1, b2, l1, l2, 0.1, 0.0, interpret=True))
+    want = np.array(
+        [py_jaro_winkler(a, b) for a, b in zip(strs1, strs2)], np.float32
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_matches_vmapped_kernel(rng):
+    from splink_tpu.ops.strings import jaro_winkler_vmapped
+
+    n, width = 300, 16
+    letters = np.array(list("abcdefghijkl"))
+    strs1 = ["".join(letters[rng.integers(0, 12, rng.integers(0, 17))]) for _ in range(n)]
+    strs2 = ["".join(letters[rng.integers(0, 12, rng.integers(0, 17))]) for _ in range(n)]
+    b1, l1 = _encode(strs1, width)
+    b2, l2 = _encode(strs2, width)
+    got = np.asarray(jaro_winkler_pallas(b1, b2, l1, l2, 0.1, 0.0, interpret=True))
+    want = np.asarray(jaro_winkler_vmapped(b1, b2, l1, l2, 0.1, 0.0))
+    np.testing.assert_allclose(got, want, atol=1e-5)
