@@ -143,16 +143,18 @@ def encode_numeric_column(values) -> EncodedNumericColumn:
     obj = _to_object_array(values)
     null_mask = np.array([v is None for v in obj], dtype=bool)
     s = pd.to_numeric(pd.Series(values), errors="coerce")
-    f = s.fillna(0.0).to_numpy(np.float64)
+    # copy=True: the default can return a read-only pandas-backed view
+    f = np.array(s.fillna(0.0).to_numpy(np.float64))
     # Rows to_numeric refused but float() accepts (e.g. the string 'nan')
     # keep their float value; anything neither parses is a real error.
     for i in np.flatnonzero(s.isna().to_numpy() & ~null_mask):
         try:
-            f[i] = float(obj[i])
+            v = float(obj[i])
         except (TypeError, ValueError):
             raise ValueError(
                 f"numeric column contains unparseable value {obj[i]!r} at row {i}"
             ) from None
+        f[i] = v
     return EncodedNumericColumn(values_f64=f, null_mask=null_mask, values=obj)
 
 
@@ -190,7 +192,11 @@ def _phonetic_columns_needed(settings: dict) -> set[str]:
     for col in settings["comparison_columns"]:
         spec = col.get("comparison") or {}
         if spec.get("kind") == "dmetaphone":
-            name = col.get("col_name") or spec.get("column")
+            name = (
+                col.get("col_name")
+                or spec.get("column")
+                or (col.get("custom_columns_used") or [None])[0]
+            )
             if name:
                 need.add(name)
     for rule in settings.get("blocking_rules") or []:

@@ -139,8 +139,27 @@ class Splink:
 
     def get_scored_comparisons(self, compute_ll: bool = False):
         """Estimate parameters by EM and return scored comparisons
-        (/root/reference/splink/__init__.py:121-145)."""
+        (/root/reference/splink/__init__.py:121-145).
+
+        When the candidate-pair count exceeds ``max_resident_pairs`` the EM
+        runs in streaming mode: the host-resident gamma matrix is fed to the
+        device in micro-batches and sufficient statistics accumulate across
+        them (splink_tpu/parallel/streaming.py) instead of keeping the whole
+        matrix in HBM.
+        """
         G = self._ensure_gammas()
+        self._run_em(G, compute_ll)
+        return self._build_df_e(G)
+
+    def _run_em(self, G: np.ndarray, compute_ll: bool) -> None:
+        """Dispatch EM to the resident or streamed regime by pair count."""
+        if len(G) > int(self.settings["max_resident_pairs"]):
+            self._run_em_streamed(G, compute_ll)
+        else:
+            self._run_em_resident(G, compute_ll)
+
+    def _run_em_resident(self, G: np.ndarray, compute_ll: bool) -> None:
+        """Fused on-device EM with the gamma matrix resident in HBM."""
         dtype = np.float64 if self.settings["float64"] else np.float32
         lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
 
@@ -184,7 +203,60 @@ class Splink:
         if converged:
             logger.info("EM algorithm has converged")
 
-        return self._build_df_e(G)
+    def _run_em_streamed(self, G: np.ndarray, compute_ll: bool) -> None:
+        """Streaming EM over host-resident gamma micro-batches."""
+        from .parallel.streaming import run_em_streamed
+
+        dtype = np.float64 if self.settings["float64"] else np.float32
+        lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
+        init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
+        batch = int(self.settings["pair_batch_size"])
+        mesh = mesh_from_settings(self.settings)
+
+        def batches():
+            for s in range(0, len(G), batch):
+                yield G[s : s + batch]
+
+        def on_iteration(it, params_dev, ll):
+            if compute_ll and ll is not None:
+                self.params.params["log_likelihood"] = float(ll)
+                self.params.log_likelihood_exists = True
+            self.params.update_from_arrays(
+                float(params_dev.lam),
+                np.asarray(params_dev.m),
+                np.asarray(params_dev.u),
+            )
+            if self.save_state_fn is not None:
+                self.save_state_fn(self.params, self.settings)
+
+        with StageTimer("em_streamed"):
+            _, _, _, converged = run_em_streamed(
+                batches,
+                init,
+                max_iterations=int(self.settings["max_iterations"]),
+                max_levels=self.params.max_levels,
+                em_convergence=self.settings["em_convergence"],
+                mesh=mesh,
+                compute_ll=compute_ll,
+                on_iteration=on_iteration,
+            )
+        if converged:
+            logger.info("EM algorithm has converged")
+
+    def stream_scored_comparisons(self, compute_ll: bool = False):
+        """Streaming variant of get_scored_comparisons for outputs too large
+        to materialise as one DataFrame: runs (streamed) EM, then yields
+        scored-comparison DataFrame chunks of ``pair_batch_size`` pairs.
+
+        The reference returns a lazy Spark DataFrame at any scale
+        (/root/reference/splink/__init__.py:121-145); chunked emission is the
+        single-host equivalent — each chunk can be appended to parquet etc.
+        """
+        G = self._ensure_gammas()
+        self._run_em(G, compute_ll)
+        batch = int(self.settings["pair_batch_size"])
+        for s in range(0, len(G), batch):
+            yield self._build_df_e(G, slice(s, min(s + batch, len(G))))
 
     def _replay_history(self, result, compute_ll: bool) -> None:
         """Install a run_em result's device-side history into the Params
@@ -223,25 +295,50 @@ class Splink:
     # Output assembly
     # ------------------------------------------------------------------
 
-    def _build_df_e(self, G: np.ndarray):
+    def _score_batched(self, G: np.ndarray, params_dev: FSParams):
+        """Score in pair_batch_size device batches (padded to one compiled
+        shape), so output assembly never pushes more than a batch of the
+        gamma matrix plus its (n, C) float intermediates into HBM."""
+        n = len(G)
+        batch = min(int(self.settings["pair_batch_size"]), max(n, 1))
+        n_cols = G.shape[1] if G.ndim == 2 else 0
+        p = np.empty(n, np.float32)
+        prob_m = np.empty((n, n_cols), np.float32)
+        prob_u = np.empty((n, n_cols), np.float32)
+        for s in range(0, n, batch):
+            stop = min(s + batch, n)
+            Gb = G[s:stop]
+            if stop - s < batch:
+                Gb = np.concatenate(
+                    [Gb, np.zeros((batch - (stop - s), n_cols), G.dtype)]
+                )
+            pb, pmb, pub = score_pairs_with_intermediates(
+                jnp.asarray(Gb), params_dev
+            )
+            p[s:stop] = np.asarray(pb)[: stop - s]
+            prob_m[s:stop] = np.asarray(pmb)[: stop - s]
+            prob_u[s:stop] = np.asarray(pub)[: stop - s]
+        return p, prob_m, prob_u
+
+    def _build_df_e(self, G: np.ndarray, rows: slice | None = None):
         """Assemble the scored comparisons DataFrame with the reference's
-        column layout (/root/reference/splink/expectation_step.py:128-165)."""
+        column layout (/root/reference/splink/expectation_step.py:128-165).
+        ``rows`` restricts output to a slice of the pair set (streaming)."""
         table = self._ensure_encoded()
         pairs = self._ensure_pairs()
         settings = self.settings
 
+        il, ir = pairs.idx_l, pairs.idx_r
+        if rows is not None:
+            G, il, ir = G[rows], il[rows], ir[rows]
+
         dtype = np.float64 if settings["float64"] else np.float32
         lam, m, u, _ = self.params.to_arrays(dtype=dtype)
+        params_dev = FSParams(
+            lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)
+        )
         with StageTimer("score"):
-            p, prob_m, prob_u = score_pairs_with_intermediates(
-                jnp.asarray(G),
-                FSParams(lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)),
-            )
-        p = np.asarray(p)
-        prob_m = np.asarray(prob_m)
-        prob_u = np.asarray(prob_u)
-
-        il, ir = pairs.idx_l, pairs.idx_r
+            p, prob_m, prob_u = self._score_batched(G, params_dev)
         uid = settings["unique_id_column_name"]
         cols: dict[str, np.ndarray] = {"match_probability": p}
 

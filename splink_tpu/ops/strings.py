@@ -140,9 +140,30 @@ def exact_equal_single(s1, s2, l1, l2):
 
 # Batched versions: vmap over the leading pair axis.
 jaro_winkler_vmapped = jax.vmap(jaro_winkler_single, in_axes=(0, 0, 0, 0, None, None))
-levenshtein = jax.vmap(levenshtein_single)
-levenshtein_ratio = jax.vmap(levenshtein_ratio_single)
+levenshtein_vmapped = jax.vmap(levenshtein_single)
+levenshtein_ratio_vmapped = jax.vmap(levenshtein_ratio_single)
 exact_equal = jax.vmap(exact_equal_single)
+
+
+def levenshtein(s1, s2, l1, l2):
+    """Batched Levenshtein distance: Pallas lane-tile kernel on TPU for
+    ASCII fixed-width columns, vmapped row-DP elsewhere."""
+    from .strings_pallas import levenshtein_pallas, pallas_supported
+
+    if pallas_supported(s1):
+        return levenshtein_pallas(s1, s2, l1, l2).astype(jnp.int32)
+    return levenshtein_vmapped(s1, s2, l1, l2)
+
+
+def levenshtein_ratio(s1, s2, l1, l2):
+    """levenshtein / mean length, batched with kernel dispatch."""
+    from .strings_pallas import levenshtein_pallas, pallas_supported
+
+    if not pallas_supported(s1):
+        return levenshtein_ratio_vmapped(s1, s2, l1, l2)
+    d = levenshtein_pallas(s1, s2, l1, l2)
+    denom = (_f(l1) + _f(l2)) / 2.0
+    return jnp.where(denom > 0, d / denom, 0.0)
 
 
 def jaro_winkler(s1, s2, l1, l2, prefix_scale=0.1, boost_threshold=0.0):

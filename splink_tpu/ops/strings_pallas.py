@@ -153,6 +153,88 @@ def jaro_winkler_pallas(
     return out[0, :B]
 
 
+def _shift_down(x, s, fill):
+    """Shift (L, T) rows down by s sublanes, filling the top with `fill`."""
+    return jnp.concatenate(
+        [jnp.full((s, x.shape[1]), fill, x.dtype), x[:-s, :]], axis=0
+    )
+
+
+def _lev_kernel(s1_ref, s2_ref, l1_ref, l2_ref, out_ref, *, L):
+    """Levenshtein row DP, pairs on lanes, DP row (L+1) on sublanes.
+
+    Row recurrence (strings.levenshtein_single): the insertion chain is a
+    prefix-min, computed here by log-step sublane shifts:
+        new[j] = j + cummin_{k<=j}(min(prev[k] + 1, prev[k-1] + cost[k]) - k)
+    """
+    s1 = s1_ref[:]  # (L, T)
+    s2 = s2_ref[:]
+    l1 = l1_ref[:]  # (1, T)
+    l2 = l2_ref[:]
+    T = s1.shape[1]
+    big = 1e9
+
+    idx = jax.lax.broadcasted_iota(jnp.float32, (L + 1, T), 0)  # 0..L
+    row = idx  # row 0: distance from empty prefix
+    for i in range(L):
+        ch = s1[i : i + 1, :]
+        cost = (s2 != ch).astype(jnp.float32)  # (L, T) over j-1 positions
+        # candidates at positions 1..L; position 0 is the deletion base i+1
+        substitute = row[:-1, :] + cost
+        delete = row[1:, :] + 1.0
+        t = jnp.concatenate(
+            [jnp.full((1, T), i + 1.0), jnp.minimum(substitute, delete)], axis=0
+        )
+        m = t - idx
+        s = 1
+        while s <= L:
+            m = jnp.minimum(m, _shift_down(m, s, big))
+            s *= 2
+        new_row = idx + m
+        row = jnp.where(i < l1, new_row, row)
+
+    # read entry l2 of the final row, per lane
+    sel = (idx == l2).astype(jnp.float32)
+    out_ref[:] = jnp.sum(row * sel, axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def levenshtein_pallas(s1, s2, l1, l2, interpret=False):
+    """Batched Levenshtein distance via the Pallas lane-tile kernel.
+
+    Args: s1, s2 (B, L) integer character codes; l1, l2 (B,) lengths.
+    Returns (B,) float32 distances.
+    """
+    B, L = s1.shape
+    T = min(LANE_TILE, max(B, 1))
+    pad = (-B) % T
+    if pad:
+        zf = lambda a: jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))  # noqa: E731
+        s1, s2, l1, l2 = zf(s1), zf(s2), zf(l1), zf(l2)
+    n = s1.shape[0]
+
+    s1T = s1.astype(jnp.float32).T
+    s2T = s2.astype(jnp.float32).T
+    l1r = l1.astype(jnp.float32).reshape(1, n)
+    l2r = l2.astype(jnp.float32).reshape(1, n)
+
+    col = lambda i: (0, i)  # noqa: E731
+    out = pl.pallas_call(
+        functools.partial(_lev_kernel, L=L),
+        grid=(n // T,),
+        in_specs=[
+            pl.BlockSpec((L, T), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, T), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), col, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, T), col, memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
+        interpret=interpret,
+    )(s1T, s2T, l1r, l2r)
+    return out[0, :B]
+
+
 def pallas_supported(s1) -> bool:
     """Whether the Pallas path handles this input on the current backend."""
     return (

@@ -52,6 +52,7 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "backend",
     "mesh",
     "pair_batch_size",
+    "max_resident_pairs",
     "float64",
 ]
 

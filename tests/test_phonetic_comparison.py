@@ -98,6 +98,37 @@ def test_case_expression_translation():
         parse_case_expression(expr3, 4)  # level shape mismatch
 
 
+def test_custom_name_case_expression_dmetaphone():
+    """The reference's UDF shape: custom_name + case_expression with
+    Dmetaphone() calls must build the derived column and compute gammas."""
+    from splink_tpu import Splink
+
+    df = _df()
+    settings = {
+        "link_type": "dedupe_only",
+        "blocking_rules": [],
+        "comparison_columns": [
+            {
+                "custom_name": "surname_dm",
+                "custom_columns_used": ["surname"],
+                "num_levels": 2,
+                "case_expression": (
+                    "case when surname_l is null or surname_r is null then -1 "
+                    "when Dmetaphone(surname_l) = Dmetaphone(surname_r) then 1 "
+                    "else 0 end"
+                ),
+            }
+        ],
+    }
+    linker = Splink(settings, df=df)
+    df_e = linker.manually_apply_fellegi_sunter_weights()
+    g = df_e.set_index(["unique_id_l", "unique_id_r"]).gamma_surname_dm
+    assert g[(0, 1)] == 1  # smith/smyth
+    assert g[(0, 2)] == 0  # smith/taylor
+    assert (df_e.unique_id_r == 4).sum() + (df_e.unique_id_l == 4).sum() > 0
+    assert (g[[k for k in g.index if 4 in k]] == -1).all()  # null row
+
+
 def test_linker_end_to_end_with_phonetic_column():
     from splink_tpu import Splink
 

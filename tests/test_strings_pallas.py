@@ -63,6 +63,33 @@ def test_matches_oracle_random(rng):
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+def test_levenshtein_matches_oracle(rng):
+    from splink_tpu.ops.strings_pallas import levenshtein_pallas
+
+    from conftest import py_levenshtein
+
+    n, width = 700, 8
+    letters = np.array(list("abcde"))
+    strs1 = ["".join(letters[rng.integers(0, 5, rng.integers(0, 9))]) for _ in range(n)]
+    strs2 = ["".join(letters[rng.integers(0, 5, rng.integers(0, 9))]) for _ in range(n)]
+    b1, l1 = _encode(strs1, width)
+    b2, l2 = _encode(strs2, width)
+    got = np.asarray(levenshtein_pallas(b1, b2, l1, l2, interpret=True))
+    want = np.array([py_levenshtein(a, b) for a, b in zip(strs1, strs2)], np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_levenshtein_edge_cases():
+    from splink_tpu.ops.strings_pallas import levenshtein_pallas
+
+    cases = [("", ""), ("", "abc"), ("abc", ""), ("kitten", "sitting"),
+             ("flaw", "lawn"), ("abcdefgh", "abcdefgh")]
+    b1, l1 = _encode([a for a, _ in cases], 8)
+    b2, l2 = _encode([b for _, b in cases], 8)
+    got = np.asarray(levenshtein_pallas(b1, b2, l1, l2, interpret=True))
+    assert got.tolist() == [0, 3, 3, 3, 2, 0]
+
+
 def test_matches_vmapped_kernel(rng):
     from splink_tpu.ops.strings import jaro_winkler_vmapped
 
