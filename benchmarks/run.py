@@ -149,68 +149,40 @@ def config_4(scale):
 
 
 def config_5(scale):
-    """Streamed EM: gamma batches too large to keep as one resident array."""
-    import jax.numpy as jnp
-
-    from splink_tpu.blocking import block_using_rules
-    from splink_tpu.data import encode_table
-    from splink_tpu.em import score_pairs
-    from splink_tpu.gammas import GammaProgram
-    from splink_tpu.models.fellegi_sunter import FSParams
-    from splink_tpu.parallel.streaming import run_em_streamed
-    from splink_tpu.params import Params
-    from splink_tpu.settings import complete_settings_dict
+    """Streamed regime: gammas computed once into host RAM, EM accumulates
+    sufficient statistics over host->device micro-batches, and scored output
+    is emitted in chunks — the linker's production path for pair sets above
+    max_resident_pairs."""
+    from splink_tpu import Splink
 
     n = max(int(20_000_000 * scale), 1000)  # pair count scales with blocking density
     df = make_people(n, seed=5)
-    settings = complete_settings_dict(
-        {
-            "link_type": "dedupe_only",
-            "comparison_columns": [
-                {"col_name": "first_name", "num_levels": 3},
-                {"col_name": "surname", "num_levels": 3},
-                {"col_name": "city", "comparison": {"kind": "exact"}},
-            ],
-            "blocking_rules": ["l.dob = r.dob", "l.postcode = r.postcode"],
-        }
-    )
     t0 = time.perf_counter()
-    table = encode_table(df, settings)
-    pairs = block_using_rules(settings, table)
-    program = GammaProgram(settings, table)
-    params = Params(settings, complete=False)
-    lam0, m0, u0, _ = params.to_arrays(dtype=np.float32)
-    init = FSParams(jnp.asarray(lam0), jnp.asarray(m0), jnp.asarray(u0))
-
-    batch = 1 << 20
-
-    def batches():
-        for s in range(0, pairs.n_pairs, batch):
-            yield program.compute(
-                pairs.idx_l[s : s + batch], pairs.idx_r[s : s + batch]
-            )
-
-    final, hist, n_updates, converged = run_em_streamed(
-        batches,
-        init,
-        max_iterations=int(settings["max_iterations"]),
-        max_levels=3,
-        em_convergence=settings["em_convergence"],
-    )
-    # final scoring pass, streamed
+    settings = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {"col_name": "surname", "num_levels": 3},
+            {"col_name": "city", "comparison": {"kind": "exact"}},
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.postcode = r.postcode"],
+        "max_resident_pairs": 1024,  # force the streamed regime at any size
+        "retain_matching_columns": False,
+        "retain_intermediate_calculation_columns": False,
+    }
+    linker = Splink(settings, df=df)
     scored = 0
-    for G in batches():
-        p = score_pairs(jnp.asarray(G), final)
-        scored += len(p)
+    for chunk in linker.stream_scored_comparisons():
+        scored += len(chunk)
     elapsed = time.perf_counter() - t0
     return {
         "rows": len(df),
-        "pairs": pairs.n_pairs,
+        "pairs": scored,
         "seconds": round(elapsed, 3),
         "pairs_per_sec": round(scored / elapsed),
-        "em_iterations": n_updates,
-        "converged": converged,
-        "lambda": round(float(final.lam), 5),
+        "em_iterations": len(linker.params.param_history),
+        "converged": bool(linker.params.is_converged()),
+        "lambda": round(linker.params.params["λ"], 5),
         "streamed": True,
     }
 
@@ -222,7 +194,18 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, required=True, choices=sorted(CONFIGS))
     ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument(
+        "--platform",
+        default=None,
+        help="Force a jax platform (e.g. cpu). The environment may pre-import "
+        "jax with a default platform, so the JAX_PLATFORMS env var alone is "
+        "not reliable — this flag uses jax.config.update before first use.",
+    )
     args = ap.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     out = CONFIGS[args.config](args.scale)
     out["config"] = args.config
     out["scale"] = args.scale

@@ -13,12 +13,14 @@ Spark SQL.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Callable
 
 import jax.numpy as jnp
 import numpy as np
 
 from .blocking import PairIndex, block_using_rules
+from .check_types import check_types
 from .data import EncodedTable, concat_tables, encode_table
 from .em import run_em, score_pairs_with_intermediates
 from .gammas import GammaProgram, register_comparison  # noqa: F401 (re-export)
@@ -37,6 +39,7 @@ except ImportError:  # pragma: no cover
 
 
 class Splink:
+    @check_types
     def __init__(
         self,
         settings: dict,
@@ -288,7 +291,8 @@ class Splink:
             retain_adjustment_columns=True,
         )
 
-    def save_model_as_json(self, path: str, overwrite: bool = False):
+    @check_types
+    def save_model_as_json(self, path: str | os.PathLike, overwrite: bool = False):
         self.params.save_params_to_json_file(path, overwrite=overwrite)
 
     # ------------------------------------------------------------------
@@ -370,8 +374,9 @@ class Splink:
         return pd.DataFrame(cols)
 
 
+@check_types
 def load_from_json(
-    path: str,
+    path: str | os.PathLike,
     df=None,
     df_l=None,
     df_r=None,

@@ -21,6 +21,7 @@ import warnings
 import numpy as np
 
 from .params import Params
+from .check_types import check_types
 
 
 def bayes_combine(probs: list[np.ndarray]) -> np.ndarray:
@@ -48,12 +49,10 @@ def compute_token_adjustment(values_l, values_r, match_probability, base_lambda)
     values_r = np.asarray(values_r, dtype=object)
     p = np.asarray(match_probability, dtype=np.float64)
 
-    agree = np.array(
-        [
-            (a is not None and not pd.isna(a)) and a == b
-            for a, b in zip(values_l, values_r)
-        ]
-    )
+    sl, sr = pd.Series(values_l), pd.Series(values_r)
+    agree = (
+        sl.notna() & sr.notna() & (sl == sr).fillna(False)
+    ).to_numpy(dtype=bool)
     adj = np.full(len(p), 0.5)
     if not agree.any():
         return adj, {}
@@ -71,6 +70,7 @@ def compute_token_adjustment(values_l, values_r, match_probability, base_lambda)
     return adj, lookup
 
 
+@check_types
 def make_adjustment_for_term_frequencies(
     df_e,
     params: Params,

@@ -13,6 +13,8 @@ import json
 import warnings
 from importlib import resources
 
+from .check_types import check_types
+
 try:
     from jsonschema import ValidationError, validate
 
@@ -36,6 +38,7 @@ def get_schema() -> dict:
     return _SCHEMA_CACHE
 
 
+@check_types
 def validate_settings(settings_dict: dict) -> None:
     """Raise ValidationError with a readable message if settings are invalid."""
     if not isinstance(settings_dict, dict):
