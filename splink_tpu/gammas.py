@@ -20,6 +20,7 @@ which is free VPU work compared to extra HBM gather passes.
 from __future__ import annotations
 
 import functools
+import logging
 from dataclasses import dataclass
 
 import jax
@@ -38,6 +39,8 @@ from .ops.gamma import (
     bucket_similarity,
 )
 from .settings import comparison_column_name
+
+logger = logging.getLogger("splink_tpu")
 
 DEFAULT_PAIR_BATCH = 1 << 20
 
@@ -423,6 +426,14 @@ class GammaProgram:
             return jnp.stack(gammas, axis=1)
 
         self._gamma_batch = _gamma_batch
+
+        # The compiled-artifact analogue of the reference logging its
+        # generated SQL at debug level (/root/reference/splink/gammas.py:120).
+        if logger.isEnabledFor(logging.DEBUG):
+            probe = jnp.zeros(8, jnp.int32)
+            logger.debug(
+                "gamma program jaxpr:\n%s", jax.make_jaxpr(_gamma_batch)(probe, probe)
+            )
 
     def compute(
         self, idx_l: np.ndarray, idx_r: np.ndarray, batch_size: int = DEFAULT_PAIR_BATCH

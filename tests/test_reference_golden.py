@@ -1,0 +1,155 @@
+"""Golden-number parity with the reference's sqlite test tier.
+
+Reproduces the reference's fixture scenario exactly — 7 rows, blocking on
+mob then surname, a 2-level exact mob comparison and a 3-level
+exact/first-3-chars surname comparison (/root/reference/tests/conftest.py:
+98-187) — and asserts the numbers its tests assert:
+
+  * E-step match probabilities  (/root/reference/tests/test_expectation.py:58-66)
+  * M-step new lambda           (/root/reference/tests/test_maximisation.py:16)
+  * M-step new pi table         (/root/reference/tests/test_maximisation.py:21-27)
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+import splink_tpu
+from splink_tpu.blocking import block_using_rules
+from splink_tpu.data import encode_table
+from splink_tpu.gammas import GammaProgram
+from splink_tpu.models.fellegi_sunter import (
+    FSParams,
+    match_probability,
+    sufficient_stats,
+    update_params,
+)
+from splink_tpu.ops.gamma import apply_null
+from splink_tpu.settings import complete_settings_dict
+
+
+def _surname_exact_or_prefix3(ctx, col_settings):
+    """The reference fixture's surname CASE: exact -> 2, first-3-chars -> 1
+    (substr semantics: shorter strings compare their zero-padded prefix)."""
+    pc = ctx.col("surname")
+    exact = pc.tok_l == pc.tok_r
+    prefix3 = jnp.all(pc.chars_l[:, :3] == pc.chars_r[:, :3], axis=1)
+    gamma = jnp.where(
+        exact, jnp.int8(2), jnp.where(prefix3, jnp.int8(1), jnp.int8(0))
+    )
+    return apply_null(gamma, pc.null)
+
+
+@pytest.fixture
+def scenario():
+    splink_tpu.register_comparison("surname_exact_or_prefix3", _surname_exact_or_prefix3)
+    df = pd.DataFrame(
+        {
+            "unique_id": [1, 2, 3, 4, 5, 6, 7],
+            "mob": [10, 10, 10, 7, 8, 8, 8],
+            "surname": ["Linacre", "Linacre", "Linacer", "Smith", "Smith", "Smith", "Jones"],
+        }
+    )
+    settings = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "proportion_of_matches": 0.4,
+            "comparison_columns": [
+                {
+                    "col_name": "mob",
+                    "num_levels": 2,
+                    "comparison": {"kind": "exact"},
+                    "m_probabilities": [0.1, 0.9],
+                    "u_probabilities": [0.8, 0.2],
+                },
+                {
+                    "col_name": "surname",
+                    "num_levels": 3,
+                    "comparison": {"kind": "custom", "fn": "surname_exact_or_prefix3"},
+                    "m_probabilities": [0.1, 0.2, 0.7],
+                    "u_probabilities": [0.5, 0.25, 0.25],
+                },
+            ],
+            "blocking_rules": ["l.mob = r.mob", "l.surname = r.surname"],
+        }
+    )
+    table = encode_table(df, settings)
+    pairs = block_using_rules(settings, table)
+    order = np.lexsort((table.unique_id[pairs.idx_r], table.unique_id[pairs.idx_l]))
+    idx_l, idx_r = pairs.idx_l[order], pairs.idx_r[order]
+    G = GammaProgram(settings, table, float_dtype=jnp.float64).compute(idx_l, idx_r)
+    params = FSParams(
+        lam=jnp.float64(0.4),
+        m=jnp.asarray([[0.1, 0.9, 0.0], [0.1, 0.2, 0.7]], jnp.float64),
+        u=jnp.asarray([[0.8, 0.2, 0.0], [0.5, 0.25, 0.25]], jnp.float64),
+    )
+    return table, (idx_l, idx_r), G, params
+
+
+def test_pair_set_matches_reference(scenario):
+    table, (idx_l, idx_r), G, _ = scenario
+    got = list(zip(table.unique_id[idx_l], table.unique_id[idx_r]))
+    assert got == [(1, 2), (1, 3), (2, 3), (4, 5), (4, 6), (5, 6), (5, 7), (6, 7)]
+
+
+def test_expectation_step_matches_reference(scenario):
+    _, _, G, params = scenario
+    p = np.asarray(match_probability(jnp.asarray(G), params))
+    # /root/reference/tests/test_expectation.py:58-66, reference pair order
+    # (1,2),(1,3),(2,3),(4,5),(4,6),(5,6),(5,7),(6,7)
+    correct = [
+        0.893617021,  # (1,2) mob eq, surname eq
+        0.705882353,  # (1,3) mob eq, surname prefix
+        0.705882353,  # (2,3)
+        0.189189189,  # (4,5) surname eq, mob diff
+        0.189189189,  # (4,6)
+        0.893617021,  # (5,6) both eq
+        0.375,        # (5,7) mob eq, surname diff
+        0.375,        # (6,7)
+    ]
+    np.testing.assert_allclose(p, correct, rtol=1e-6)
+
+
+def test_maximisation_step_matches_reference(scenario):
+    _, _, G, params = scenario
+    p = match_probability(jnp.asarray(G), params)
+    stats = sufficient_stats(jnp.asarray(G), p, max_levels=3)
+    new = update_params(stats)
+    # /root/reference/tests/test_maximisation.py:16
+    assert float(new.lam) == pytest.approx(0.540922141)
+    # /root/reference/tests/test_maximisation.py:21-27
+    m, u = np.asarray(new.m), np.asarray(new.u)
+    assert m[0, 0] == pytest.approx(0.087438272)
+    assert u[0, 0] == pytest.approx(0.441543191)
+    assert m[0, 1] == pytest.approx(0.912561728)
+    assert u[0, 1] == pytest.approx(0.558456809)
+    assert m[1, 0] == pytest.approx(0.173315146)
+    assert u[1, 0] == pytest.approx(0.340356209)
+    assert m[1, 1] == pytest.approx(0.326240275)
+    assert u[1, 1] == pytest.approx(0.160167628)
+    assert m[1, 2] == pytest.approx(0.500444578)
+    assert u[1, 2] == pytest.approx(0.499476163)
+
+
+def test_second_iteration_matches_reference(scenario):
+    """Two fused EM updates against the reference's iteration-2 goldens
+    (/root/reference/tests/test_iterate.py:10-41)."""
+    from splink_tpu.em import run_em
+
+    _, _, G, params = scenario
+    result = run_em(
+        jnp.asarray(G), params, max_iterations=2, max_levels=3, em_convergence=0.0
+    )
+    assert float(result.params.lam) == pytest.approx(0.534993426)
+    m, u = np.asarray(result.params.m), np.asarray(result.params.u)
+    assert m[0, 0] == pytest.approx(0.088546179)
+    assert u[0, 0] == pytest.approx(0.435753788)
+    assert m[0, 1] == pytest.approx(0.911453821)
+    assert u[0, 1] == pytest.approx(0.564246212)
+    assert m[1, 0] == pytest.approx(0.231340865)
+    assert u[1, 0] == pytest.approx(0.27146747)
+    assert m[1, 1] == pytest.approx(0.372351177)
+    assert u[1, 1] == pytest.approx(0.109234086)
+    assert m[1, 2] == pytest.approx(0.396307958)
+    assert u[1, 2] == pytest.approx(0.619298443)
