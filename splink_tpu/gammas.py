@@ -39,6 +39,7 @@ from .ops.gamma import (
     bucket_similarity,
 )
 from .settings import comparison_column_name
+from .utils.logging_utils import log_jaxpr
 
 logger = logging.getLogger("splink_tpu")
 
@@ -429,11 +430,8 @@ class GammaProgram:
 
         # The compiled-artifact analogue of the reference logging its
         # generated SQL at debug level (/root/reference/splink/gammas.py:120).
-        if logger.isEnabledFor(logging.DEBUG):
-            probe = jnp.zeros(8, jnp.int32)
-            logger.debug(
-                "gamma program jaxpr:\n%s", jax.make_jaxpr(_gamma_batch)(probe, probe)
-            )
+        probe = jnp.zeros(8, jnp.int32)
+        log_jaxpr("gamma_program", _gamma_batch, probe, probe)
 
     def compute(
         self, idx_l: np.ndarray, idx_r: np.ndarray, batch_size: int = DEFAULT_PAIR_BATCH

@@ -1,0 +1,120 @@
+"""Observability layer: intuition report, charts, block diagnostics.
+
+Parity targets: intuition narrative (/root/reference/splink/intuition.py:32-92),
+chart methods + combined HTML (/root/reference/splink/params.py:358-484,
+chart_definitions.py:248-277), get_largest_blocks
+(/root/reference/splink/comparison_evaluation.py:12-34).
+"""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu import Splink
+from splink_tpu.comparison_evaluation import get_largest_blocks
+from splink_tpu.intuition import adjustment_factor_chart, intuition_report
+
+
+@pytest.fixture
+def trained_linker():
+    rng = np.random.default_rng(11)
+    firsts = np.array(["amelia", "oliver", "isla", "george"])
+    n = 120
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "first_name": firsts[rng.integers(0, 4, n)],
+            "surname": np.array(["smith", "jones", "taylor"])[rng.integers(0, 3, n)],
+            "city": [f"c{i % 3}" for i in range(n)],
+        }
+    )
+    settings = {
+        "link_type": "dedupe_only",
+        "blocking_rules": ["l.city = r.city"],
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 2, "comparison": {"kind": "exact"}},
+            {"col_name": "surname", "num_levels": 2, "comparison": {"kind": "exact"}},
+        ],
+        "retain_intermediate_calculation_columns": True,
+        "retain_matching_columns": True,
+        "max_iterations": 5,
+    }
+    linker = Splink(settings, df=df)
+    df_e = linker.get_scored_comparisons(compute_ll=True)
+    return linker, df_e
+
+
+def test_intuition_report_narrative(trained_linker):
+    linker, df_e = trained_linker
+    row = df_e.iloc[0]
+    text = intuition_report(row, linker.params)
+    assert "Initial probability of match (prior)" in text
+    assert "Comparison of first_name" in text
+    assert "Comparison of surname" in text
+    assert "Adjustment factor = m/(m + u)" in text
+    # the narrative's final probability equals the scored probability
+    final = float(text.strip().rsplit("=", 1)[1])
+    assert final == pytest.approx(float(row["match_probability"]), abs=1e-4)
+
+
+def test_intuition_report_requires_intermediates(trained_linker):
+    linker, df_e = trained_linker
+    row = df_e.iloc[0].drop(labels=["prob_gamma_first_name_match"])
+    with pytest.raises(KeyError, match="retain_intermediate_calculation_columns"):
+        intuition_report(row, linker.params)
+
+
+def test_adjustment_factor_chart(trained_linker):
+    linker, df_e = trained_linker
+    spec = adjustment_factor_chart(df_e.iloc[0], linker.params)
+    rows = spec["data"]["values"]
+    assert {r["col_name"] for r in rows} == {"first_name", "surname"}
+    for r in rows:
+        assert abs(r["normalised"]) <= 0.5
+        assert r["value"] == pytest.approx(r["normalised"] + 0.5)
+
+
+def test_params_charts_and_html(tmp_path, trained_linker):
+    linker, _ = trained_linker
+    p = linker.params
+    for method in (
+        "pi_iteration_chart",
+        "lambda_iteration_chart",
+        "ll_iteration_chart",
+        "probability_distribution_chart",
+        "adjustment_factor_chart",
+    ):
+        spec = getattr(p, method)()
+        assert isinstance(spec, dict) and "data" in spec
+        json.dumps(spec)  # must be JSON-serialisable
+
+    out = tmp_path / "charts.html"
+    p.all_charts_write_html_file(str(out))
+    html = out.read_text()
+    assert "vega" in html.lower()
+    with pytest.raises(ValueError):  # overwrite guard
+        p.all_charts_write_html_file(str(out))
+    p.all_charts_write_html_file(str(out), overwrite=True)
+
+
+def test_get_largest_blocks():
+    df = pd.DataFrame(
+        {
+            "first_name": ["a", "a", "a", "b", "b", None, "c"],
+            "surname": ["x"] * 7,
+        }
+    )
+    top = get_largest_blocks("l.first_name = r.first_name", df, limit=2)
+    assert top.iloc[0]["first_name"] == "a"
+    assert top.iloc[0]["count"] == 3
+    assert len(top) == 2
+
+    two_col = get_largest_blocks(
+        "l.first_name = r.first_name and l.surname = r.surname", df
+    )
+    assert list(two_col.columns) == ["first_name", "surname", "count"]
+
+    with pytest.raises(ValueError):
+        get_largest_blocks("something invalid", df)
