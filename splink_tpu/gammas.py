@@ -441,11 +441,29 @@ class GammaProgram:
         The final short batch is padded to ``batch_size`` so every call hits
         the same compiled program (no shape-driven recompiles).
         """
+        return self.compute_with_device(idx_l, idx_r, batch_size)[0]
+
+    def compute_with_device(
+        self,
+        idx_l: np.ndarray,
+        idx_r: np.ndarray,
+        batch_size: int = DEFAULT_PAIR_BATCH,
+        keep_device: bool = False,
+    ):
+        """(host gamma matrix, device gamma matrix | None).
+
+        With ``keep_device`` the per-batch device outputs are also
+        concatenated on device and returned, so a resident-EM caller can feed
+        them straight into the EM loop without re-uploading the matrix it
+        just downloaded (a full extra round-trip over the host<->TPU link).
+        """
         n = len(idx_l)
         if n == 0:
-            return np.zeros((0, self.n_cols), np.int8)
+            host = np.zeros((0, self.n_cols), np.int8)
+            return host, (jnp.asarray(host) if keep_device else None)
         batch_size = min(batch_size, max(n, 1))
         out = np.empty((n, self.n_cols), np.int8)
+        device_batches = []
         for start in range(0, n, batch_size):
             stop = min(start + batch_size, n)
             bl = idx_l[start:stop]
@@ -455,5 +473,14 @@ class GammaProgram:
                 bl = np.concatenate([bl, np.zeros(pad, bl.dtype)])
                 br = np.concatenate([br, np.zeros(pad, br.dtype)])
             G = self._gamma_batch(jnp.asarray(bl), jnp.asarray(br))
+            if keep_device:
+                device_batches.append(G[: stop - start])
             out[start:stop] = np.asarray(G)[: stop - start]
-        return out
+        dev = None
+        if keep_device:
+            dev = (
+                device_batches[0]
+                if len(device_batches) == 1
+                else jnp.concatenate(device_batches)
+            )
+        return out, dev

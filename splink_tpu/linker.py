@@ -72,6 +72,7 @@ class Splink:
         self._table: EncodedTable | None = None
         self._pairs: PairIndex | None = None
         self._G: np.ndarray | None = None
+        self._G_dev = None  # device-resident copy (resident regime only)
 
     # ------------------------------------------------------------------
 
@@ -121,12 +122,20 @@ class Splink:
         if self._G is None:
             table = self._ensure_encoded()
             pairs = self._ensure_pairs()
+            # In the resident regime (and without a mesh, which shards its
+            # own upload), keep the device-side gamma batches so EM doesn't
+            # re-upload the matrix that was just computed there.
+            keep = (
+                pairs.n_pairs <= int(self.settings["max_resident_pairs"])
+                and mesh_from_settings(self.settings) is None
+            )
             with StageTimer("gammas"):
                 program = GammaProgram(self.settings, table)
-                self._G = program.compute(
+                self._G, self._G_dev = program.compute_with_device(
                     pairs.idx_l,
                     pairs.idx_r,
                     batch_size=self.settings["pair_batch_size"],
+                    keep_device=keep,
                 )
         return self._G
 
@@ -138,7 +147,9 @@ class Splink:
         """Score using the m/u values in the settings, without running EM
         (/root/reference/splink/__init__.py:111-119)."""
         G = self._ensure_gammas()
-        return self._build_df_e(G)
+        df_e = self._build_df_e(G)
+        self._G_dev = None  # release the HBM copy once scoring is done
+        return df_e
 
     def get_scored_comparisons(self, compute_ll: bool = False):
         """Estimate parameters by EM and return scored comparisons
@@ -152,7 +163,9 @@ class Splink:
         """
         G = self._ensure_gammas()
         self._run_em(G, compute_ll)
-        return self._build_df_e(G)
+        df_e = self._build_df_e(G)
+        self._G_dev = None  # release the HBM copy once EM + scoring are done
+        return df_e
 
     def _run_em(self, G: np.ndarray, compute_ll: bool) -> None:
         """Dispatch EM to the resident or streamed regime by pair count."""
@@ -168,10 +181,11 @@ class Splink:
 
         mesh = mesh_from_settings(self.settings)
         weights = None
-        G_dev = jnp.asarray(G)
         if mesh is not None:
             G_dev, weights = shard_pairs(mesh, G)
             weights = weights.astype(dtype)
+        else:
+            G_dev = self._G_dev if self._G_dev is not None else jnp.asarray(G)
 
         init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
         max_iterations = int(self.settings["max_iterations"])
@@ -306,19 +320,19 @@ class Splink:
         n = len(G)
         batch = min(int(self.settings["pair_batch_size"]), max(n, 1))
         n_cols = G.shape[1] if G.ndim == 2 else 0
+        # Device copy is reusable only when scoring the exact same full matrix
+        src_dev = self._G_dev if self._G_dev is not None and G is self._G else None
         p = np.empty(n, np.float32)
         prob_m = np.empty((n, n_cols), np.float32)
         prob_u = np.empty((n, n_cols), np.float32)
         for s in range(0, n, batch):
             stop = min(s + batch, n)
-            Gb = G[s:stop]
+            Gb = src_dev[s:stop] if src_dev is not None else jnp.asarray(G[s:stop])
             if stop - s < batch:
-                Gb = np.concatenate(
-                    [Gb, np.zeros((batch - (stop - s), n_cols), G.dtype)]
+                Gb = jnp.concatenate(
+                    [Gb, jnp.zeros((batch - (stop - s), n_cols), Gb.dtype)]
                 )
-            pb, pmb, pub = score_pairs_with_intermediates(
-                jnp.asarray(Gb), params_dev
-            )
+            pb, pmb, pub = score_pairs_with_intermediates(Gb, params_dev)
             p[s:stop] = np.asarray(pb)[: stop - s]
             prob_m[s:stop] = np.asarray(pmb)[: stop - s]
             prob_u[s:stop] = np.asarray(pub)[: stop - s]
