@@ -49,7 +49,10 @@ def _jw_kernel(s1_ref, s2_ref, l1_ref, l2_ref, out_ref, *, L, prefix_scale,
     l2 = l2_ref[:]
 
     incl = _tril(L, strict=False)  # inclusive prefix-count operator
-    iota = jax.lax.broadcasted_iota(jnp.float32, (L, s1.shape[1]), 0)
+    # Mosaic requires integer iota; widen to f32 afterwards.
+    iota = jax.lax.broadcasted_iota(jnp.int32, (L, s1.shape[1]), 0).astype(
+        jnp.float32
+    )
     valid2 = iota < l2
     maxlen = jnp.maximum(l1, l2)
     window = jnp.maximum(jnp.floor(maxlen * 0.5) - 1.0, 0.0)
@@ -154,37 +157,43 @@ def jaro_winkler_pallas(
 
 
 def _shift_down(x, s, fill):
-    """Shift (L, T) rows down by s sublanes, filling the top with `fill`."""
-    return jnp.concatenate(
-        [jnp.full((s, x.shape[1]), fill, x.dtype), x[:-s, :]], axis=0
-    )
+    """Shift rows down by s sublanes, filling the top with `fill`.
+
+    Mosaic rejects jnp.concatenate inside unrolled loops (the round-1 kernel
+    SIGABRTed the TPU compiler), so this uses a circular roll plus an iota
+    mask, which lowers to a plain VPU shift.
+    """
+    rolled = pltpu.roll(x, shift=s, axis=0)
+    ridx = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    return jnp.where(ridx < s, fill, rolled)
 
 
-def _lev_kernel(s1_ref, s2_ref, l1_ref, l2_ref, out_ref, *, L):
+def _lev_kernel(s1_ref, s2p_ref, l1_ref, l2_ref, out_ref, *, L):
     """Levenshtein row DP, pairs on lanes, DP row (L+1) on sublanes.
 
     Row recurrence (strings.levenshtein_single): the insertion chain is a
     prefix-min, computed here by log-step sublane shifts:
         new[j] = j + cummin_{k<=j}(min(prev[k] + 1, prev[k-1] + cost[k]) - k)
+
+    s2p arrives pre-shifted from the wrapper as (L+1, T) with a sentinel in
+    row 0 (s2p[j] = s2[j-1]), so the kernel body is concatenate-free.
     """
     s1 = s1_ref[:]  # (L, T)
-    s2 = s2_ref[:]
+    s2p = s2p_ref[:]  # (L+1, T), row 0 = sentinel
     l1 = l1_ref[:]  # (1, T)
     l2 = l2_ref[:]
-    T = s1.shape[1]
     big = 1e9
 
-    idx = jax.lax.broadcasted_iota(jnp.float32, (L + 1, T), 0)  # 0..L
+    idx = jax.lax.broadcasted_iota(jnp.int32, (L + 1, s1.shape[1]), 0).astype(
+        jnp.float32
+    )  # 0..L
     row = idx  # row 0: distance from empty prefix
     for i in range(L):
         ch = s1[i : i + 1, :]
-        cost = (s2 != ch).astype(jnp.float32)  # (L, T) over j-1 positions
-        # candidates at positions 1..L; position 0 is the deletion base i+1
-        substitute = row[:-1, :] + cost
-        delete = row[1:, :] + 1.0
-        t = jnp.concatenate(
-            [jnp.full((1, T), i + 1.0), jnp.minimum(substitute, delete)], axis=0
-        )
+        cost = (s2p != ch).astype(jnp.float32)  # (L+1, T); cost[0] unused
+        row_prev = _shift_down(row, 1, big)  # row[j-1], big at j=0
+        # position 0 resolves to the deletion base row[0]+1 == i+1
+        t = jnp.minimum(row_prev + cost, row + 1.0)
         m = t - idx
         s = 1
         while s <= L:
@@ -214,7 +223,11 @@ def levenshtein_pallas(s1, s2, l1, l2, interpret=False):
     n = s1.shape[0]
 
     s1T = s1.astype(jnp.float32).T
-    s2T = s2.astype(jnp.float32).T
+    # pre-shift s2 on the host side of the kernel: s2p[j] = s2[j-1], row 0 a
+    # sentinel no real character code equals (codes are non-negative)
+    s2pT = jnp.concatenate(
+        [jnp.full((1, n), -1.0, jnp.float32), s2.astype(jnp.float32).T], axis=0
+    )
     l1r = l1.astype(jnp.float32).reshape(1, n)
     l2r = l2.astype(jnp.float32).reshape(1, n)
 
@@ -224,14 +237,14 @@ def levenshtein_pallas(s1, s2, l1, l2, interpret=False):
         grid=(n // T,),
         in_specs=[
             pl.BlockSpec((L, T), col, memory_space=pltpu.VMEM),
-            pl.BlockSpec((L, T), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((L + 1, T), col, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, T), col, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, T), col, memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, T), col, memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
         interpret=interpret,
-    )(s1T, s2T, l1r, l2r)
+    )(s1T, s2pT, l1r, l2r)
     return out[0, :B]
 
 
