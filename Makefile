@@ -1,0 +1,16 @@
+.PHONY: test tpu-smoke bench all
+
+# CPU oracle/golden tier: 8 virtual devices, runs anywhere.
+test:
+	python -m pytest tests/ -x -q
+
+# Hardware smoke tier: real TPU lowering of Pallas kernels + pipeline.
+# Separate invocation because tests/conftest.py pins its process to CPU.
+# Skips cleanly when no TPU backend is present.
+tpu-smoke:
+	python -m pytest tests_tpu/ -q
+
+bench:
+	python bench.py
+
+all: test tpu-smoke bench

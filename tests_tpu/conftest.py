@@ -1,0 +1,51 @@
+"""TPU smoke tier configuration.
+
+Unlike tests/conftest.py (which forces an 8-virtual-device CPU platform for
+the oracle/golden tier), this tier runs on whatever accelerator backend the
+environment provides and skips everything when none is present. It exists so
+TPU *lowering* is exercised by the suite — the round-1 Pallas iota bug shipped
+precisely because every Pallas test passed interpret=True.
+
+Run with: make tpu-smoke   (or: python -m pytest tests_tpu/ -q)
+It must be a separate pytest invocation from tests/ — the unit tier's
+conftest pins the process to CPU before jax initialises.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+TPU_BACKENDS = ("tpu", "axon")  # axon = tunnelled TPU plugin
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() not in TPU_BACKENDS:
+        skip = pytest.mark.skip(reason="no TPU backend present")
+        for item in items:
+            item.add_marker(skip)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+@pytest.fixture
+def string_batch(rng):
+    """~1k variable-length lowercase ASCII pairs, incl. duplicates/transposes."""
+    B, L = 1024, 24
+    lens1 = rng.integers(0, L + 1, B).astype(np.int32)
+    lens2 = rng.integers(0, L + 1, B).astype(np.int32)
+    s1 = (rng.integers(97, 123, (B, L)) * (np.arange(L) < lens1[:, None])).astype(
+        np.uint8
+    )
+    s2 = (rng.integers(97, 123, (B, L)) * (np.arange(L) < lens2[:, None])).astype(
+        np.uint8
+    )
+    # make a slice of exact duplicates and near-duplicates (transpositions)
+    s2[:256], lens2[:256] = s1[:256], lens1[:256]
+    for i in range(128, 256):
+        if lens1[i] >= 2:
+            j = int(rng.integers(0, lens1[i] - 1))
+            s2[i, j], s2[i, j + 1] = s2[i, j + 1], s2[i, j]
+    return s1, s2, lens1, lens2

@@ -1,0 +1,123 @@
+"""Hardware smoke tier: compile and run the TPU-only code paths for real.
+
+Covers the gap that shipped the round-1 regression: Pallas kernels were only
+ever tested with interpret=True, so Mosaic lowering was never exercised. Each
+test here runs the real compiled artifact on the chip and checks values
+against the vmapped JAX implementations (which are themselves oracle-tested
+in the CPU tier, tests/test_string_kernels.py).
+
+Reference analogue: the "real engine" Spark tier of the reference suite
+(/root/reference/tests/test_spark.py:22-68).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+
+def _dev(*arrays):
+    return tuple(jnp.asarray(a) for a in arrays)
+
+
+class TestPallasKernelsOnHardware:
+    def test_jaro_winkler_matches_vmapped(self, string_batch):
+        from splink_tpu.ops import strings
+        from splink_tpu.ops.strings_pallas import jaro_winkler_pallas
+
+        s1, s2, l1, l2 = _dev(*string_batch)
+        got = np.asarray(jaro_winkler_pallas(s1, s2, l1, l2))
+        want = np.asarray(strings.jaro_winkler_vmapped(s1, s2, l1, l2, 0.1, 0.0))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_jaro_winkler_known_value(self):
+        from splink_tpu.ops.strings_pallas import jaro_winkler_pallas
+
+        m1 = np.zeros((1, 16), np.uint8)
+        m2 = np.zeros((1, 16), np.uint8)
+        m1[0, :6] = np.frombuffer(b"MARTHA", np.uint8)
+        m2[0, :6] = np.frombuffer(b"MARHTA", np.uint8)
+        v = float(jaro_winkler_pallas(*_dev(m1, m2, [6], [6]))[0])
+        assert abs(v - 0.9611) < 1e-3
+
+    def test_levenshtein_matches_vmapped(self, string_batch):
+        from splink_tpu.ops import strings
+        from splink_tpu.ops.strings_pallas import levenshtein_pallas
+
+        s1, s2, l1, l2 = _dev(*string_batch)
+        got = np.asarray(levenshtein_pallas(s1, s2, l1, l2))
+        want = np.asarray(strings.levenshtein_vmapped(s1, s2, l1, l2))
+        np.testing.assert_allclose(got, want.astype(np.float32), atol=0)
+
+    def test_dispatch_selects_pallas_on_tpu(self):
+        from splink_tpu.ops.strings_pallas import pallas_supported
+
+        a = jnp.zeros((8, 24), jnp.uint8)
+        assert pallas_supported(a)
+
+
+class TestPipelineOnHardware:
+    def test_linker_end_to_end_on_device(self):
+        """Full Splink flow — blocking, gamma program, fused EM — on the chip.
+
+        Uses a jaro_winkler string column so the GammaProgram routes through
+        the Pallas kernel (non-interpret)."""
+        import splink_tpu
+
+        rng = np.random.default_rng(7)
+        names = ["olivia", "liam", "emma", "noah", "amelia", "oliver",
+                 "sophia", "elijah", "isabella", "lucas"]
+        rows = []
+        for i in range(150):
+            f = names[rng.integers(len(names))] + str(rng.integers(100))
+            city = ["london", "leeds", "york", "bath"][rng.integers(4)]
+            rows.append({"unique_id": 2 * i, "name": f, "city": city})
+            g = list(f)
+            g[1], g[2] = g[2], g[1]
+            rows.append({"unique_id": 2 * i + 1, "name": "".join(g), "city": city})
+        df = pd.DataFrame(rows)
+        settings = {
+            "link_type": "dedupe_only",
+            "blocking_rules": ["l.city = r.city"],
+            "comparison_columns": [
+                {"col_name": "name", "data_type": "string", "num_levels": 3},
+                {"col_name": "city", "data_type": "string", "num_levels": 2},
+            ],
+            "max_iterations": 10,
+        }
+        linker = splink_tpu.Splink(settings, df=df)
+        scored = linker.get_scored_comparisons()
+        dup = scored[(scored.unique_id_l // 2) == (scored.unique_id_r // 2)]
+        non = scored[(scored.unique_id_l // 2) != (scored.unique_id_r // 2)]
+        assert dup.match_probability.median() > 0.8
+        assert non.match_probability.median() < 0.5
+
+    def test_run_em_on_device(self):
+        from splink_tpu.em import run_em
+        from splink_tpu.models.fellegi_sunter import FSParams
+
+        rng = np.random.default_rng(3)
+        C, N = 4, 50_000
+        m_t = np.tile([0.05, 0.1, 0.85], (C, 1))
+        u_t = np.tile([0.7, 0.2, 0.1], (C, 1))
+        is_m = rng.random(N) < 0.25
+        G = np.zeros((N, C), np.int8)
+        for c in range(C):
+            G[:, c] = np.where(
+                is_m, rng.choice(3, N, p=m_t[c]), rng.choice(3, N, p=u_t[c])
+            )
+        params0 = FSParams(
+            lam=jnp.asarray(0.5),
+            m=jnp.asarray(np.tile([0.1, 0.2, 0.7], (C, 1))),
+            u=jnp.asarray(np.tile([0.7, 0.2, 0.1], (C, 1))),
+        )
+        out = run_em(
+            jnp.asarray(G), params0, max_levels=3, max_iterations=40,
+            em_convergence=1e-6,
+        )
+        assert abs(float(out.params.lam) - 0.25) < 0.02
+        assert np.abs(np.asarray(out.params.m) - m_t).max() < 0.03
+
+
+def test_backend_is_tpu():
+    assert jax.default_backend() in ("tpu", "axon")
