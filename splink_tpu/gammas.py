@@ -464,6 +464,10 @@ class GammaProgram:
         batch_size = min(batch_size, max(n, 1))
         out = np.empty((n, self.n_cols), np.int8)
         device_batches = []
+        # Double-buffered: batch k+1 is dispatched before batch k's result is
+        # pulled to the host, so device compute overlaps the D2H transfer
+        # (JAX dispatch is async; np.asarray is the only sync point).
+        pending = None  # (start, stop, device result)
         for start in range(0, n, batch_size):
             stop = min(start + batch_size, n)
             bl = idx_l[start:stop]
@@ -472,10 +476,15 @@ class GammaProgram:
                 pad = batch_size - (stop - start)
                 bl = np.concatenate([bl, np.zeros(pad, bl.dtype)])
                 br = np.concatenate([br, np.zeros(pad, br.dtype)])
-            G = self._gamma_batch(jnp.asarray(bl), jnp.asarray(br))
+            G = self._gamma_batch(jnp.asarray(bl), jnp.asarray(br))[: stop - start]
             if keep_device:
-                device_batches.append(G[: stop - start])
-            out[start:stop] = np.asarray(G)[: stop - start]
+                device_batches.append(G)
+            if pending is not None:
+                ps, pe, pG = pending
+                out[ps:pe] = np.asarray(pG)
+            pending = (start, stop, G)
+        ps, pe, pG = pending
+        out[ps:pe] = np.asarray(pG)
         dev = None
         if keep_device:
             dev = (

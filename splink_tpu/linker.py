@@ -22,7 +22,7 @@ import numpy as np
 from .blocking import PairIndex, block_using_rules
 from .check_types import check_types
 from .data import EncodedTable, concat_tables, encode_table
-from .em import run_em, score_pairs_with_intermediates
+from .em import run_em, score_pairs, score_pairs_with_intermediates
 from .gammas import GammaProgram, register_comparison  # noqa: F401 (re-export)
 from .models.fellegi_sunter import FSParams
 from .params import Params, load_params_from_json
@@ -316,15 +316,25 @@ class Splink:
     def _score_batched(self, G: np.ndarray, params_dev: FSParams):
         """Score in pair_batch_size device batches (padded to one compiled
         shape), so output assembly never pushes more than a batch of the
-        gamma matrix plus its (n, C) float intermediates into HBM."""
+        gamma matrix plus its (n, C) float intermediates into HBM.
+
+        The per-column prob_m/prob_u intermediates are only computed and
+        transferred when retain_intermediate_calculation_columns is set —
+        the default path downloads just the (n,) probabilities. Batches are
+        double-buffered: batch k+1 dispatches before batch k's download."""
         n = len(G)
         batch = min(int(self.settings["pair_batch_size"]), max(n, 1))
         n_cols = G.shape[1] if G.ndim == 2 else 0
+        want_inter = bool(self.settings["retain_intermediate_calculation_columns"])
         # Device copy is reusable only when scoring the exact same full matrix
         src_dev = self._G_dev if self._G_dev is not None and G is self._G else None
         p = np.empty(n, np.float32)
-        prob_m = np.empty((n, n_cols), np.float32)
-        prob_u = np.empty((n, n_cols), np.float32)
+        if want_inter:
+            prob_m = np.empty((n, n_cols), np.float32)
+            prob_u = np.empty((n, n_cols), np.float32)
+        else:
+            prob_m = prob_u = None
+        pending = None  # (start, stop, device results)
         for s in range(0, n, batch):
             stop = min(s + batch, n)
             Gb = src_dev[s:stop] if src_dev is not None else jnp.asarray(G[s:stop])
@@ -332,11 +342,25 @@ class Splink:
                 Gb = jnp.concatenate(
                     [Gb, jnp.zeros((batch - (stop - s), n_cols), Gb.dtype)]
                 )
-            pb, pmb, pub = score_pairs_with_intermediates(Gb, params_dev)
-            p[s:stop] = np.asarray(pb)[: stop - s]
-            prob_m[s:stop] = np.asarray(pmb)[: stop - s]
-            prob_u[s:stop] = np.asarray(pub)[: stop - s]
+            if want_inter:
+                res = score_pairs_with_intermediates(Gb, params_dev)
+            else:
+                res = (score_pairs(Gb, params_dev),)
+            res = tuple(r[: stop - s] for r in res)
+            if pending is not None:
+                self._drain_score_batch(pending, p, prob_m, prob_u)
+            pending = (s, stop, res)
+        if pending is not None:
+            self._drain_score_batch(pending, p, prob_m, prob_u)
         return p, prob_m, prob_u
+
+    @staticmethod
+    def _drain_score_batch(pending, p, prob_m, prob_u):
+        s, stop, res = pending
+        p[s:stop] = np.asarray(res[0])
+        if prob_m is not None:
+            prob_m[s:stop] = np.asarray(res[1])
+            prob_u[s:stop] = np.asarray(res[2])
 
     def _build_df_e(self, G: np.ndarray, rows: slice | None = None):
         """Assemble the scored comparisons DataFrame with the reference's

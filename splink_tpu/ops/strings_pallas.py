@@ -32,6 +32,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANE_TILE = 512  # pairs per grid step
 MAX_PALLAS_WIDTH = 32
+TPU_BACKENDS = ("tpu", "axon")  # axon = tunnelled TPU plugin
 
 
 def _tril(L: int, strict: bool) -> jnp.ndarray:
@@ -251,7 +252,7 @@ def levenshtein_pallas(s1, s2, l1, l2, interpret=False):
 def pallas_supported(s1) -> bool:
     """Whether the Pallas path handles this input on the current backend."""
     return (
-        jax.default_backend() in ("tpu", "axon")  # axon = tunnelled TPU plugin
+        jax.default_backend() in TPU_BACKENDS
         and s1.ndim == 2
         and s1.shape[1] <= MAX_PALLAS_WIDTH
         and s1.dtype == jnp.uint8

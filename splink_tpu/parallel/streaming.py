@@ -73,7 +73,9 @@ def run_em_streamed(
 
     for it in range(1, max_iterations + 1):
         acc = SufficientStats.zeros(C, L, dtype=init.m.dtype)
-        ll_total = 0.0
+        # The log-likelihood accumulates on device: a host-side float(ll)
+        # here would sync every micro-batch and serialise the stream.
+        ll_acc = jnp.zeros((), init.m.dtype)
         for batch in batch_iter_factory():
             if isinstance(batch, tuple):
                 G, w = batch
@@ -91,7 +93,9 @@ def run_em_streamed(
                 jnp.asarray(G), params, max_levels, w, compute_ll
             )
             acc = acc + stats
-            ll_total += float(ll)
+            if compute_ll:
+                ll_acc = ll_acc + ll
+        ll_total = float(ll_acc) if compute_ll else 0.0
 
         new = update_params(acc)
         delta = max(

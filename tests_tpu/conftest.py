@@ -15,7 +15,7 @@ import jax
 import numpy as np
 import pytest
 
-TPU_BACKENDS = ("tpu", "axon")  # axon = tunnelled TPU plugin
+from splink_tpu.ops.strings_pallas import TPU_BACKENDS
 
 
 def pytest_collection_modifyitems(config, items):

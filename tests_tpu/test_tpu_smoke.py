@@ -10,7 +10,6 @@ Reference analogue: the "real engine" Spark tier of the reference suite
 (/root/reference/tests/test_spark.py:22-68).
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pandas as pd
@@ -117,7 +116,3 @@ class TestPipelineOnHardware:
         )
         assert abs(float(out.params.lam) - 0.25) < 0.02
         assert np.abs(np.asarray(out.params.m) - m_t).max() < 0.03
-
-
-def test_backend_is_tpu():
-    assert jax.default_backend() in ("tpu", "axon")
