@@ -295,15 +295,58 @@ class Splink:
 
     def make_term_frequency_adjustments(self, df_e):
         """Ex-post term-frequency adjustment of scored comparisons
-        (/root/reference/splink/__init__.py:147-163)."""
+        (/root/reference/splink/__init__.py:147-163).
+
+        When df_e still corresponds row-for-row to this linker's pair index,
+        the per-token aggregation runs on device over the encoded table's
+        factorised token ids (segment_sum) instead of a host groupby."""
         from .term_frequencies import make_adjustment_for_term_frequencies
+
+        pair_token_ids = None
+        if self._pairs is not None and self._df_e_aligned_with_pairs(df_e):
+            table = self._ensure_encoded()
+            pair_token_ids = {}
+            for c in self.settings["comparison_columns"]:
+                name = c.get("col_name")
+                if (
+                    c.get("term_frequency_adjustments")
+                    and name in table.strings
+                ):
+                    tid = table.strings[name].token_ids
+                    pair_token_ids[name] = (
+                        tid[self._pairs.idx_l],
+                        tid[self._pairs.idx_r],
+                        table.strings[name].n_tokens,
+                    )
 
         return make_adjustment_for_term_frequencies(
             df_e,
             self.params,
             self.settings,
             retain_adjustment_columns=True,
+            pair_token_ids=pair_token_ids,
         )
+
+    def _df_e_aligned_with_pairs(self, df_e) -> bool:
+        """Whether df_e still corresponds row-for-row to the pair index (the
+        fast device-side TF path needs this; a user-sorted or filtered frame
+        falls back to the host groupby path)."""
+        n = self._pairs.n_pairs
+        if len(df_e) != n or not df_e.index.equals(pd.RangeIndex(n)):
+            return False
+        uid = self.settings["unique_id_column_name"]
+        cols = (f"{uid}_l", f"{uid}_r")
+        if not all(c in df_e.columns for c in cols):
+            return False
+        table = self._ensure_encoded()
+        # Full-column comparison: a sampled check could miss a small
+        # permutation and silently misattribute probabilities to token ids.
+        for c, idx in zip(cols, (self._pairs.idx_l, self._pairs.idx_r)):
+            want = np.asarray(table.unique_id[idx])
+            got = df_e[c].to_numpy()
+            if not np.array_equal(got, want):
+                return False
+        return True
 
     @check_types
     def save_model_as_json(self, path: str | os.PathLike, overwrite: bool = False):

@@ -73,9 +73,11 @@ def run_em_streamed(
 
     for it in range(1, max_iterations + 1):
         acc = SufficientStats.zeros(C, L, dtype=init.m.dtype)
-        # The log-likelihood accumulates on device: a host-side float(ll)
-        # here would sync every micro-batch and serialise the stream.
-        ll_acc = jnp.zeros((), init.m.dtype)
+        # Per-batch log-likelihoods stay on device (a host-side float(ll)
+        # here would sync every micro-batch and serialise the stream) and
+        # reduce pairwise at the end of the pass, which keeps f32 error
+        # O(log n_batches) instead of O(n_batches) for sequential adds.
+        ll_parts = []
         for batch in batch_iter_factory():
             if isinstance(batch, tuple):
                 G, w = batch
@@ -94,8 +96,8 @@ def run_em_streamed(
             )
             acc = acc + stats
             if compute_ll:
-                ll_acc = ll_acc + ll
-        ll_total = float(ll_acc) if compute_ll else 0.0
+                ll_parts.append(ll)
+        ll_total = float(jnp.sum(jnp.stack(ll_parts))) if ll_parts else 0.0
 
         new = update_params(acc)
         delta = max(

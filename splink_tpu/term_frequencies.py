@@ -8,14 +8,22 @@ Bayes-combined with (1 - lambda); disagreeing or null pairs are neutral
 (0.5); the final ``tf_adjusted_match_prob`` Bayes-combines the base match
 probability with every column adjustment.
 
-The aggregation is a segment mean over token ids — tiny relative to scoring —
-so it runs host-side on the scored frame; the result is a per-token lookup
-(the analogue of the reference's BROADCAST join lookup tables,
-/root/reference/splink/term_frequencies.py:84-86).
+Two implementations of the per-column aggregation:
+
+  * device path (``compute_token_adjustment_device``): a jitted
+    ``segment_sum`` over the encoded table's factorised token ids — the
+    per-token lambda table is built on the TPU and gathered back per pair,
+    the analogue of the reference's grouped aggregate + BROADCAST join
+    (/root/reference/splink/term_frequencies.py:49-95). The linker uses this
+    whenever the scored frame still corresponds 1:1 to its pair index.
+  * host path (``compute_token_adjustment``): pandas groupby over the raw
+    values, kept for arbitrary user-supplied frames (API parity — the
+    reference accepts any df_e).
 """
 
 from __future__ import annotations
 
+import functools
 import warnings
 
 import numpy as np
@@ -70,14 +78,136 @@ def compute_token_adjustment(values_l, values_r, match_probability, base_lambda)
     return adj, lookup
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+# Device TF aggregation chunk size: bounds HBM use like pair_batch_size does
+# for gammas/scoring, so the fast path holds in the streamed regime too.
+TF_DEVICE_CHUNK = 1 << 24
+
+
+@functools.lru_cache(maxsize=None)
+def _device_token_stats_fn(num_segments: int):
+    """Jitted per-chunk (sums, counts) accumulation over token ids."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(tid_l, tid_r, p, sums, counts):
+        agree = (tid_l == tid_r) & (tid_l >= 0)
+        af = agree.astype(p.dtype)
+        # disagreeing (and padded, tid=-1) pairs go to the overflow bucket
+        seg = jnp.where(agree, tid_l, num_segments - 1)
+        sums = sums + jax.ops.segment_sum(p * af, seg, num_segments=num_segments)
+        counts = counts + jax.ops.segment_sum(af, seg, num_segments=num_segments)
+        return sums, counts
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _device_token_gather_fn(num_segments: int):
+    """Jitted per-chunk gather of each pair's token adjustment."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(tid_l, tid_r, adjusted):
+        agree = (tid_l == tid_r) & (tid_l >= 0)
+        return jnp.where(
+            agree, adjusted[jnp.minimum(tid_l, num_segments - 1)], 0.5
+        )
+
+    return fn
+
+
+def compute_token_adjustment_device(
+    tid_l, tid_r, match_probability, base_lambda, n_tokens: int
+):
+    """Device-side per-column adjustment over factorised token ids.
+
+    Same formulas as compute_token_adjustment, but the segment mean over
+    agreeing pairs runs as jitted segment_sums on the accelerator instead of
+    a host groupby over object arrays. Processes the pair axis in
+    TF_DEVICE_CHUNK chunks so HBM use stays bounded at any pair count.
+    Returns (adj, tok_lambda, counts) — per-pair adjustment plus the
+    per-token-id lambda table and agree-counts (diagnostics).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # f64 when enabled (CPU test tier: bit-parity with the host oracle);
+    # f32 on TPU, where f64 doesn't exist.
+    dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    num_segments = _next_pow2(n_tokens + 1)
+    n = len(tid_l)
+    if n == 0:
+        z = np.zeros(num_segments)
+        return np.zeros(0, np.float64), z, z
+    chunk = min(TF_DEVICE_CHUNK, max(n, 1))
+
+    def chunks_of(a, fill):
+        for s in range(0, n, chunk):
+            piece = a[s : s + chunk]
+            if len(piece) < chunk:
+                piece = np.concatenate(
+                    [piece, np.full(chunk - len(piece), fill, piece.dtype)]
+                )
+            yield s, piece
+
+    p_host = np.asarray(match_probability)
+    stats_fn = _device_token_stats_fn(num_segments)
+    sums = jnp.zeros(num_segments, dtype)
+    counts = jnp.zeros(num_segments, dtype)
+    for (s, cl), (_, cr) in zip(
+        chunks_of(np.asarray(tid_l), -1), chunks_of(np.asarray(tid_r), -1)
+    ):
+        pc = p_host[s : s + chunk]
+        if len(pc) < chunk:
+            pc = np.concatenate([pc, np.zeros(chunk - len(pc), pc.dtype)])
+        sums, counts = stats_fn(
+            jnp.asarray(cl), jnp.asarray(cr), jnp.asarray(pc, dtype), sums, counts
+        )
+
+    tok_lambda = sums / jnp.maximum(counts, 1.0)
+    # Bayes-combine each token lambda with (1 - base lambda)
+    # (/root/reference/splink/term_frequencies.py:60)
+    num = tok_lambda * (1.0 - jnp.asarray(base_lambda, dtype))
+    den = (1.0 - tok_lambda) * jnp.asarray(base_lambda, dtype)
+    adjusted = num / (num + den)
+
+    gather_fn = _device_token_gather_fn(num_segments)
+    adj = np.empty(n, np.float64)
+    pending = None
+    for (s, cl), (_, cr) in zip(
+        chunks_of(np.asarray(tid_l), -1), chunks_of(np.asarray(tid_r), -1)
+    ):
+        out = gather_fn(jnp.asarray(cl), jnp.asarray(cr), adjusted)
+        if pending is not None:
+            ps, pout = pending
+            adj[ps : ps + chunk] = np.asarray(pout)[: max(0, min(chunk, n - ps))]
+        pending = (s, out)
+    ps, pout = pending
+    adj[ps : ps + chunk] = np.asarray(pout)[: max(0, min(chunk, n - ps))]
+    return adj, np.asarray(tok_lambda), np.asarray(counts)
+
+
 @check_types
 def make_adjustment_for_term_frequencies(
     df_e,
     params: Params,
     settings: dict,
     retain_adjustment_columns: bool = False,
+    pair_token_ids: dict | None = None,
 ):
-    """Add ``tf_adjusted_match_prob`` to a scored comparisons frame."""
+    """Add ``tf_adjusted_match_prob`` to a scored comparisons frame.
+
+    pair_token_ids (optional, supplied by the linker): maps column name ->
+    (tid_l, tid_r, n_tokens) int32 arrays aligned with df_e's rows; when
+    present the per-token aggregation runs on device instead of a host
+    groupby.
+    """
     tf_cols = [
         c["col_name"]
         for c in settings["comparison_columns"]
@@ -94,12 +224,22 @@ def make_adjustment_for_term_frequencies(
     base_lambda = params.params["λ"]
     adj_arrays = []
     for col in tf_cols:
-        adj, _ = compute_token_adjustment(
-            df[f"{col}_l"].to_numpy(dtype=object),
-            df[f"{col}_r"].to_numpy(dtype=object),
-            df["match_probability"].to_numpy(),
-            base_lambda,
-        )
+        if pair_token_ids is not None and col in pair_token_ids:
+            tid_l, tid_r, n_tokens = pair_token_ids[col]
+            adj, _, _ = compute_token_adjustment_device(
+                tid_l,
+                tid_r,
+                df["match_probability"].to_numpy(),
+                base_lambda,
+                n_tokens,
+            )
+        else:
+            adj, _ = compute_token_adjustment(
+                df[f"{col}_l"].to_numpy(dtype=object),
+                df[f"{col}_r"].to_numpy(dtype=object),
+                df["match_probability"].to_numpy(),
+                base_lambda,
+            )
         df[f"{col}_adj"] = adj
         adj_arrays.append(adj)
 

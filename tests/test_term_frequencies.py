@@ -142,3 +142,88 @@ def test_linker_tf_integration():
         adj_lambda * (1 - lam) + (1 - adj_lambda) * lam
     )
     np.testing.assert_allclose(smith.name_adj.to_numpy(), want_adj, rtol=1e-6)
+
+
+def test_device_path_matches_host_groupby():
+    """compute_token_adjustment_device (segment_sum over token ids) must agree
+    with the host pandas-groupby path on nulls, disagreements and skewed
+    token distributions."""
+    from splink_tpu.term_frequencies import (
+        compute_token_adjustment,
+        compute_token_adjustment_device,
+    )
+
+    rng = np.random.default_rng(11)
+    n, n_tokens = 20_000, 37
+    vocab = np.array([f"tok{i}" for i in range(n_tokens)], dtype=object)
+    tid_l = rng.integers(-1, n_tokens, n).astype(np.int32)  # -1 = null
+    tid_r = np.where(rng.random(n) < 0.5, tid_l, rng.integers(-1, n_tokens, n)).astype(np.int32)
+    p = rng.random(n)
+    base_lambda = 0.27
+
+    values_l = np.where(tid_l >= 0, vocab[np.maximum(tid_l, 0)], None)
+    values_r = np.where(tid_r >= 0, vocab[np.maximum(tid_r, 0)], None)
+
+    adj_host, _ = compute_token_adjustment(values_l, values_r, p, base_lambda)
+    adj_dev, _, _ = compute_token_adjustment_device(tid_l, tid_r, p, base_lambda, n_tokens)
+    np.testing.assert_allclose(adj_dev, adj_host, rtol=1e-9, atol=1e-12)
+
+
+def test_linker_uses_device_path_and_falls_back_when_misaligned():
+    from splink_tpu import Splink
+
+    rng = np.random.default_rng(5)
+    names = np.array(["smith", "jones", "patel", "kim", "lee"], dtype=object)
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(300),
+            "name": names[rng.integers(0, len(names), 300)],
+            "city": np.array(["a", "b", "c"], dtype=object)[rng.integers(0, 3, 300)],
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "name", "comparison": {"kind": "exact"},
+             "term_frequency_adjustments": True},
+            {"col_name": "city", "comparison": {"kind": "exact"}},
+        ],
+        "blocking_rules": ["l.city = r.city"],
+        "max_iterations": 3,
+    }
+    linker = Splink(s, df=df)
+    df_e = linker.get_scored_comparisons()
+    assert linker._df_e_aligned_with_pairs(df_e)
+    out_fast = linker.make_term_frequency_adjustments(df_e)
+
+    shuffled = df_e.sample(frac=1.0, random_state=0)
+    assert not linker._df_e_aligned_with_pairs(shuffled)
+    out_slow = linker.make_term_frequency_adjustments(shuffled).sort_index()
+    np.testing.assert_allclose(
+        out_fast.tf_adjusted_match_prob.to_numpy(),
+        out_slow.tf_adjusted_match_prob.to_numpy(),
+        rtol=1e-9,
+    )
+
+
+def test_device_path_chunked_matches_single_chunk(monkeypatch):
+    """The chunked accumulation (HBM-bounded) must give the same answer as a
+    single-chunk pass, including at ragged chunk boundaries."""
+    import splink_tpu.term_frequencies as tf
+
+    rng = np.random.default_rng(13)
+    n, n_tokens = 10_001, 13  # deliberately not a multiple of the chunk size
+    tid_l = rng.integers(-1, n_tokens, n).astype(np.int32)
+    tid_r = np.where(rng.random(n) < 0.4, tid_l, rng.integers(-1, n_tokens, n)).astype(np.int32)
+    p = rng.random(n)
+
+    adj_one, lam_one, cnt_one = tf.compute_token_adjustment_device(
+        tid_l, tid_r, p, 0.3, n_tokens
+    )
+    monkeypatch.setattr(tf, "TF_DEVICE_CHUNK", 4096)
+    adj_many, lam_many, cnt_many = tf.compute_token_adjustment_device(
+        tid_l, tid_r, p, 0.3, n_tokens
+    )
+    np.testing.assert_allclose(adj_many, adj_one, rtol=1e-12)
+    np.testing.assert_allclose(lam_many, lam_one, rtol=1e-12)
+    np.testing.assert_allclose(cnt_many, cnt_one, rtol=0)
