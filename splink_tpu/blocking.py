@@ -14,11 +14,10 @@ Pair-set semantics are preserved exactly:
     equality semantics),
   * each rule's pairs exclude pairs produced by ANY earlier rule. The
     reference expresses this as ``AND NOT ifnull(previous_rule, false)``
-    (/root/reference/splink/blocking.py:59-68); because every rule's join
-    (with the same where-condition) generates exactly the pairs satisfying
-    that rule, subtracting the already-seen pair set is equivalent — and the
-    null-safety is inherited (a pair that null-fails an earlier rule was
-    simply never generated by it),
+    (/root/reference/splink/blocking.py:59-68) and that is literally what
+    runs here: earlier rules' predicates (join-key equality + residual) are
+    evaluated on each new rule's candidates, with a null/UNKNOWN outcome
+    counting as not-produced (the ifnull). No accumulated pair set is kept,
   * link types order/orient pairs like the reference
     (/root/reference/splink/blocking.py:133-139): dedupe_only keeps
     ``uid_l < uid_r``; link_only crosses the two tables with the left input
@@ -33,7 +32,6 @@ with no equality part at all, against cartesian chunks).
 from __future__ import annotations
 
 import logging
-import re
 import warnings
 from dataclasses import dataclass
 
@@ -168,22 +166,66 @@ def _cross_join(codes: np.ndarray, left_rows: np.ndarray, right_rows: np.ndarray
 # ----------------------------------------------------------------------
 
 
+def _uid_ranks(table: EncodedTable, link_type: str):
+    """(ranks, keys_unique): int32 rank of each row in the reference's
+    ordering — uid for dedupe_only, (source_table, uid) for link_and_dedupe —
+    plus whether the ordering keys are unique (they almost always are, which
+    lets orientation skip the drop-equal-key pass entirely). Rank comparisons
+    replace per-pair gathers of arbitrary-dtype uid arrays: at billions of
+    candidate pairs the int32 rank gather halves the transient footprint and
+    avoids object-dtype comparisons for string uids. Cached per table."""
+    cache = getattr(table, "_uid_rank_cache", None)
+    if cache is None:
+        cache = table._uid_rank_cache = {}
+    if link_type not in cache:
+        uid = np.asarray(table.unique_id)
+        if link_type == "link_and_dedupe":
+            order = np.lexsort((uid, table.source_table))
+        else:
+            order = np.argsort(uid, kind="stable")
+        ranks = np.empty(len(uid), np.int32)
+        ranks[order] = np.arange(len(uid), dtype=np.int32)
+        sorted_uid = uid[order]
+        if len(uid) < 2:
+            keys_unique = True
+        elif link_type == "link_and_dedupe":
+            sorted_src = table.source_table[order]
+            keys_unique = bool(
+                (
+                    (sorted_uid[1:] != sorted_uid[:-1])
+                    | (sorted_src[1:] != sorted_src[:-1])
+                ).all()
+            )
+        else:
+            keys_unique = bool((sorted_uid[1:] != sorted_uid[:-1]).all())
+        cache[link_type] = (ranks, keys_unique)
+    return cache[link_type]
+
+
 def _orient_pairs(table: EncodedTable, link_type: str, i: np.ndarray, j: np.ndarray):
     """Apply the reference's where-condition semantics to unordered pairs."""
-    uid = table.unique_id
     if link_type == "dedupe_only":
-        ui, uj = uid[i], uid[j]
-        keep = ui != uj
-        i, j, ui, uj = i[keep], j[keep], ui[keep], uj[keep]
-        swap = uj < ui
+        ranks, uids_unique = _uid_ranks(table, link_type)
+        ri, rj = ranks[i], ranks[j]
+        if not uids_unique:
+            # duplicated uids: drop equal-uid pairs (the reference's
+            # l.uid < r.uid keeps them out)
+            uid = table.unique_id
+            keep = uid[i] != uid[j]
+            i, j, ri, rj = i[keep], j[keep], ri[keep], rj[keep]
+        swap = rj < ri
         return np.where(swap, j, i), np.where(swap, i, j)
     if link_type == "link_and_dedupe":
-        st = table.source_table
-        ui, uj = uid[i], uid[j]
-        si, sj = st[i], st[j]
-        keep = ~((si == sj) & (ui == uj))
-        i, j, ui, uj, si, sj = i[keep], j[keep], ui[keep], uj[keep], si[keep], sj[keep]
-        swap = (sj < si) | ((sj == si) & (uj < ui))
+        ranks, combos_unique = _uid_ranks(table, link_type)
+        ri, rj = ranks[i], ranks[j]
+        if combos_unique:
+            keep = ri != rj  # drops same-source same-uid self matches
+        else:
+            st = table.source_table
+            uid = table.unique_id
+            keep = ~((st[i] == st[j]) & (uid[i] == uid[j]))
+        i, j, ri, rj = i[keep], j[keep], ri[keep], rj[keep]
+        swap = rj < ri
         return np.where(swap, j, i), np.where(swap, i, j)
     return i, j  # link_only: orientation fixed by construction
 
@@ -194,36 +236,14 @@ def _orient_pairs(table: EncodedTable, link_type: str, i: np.ndarray, j: np.ndar
 
 
 def _eval_residual(table: EncodedTable, residual: str, i: np.ndarray, j: np.ndarray):
-    """Evaluate a translated residual predicate on candidate pairs.
+    """Evaluate a translated residual predicate on candidate pairs via the
+    typed AST interpreter (splink_tpu/residual_eval.py): string columns
+    compare through lexicographic rank arrays, comparisons follow SQL null
+    semantics, and no ``eval`` is involved."""
+    from .residual_eval import evaluate_residual
 
-    Null values surface as NaN so SQL's null-rejecting comparison semantics
-    hold element-wise; explicit IS [NOT] NULL atoms use _isna.
-    """
-    import pandas as pd
-
-    cols = set(re.findall(r'[lr]\["(\w+)"\]', residual))
-    l_ns, r_ns = {}, {}
-    for col in cols:
-        vals = _values_with_nan(table, col)
-        l_ns[col] = vals[i]
-        r_ns[col] = vals[j]
-    result = eval(  # noqa: S307 - translated from user-supplied SQL config
-        residual, {"_isna": pd.isna, "np": np}, {"l": l_ns, "r": r_ns}
-    )
-    mask = np.asarray(result, dtype=bool)
+    mask = evaluate_residual(table, residual, i, j)
     return i[mask], j[mask]
-
-
-def _values_with_nan(table: EncodedTable, col: str) -> np.ndarray:
-    if col in table.numerics:
-        nc = table.numerics[col]
-        vals = nc.values_f64.copy()
-        vals[nc.null_mask] = np.nan
-        return vals
-    vals = np.array(table.column_values(col), dtype=object)
-    null = table.is_null(col)
-    vals[null] = np.nan
-    return vals
 
 
 # ----------------------------------------------------------------------
@@ -254,7 +274,20 @@ def block_using_rules(
         assert n_left is not None
         left_rows, right_rows = all_rows[:n_left], all_rows[n_left:]
 
-    seen = np.zeros(0, np.int64)  # sorted packed pair ids across rules
+    # Pair indices are stored int32 when the table allows (they always do —
+    # int32 row indices cover 2^31 rows); at billions of candidate pairs this
+    # halves the resident footprint of the pair set.
+    idx_dtype = np.int32 if table.n_rows < 2**31 else np.int64
+
+    # Sequential-rule dedup by PREDICATE, the literal semantics of the
+    # reference's ``AND NOT ifnull(previous_rule, false)``
+    # (/root/reference/splink/blocking.py:59-68): a candidate of rule k is
+    # kept iff NO earlier rule's predicate holds for it. Evaluating earlier
+    # predicates on rule k's candidates costs O(pairs_k) per earlier rule and
+    # needs no sorted pair-set accumulation (the round-1 design re-sorted a
+    # packed pair-id set per rule — minutes of host time and two extra
+    # full-size copies at the 10M-row configs).
+    prior_rules: list[tuple[np.ndarray | None, str | None]] = []
     out_l, out_r = [], []
     for rule in rules:
         eq_pairs, residual = parse_blocking_rule(rule)
@@ -267,6 +300,7 @@ def block_using_rules(
             else:
                 i, j = _self_join(codes)
         else:
+            codes = None
             warnings.warn(
                 f"Blocking rule {rule!r} has no equality condition; evaluating "
                 "it against all row pairs (quadratic)."
@@ -277,17 +311,41 @@ def block_using_rules(
         if residual is not None:
             i, j = _eval_residual(table, residual, i, j)
 
-        # sequential-rule dedup: drop pairs any earlier rule produced
-        packed = i * table.n_rows + j
-        if len(seen):
-            fresh = ~_isin_sorted(packed, seen)
-            i, j, packed = i[fresh], j[fresh], packed[fresh]
-        seen = _merge_sorted(seen, packed)
-        out_l.append(i)
-        out_r.append(j)
+        for prev_codes, prev_residual in prior_rules:
+            holds = _rule_holds(table, prev_codes, prev_residual, i, j)
+            keep = ~holds
+            i, j = i[keep], j[keep]
+
+        prior_rules.append((codes, residual))
+        out_l.append(i.astype(idx_dtype, copy=False))
+        out_r.append(j.astype(idx_dtype, copy=False))
         logger.debug("blocking rule %r -> %d new pairs", rule, len(i))
 
     return PairIndex(np.concatenate(out_l), np.concatenate(out_r))
+
+
+def _rule_holds(
+    table: EncodedTable,
+    codes: np.ndarray | None,
+    residual: str | None,
+    i: np.ndarray,
+    j: np.ndarray,
+) -> np.ndarray:
+    """Whether an (earlier) rule's predicate holds for each candidate pair:
+    combined join-key equality (null keys never match) AND the residual
+    (UNKNOWN counts as not-holding — ifnull(..., false))."""
+    if codes is not None:
+        ci, cj = codes[i], codes[j]
+        holds = (ci == cj) & (ci >= 0)
+    else:
+        holds = np.ones(len(i), bool)
+    if residual is not None:
+        sub = np.flatnonzero(holds)
+        if len(sub):
+            from .residual_eval import evaluate_residual
+
+            holds[sub] = evaluate_residual(table, residual, i[sub], j[sub])
+    return holds
 
 
 def _split_join_keys(eq_pairs, residual: str | None) -> tuple[list[str], str | None]:
@@ -304,18 +362,6 @@ def _split_join_keys(eq_pairs, residual: str | None) -> tuple[list[str], str | N
         parts = ([f"({residual})"] if residual else []) + extra
         residual = " & ".join(parts)
     return cols, residual
-
-
-def _isin_sorted(values: np.ndarray, sorted_ref: np.ndarray) -> np.ndarray:
-    pos = np.searchsorted(sorted_ref, values)
-    pos = np.clip(pos, 0, len(sorted_ref) - 1)
-    return sorted_ref[pos] == values
-
-
-def _merge_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    if not len(a):
-        return np.sort(b)
-    return np.sort(np.concatenate([a, b]))
 
 
 def _all_pairs(table: EncodedTable, link_type: str, n_left: int | None):
@@ -338,4 +384,5 @@ def cartesian_block(
     link_type = settings["link_type"]
     i, j = _all_pairs(table, link_type, n_left)
     i, j = _orient_pairs(table, link_type, i, j)
-    return PairIndex(i, j)
+    idx_dtype = np.int32 if table.n_rows < 2**31 else np.int64
+    return PairIndex(i.astype(idx_dtype, copy=False), j.astype(idx_dtype, copy=False))

@@ -231,30 +231,88 @@ def sql_predicate_to_python(pred: str) -> str:
     parenthesised during translation to preserve SQL precedence.
     """
     s = _normalise(pred)
-    # Substitute IS [NOT] NULL before tokenising — its NOT must not be taken
+    # Substitute IS [NOT] NULL before parsing — its NOT must not be taken
     # as a boolean operator.
     s = re.sub(r"(?i)\bis\s+not\s+null\b", " __ISNOTNULL__", s)
     s = re.sub(r"(?i)\bis\s+null\b", " __ISNULL__", s)
-    # Tokenise into atoms / boolean operators / parens, so each atom can be
-    # parenthesised independently.
-    parts = re.split(r"(?i)(\(|\)|\band\b|\bor\b|\bnot\b)", s)
-    out: list[str] = []
-    for part in parts:
-        token = part.strip()
-        if not token:
+    # Recursive descent over the boolean structure. Parens are only grouping
+    # when they wrap a sub-expression containing top-level boolean operators;
+    # otherwise they belong to the atom (function calls like abs(...),
+    # parenthesised arithmetic) and must not be split apart.
+    return _bool_expr(s)
+
+
+def _split_top_level(s: str, word: str) -> list[str]:
+    """Split s on the boolean keyword at paren depth 0, outside single-quoted
+    string literals (case-insensitive) — a literal like 'rock and roll' or
+    'Ft. (Worth' must not steer the parse."""
+    parts, depth, last = [], 0, 0
+    pat = re.compile(rf"(?i)\b{word}\b")
+    pos = 0
+    while pos < len(s):
+        ch = s[pos]
+        if ch == "'":
+            end = s.find("'", pos + 1)
+            pos = len(s) if end < 0 else end + 1
             continue
-        low = token.lower()
-        if low == "and":
-            out.append("&")
-        elif low == "or":
-            out.append("|")
-        elif low == "not":
-            out.append("~")
-        elif token in "()":
-            out.append(token)
-        else:
-            out.append(f"({_translate_atom(token)})")
-    return " ".join(out)
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif depth == 0:
+            m = pat.match(s, pos)
+            if m and (pos == 0 or not s[pos - 1].isalnum()):
+                parts.append(s[last:pos])
+                last = m.end()
+                pos = m.end()
+                continue
+        pos += 1
+    parts.append(s[last:])
+    return parts
+
+
+def _bool_expr(s: str) -> str:
+    s = s.strip()
+    ors = _split_top_level(s, "or")
+    if len(ors) > 1:
+        return " | ".join(f"({_bool_expr(p)})" for p in ors)
+    ands = _split_top_level(s, "and")
+    if len(ands) > 1:
+        return " & ".join(f"({_bool_expr(p)})" for p in ands)
+    m = re.match(r"(?i)^\s*not\b(.*)$", s)
+    if m:
+        return f"~({_bool_expr(m.group(1))})"
+    # fully-wrapped group whose parens match end-to-end -> recurse inside
+    if s.startswith("(") and s.endswith(")") and _parens_match_whole(s):
+        inner = s[1:-1]
+        if (
+            len(_split_top_level(inner, "or")) > 1
+            or len(_split_top_level(inner, "and")) > 1
+            or re.match(r"(?i)^\s*not\b", inner.strip())
+            or (inner.strip().startswith("(") and _parens_match_whole(inner.strip()))
+        ):
+            return f"({_bool_expr(inner)})"
+    return f"({_translate_atom(s)})"
+
+
+def _parens_match_whole(s: str) -> bool:
+    """True when s[0] == '(' pairs with s[-1] == ')' (quote-aware)."""
+    depth = 0
+    i = 0
+    while i < len(s):
+        ch = s[i]
+        if ch == "'":
+            end = s.find("'", i + 1)
+            i = len(s) if end < 0 else end + 1
+            continue
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return i == len(s) - 1
+        i += 1
+    return False
 
 
 def _translate_atom(atom: str) -> str:

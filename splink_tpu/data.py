@@ -72,6 +72,27 @@ class EncodedTable:
             return self.numerics[name].null_mask
         return np.array([v is None for v in self.raw[name]])
 
+    def string_ranks(self, name: str) -> tuple[np.ndarray, np.ndarray]:
+        """(ranks, sorted_vocab) for a string column: ranks is (n,) float64 —
+        the value's index in the lexicographically sorted vocabulary, NaN for
+        null. Rank comparisons are then order-isomorphic to string
+        comparisons, so residual blocking predicates evaluate on numeric
+        arrays instead of object arrays. Cached per column."""
+        cache = getattr(self, "_rank_cache", None)
+        if cache is None:
+            cache = self._rank_cache = {}
+        if name not in cache:
+            col = self.strings[name]
+            null = col.null_mask
+            vals = np.array(
+                ["" if v is None else str(v) for v in col.values], dtype=object
+            )
+            vocab, inv = np.unique(vals[~null], return_inverse=True)
+            ranks = np.full(len(vals), np.nan)
+            ranks[~null] = inv.astype(np.float64)
+            cache[name] = (ranks, vocab)
+        return cache[name]
+
 
 def _to_object_array(values) -> np.ndarray:
     import pandas as pd

@@ -493,3 +493,78 @@ class GammaProgram:
                 else jnp.concatenate(device_batches)
             )
         return out, dev
+
+
+def pattern_strides_for(level_counts: list[int]) -> tuple[list[int], int]:
+    """Mixed-radix strides and total pattern count for gamma vectors with
+    the given per-column level counts (digit c = gamma_c + 1)."""
+    strides, n_patterns = [], 1
+    for lc in level_counts:
+        strides.append(n_patterns)
+        n_patterns *= int(lc) + 1
+    return strides, n_patterns
+
+
+@functools.partial(jax.jit, static_argnames=("n_patterns",))
+def _pattern_counts_batch(G, valid, strides, n_patterns, acc):
+    pattern = jnp.sum((G.astype(jnp.int32) + 1) * strides[None, :], axis=1)
+    pattern = jnp.where(jnp.arange(pattern.shape[0]) < valid, pattern, n_patterns)
+    return acc + jnp.bincount(pattern, length=n_patterns + 1)
+
+
+# Flush the device int32 histogram accumulator to the host int64 total at
+# least this often. Without x64 enabled (the TPU default) jax silently
+# downgrades an int64 accumulator to int32, so the device-side partial sum
+# must stay safely below 2^31: flush_every * batch_size <= 2^30.
+_HIST_FLUSH_BATCHES = 1 << 10
+
+
+def pattern_counts_from_gammas(
+    G: np.ndarray, level_counts: list[int], batch_size: int = DEFAULT_PAIR_BATCH
+) -> np.ndarray:
+    """(n_patterns,) int64 pattern counts from a host gamma matrix, batched
+    through the device.
+
+    The device accumulator is int32 (int64 does not exist on TPU without
+    x64) and is flushed into a host int64 total every _HIST_FLUSH_BATCHES
+    batches, so counts cannot overflow at any pair count.
+    """
+    strides, n_patterns = pattern_strides_for(level_counts)
+    strides_dev = jnp.asarray(strides, jnp.int32)
+    n = len(G)
+    total = np.zeros(n_patterns, np.int64)
+    if n == 0:
+        return total
+    batch_size = min(batch_size, max(n, 1))
+    # keep the int32 partial sum below 2^30 regardless of batch size
+    flush_every = max(min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1)
+    acc = jnp.zeros(n_patterns + 1, jnp.int32)
+    batches_in_acc = 0
+    for start in range(0, n, batch_size):
+        stop = min(start + batch_size, n)
+        Gb = G[start:stop]
+        if stop - start < batch_size:
+            Gb = np.concatenate(
+                [Gb, np.zeros((batch_size - (stop - start), G.shape[1]), G.dtype)]
+            )
+        acc = _pattern_counts_batch(
+            jnp.asarray(Gb), stop - start, strides_dev, n_patterns, acc
+        )
+        batches_in_acc += 1
+        if batches_in_acc >= flush_every:
+            total += np.asarray(acc[:-1], np.int64)
+            acc = jnp.zeros(n_patterns + 1, jnp.int32)
+            batches_in_acc = 0
+    if batches_in_acc:
+        total += np.asarray(acc[:-1], np.int64)
+    return total
+
+
+def patterns_matrix_for(level_counts: list[int]) -> np.ndarray:
+    """(n_patterns, C) int8 gamma vectors in mixed-radix pattern-id order."""
+    strides, n_patterns = pattern_strides_for(level_counts)
+    ids = np.arange(n_patterns, dtype=np.int64)
+    out = np.empty((n_patterns, len(level_counts)), np.int8)
+    for c, lc in enumerate(level_counts):
+        out[:, c] = ((ids // strides[c]) % (int(lc) + 1)).astype(np.int8) - 1
+    return out

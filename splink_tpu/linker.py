@@ -221,7 +221,95 @@ class Splink:
             logger.info("EM algorithm has converged")
 
     def _run_em_streamed(self, G: np.ndarray, compute_ll: bool) -> None:
-        """Streaming EM over host-resident gamma micro-batches."""
+        """Streaming EM over host-resident gamma micro-batches.
+
+        Without a mesh this uses pattern compression — the observation behind
+        the reference's M-step group-by (/root/reference/splink/
+        maximisation_step.py:41-59): a gamma vector takes at most
+        prod(num_levels_c + 1) distinct values, so ONE device pass builds a
+        pattern histogram and every EM iteration then runs on the tiny
+        weighted pattern matrix instead of re-scanning all pairs."""
+        mesh = mesh_from_settings(self.settings)
+        if mesh is not None:
+            self._run_em_streamed_stats(G, compute_ll)
+            return
+
+        from .gammas import (
+            pattern_counts_from_gammas,
+            pattern_strides_for,
+            patterns_matrix_for,
+        )
+
+        level_counts = [
+            int(c["num_levels"]) for c in self.settings["comparison_columns"]
+        ]
+        # The dense histogram is prod(levels_c + 1) buckets; with very many
+        # columns that explodes (5^14 ~ 6e9), so fall back to pair-streaming
+        # sufficient statistics past a sane bound.
+        _, n_patterns = pattern_strides_for(level_counts)
+        if n_patterns > (1 << 22):
+            logger.info(
+                "pattern space too large for histogram EM (%d); streaming "
+                "sufficient statistics instead",
+                n_patterns,
+            )
+            self._run_em_streamed_stats(G, compute_ll)
+            return
+        batch = int(self.settings["pair_batch_size"])
+        with StageTimer("em_histogram"):
+            counts = pattern_counts_from_gammas(G, level_counts, batch)
+            patterns = patterns_matrix_for(level_counts)
+            seen = counts > 0
+            G_pat = patterns[seen]
+            weights = counts[seen]
+        logger.info(
+            "pattern-compressed EM: %d pairs -> %d distinct gamma patterns",
+            len(G),
+            len(G_pat),
+        )
+        self._run_em_resident_weighted(G_pat, weights, compute_ll)
+
+    def _run_em_resident_weighted(
+        self, G_pat: np.ndarray, weights: np.ndarray, compute_ll: bool
+    ) -> None:
+        """Fused EM on a weighted pattern matrix (counts as weights)."""
+        dtype = np.float64 if self.settings["float64"] else np.float32
+        lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
+        init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
+        G_dev = jnp.asarray(G_pat)
+        w_dev = jnp.asarray(weights.astype(dtype))
+        max_iterations = int(self.settings["max_iterations"])
+        em_kwargs = dict(
+            max_levels=self.params.max_levels,
+            em_convergence=self.settings["em_convergence"],
+            weights=w_dev,
+            compute_ll=compute_ll,
+        )
+        with StageTimer("em"):
+            if self.save_state_fn is None:
+                result = run_em(
+                    G_dev, init, max_iterations=max_iterations, **em_kwargs
+                )
+                self._replay_history(result, compute_ll)
+                converged = bool(result.converged)
+            else:
+                converged = False
+                params_dev = init
+                for _ in range(max_iterations):
+                    result = run_em(G_dev, params_dev, max_iterations=1, **em_kwargs)
+                    params_dev = result.params
+                    self._replay_history(result, compute_ll)
+                    self.save_state_fn(self.params, self.settings)
+                    if bool(result.converged):
+                        converged = True
+                        break
+        if converged:
+            logger.info("EM algorithm has converged")
+
+    def _run_em_streamed_stats(self, G: np.ndarray, compute_ll: bool) -> None:
+        """Streaming EM accumulating sufficient statistics per pass — the
+        fallback when the pattern space is too large for a dense histogram,
+        and the mesh path (stats psum across devices)."""
         from .parallel.streaming import run_em_streamed
 
         dtype = np.float64 if self.settings["float64"] else np.float32
