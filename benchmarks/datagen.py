@@ -80,8 +80,10 @@ def make_people(
     n_dups = rng.random(n_base) < duplicate_rate
 
     # name cardinality grows with dataset size, like real populations
-    f_pool, f_w = _name_pool(rng, FIRSTS, max(64, min(n_base // 20, 20_000)))
-    l_pool, l_w = _name_pool(rng, LASTS, max(64, min(n_base // 10, 50_000)))
+    # (a 10M-person population has hundreds of thousands of distinct names;
+    # capping too low makes the Zipf head collide whole blocks together)
+    f_pool, f_w = _name_pool(rng, FIRSTS, max(64, min(n_base // 20, 200_000)))
+    l_pool, l_w = _name_pool(rng, LASTS, max(64, min(n_base // 10, 500_000)))
     firsts = f_pool[rng.choice(len(f_pool), n_base, p=f_w)]
     lasts = l_pool[rng.choice(len(l_pool), n_base, p=l_w)]
     dobs = np.array(

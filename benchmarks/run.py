@@ -119,9 +119,16 @@ def config_3(scale):
 
 
 def config_4(scale):
+    """10M-row dedupe. At full scale the dob blocking rule alone yields
+    ~3.3B candidate pairs, so output is consumed as a stream (the full
+    scored frame would not fit host memory as one DataFrame) and quality
+    metrics aggregate incrementally. EM runs pattern-compressed: one device
+    pass histograms the gamma vectors, iterations run on the tiny weighted
+    pattern matrix."""
+    from splink_tpu import Splink
+
     n = max(int(10_000_000 * scale), 1000)
     df = make_people(n, seed=4)
-    t0 = time.perf_counter()
     settings = {
         "link_type": "dedupe_only",
         "comparison_columns": [
@@ -138,14 +145,46 @@ def config_4(scale):
         "blocking_rules": [
             "l.dob = r.dob",
             "l.postcode = r.postcode AND l.surname = r.surname",
-            "l.first_name = r.first_name AND l.city = r.city",
+            "l.first_name = r.first_name AND l.surname = r.surname",
         ],
         "retain_matching_columns": False,
         "retain_intermediate_calculation_columns": False,
         "additional_columns_to_retain": ["cluster"],
     }
-    _, _, out = _run_linker(settings, t0, df=df)
-    return out
+    t0 = time.perf_counter()
+    linker = Splink(settings, df=df)
+    t1 = time.perf_counter()
+    G = linker._ensure_gammas()
+    t_pairs = time.perf_counter() - t1
+    t1 = time.perf_counter()
+    linker._run_em(G, False)
+    t_em = time.perf_counter() - t1
+
+    t1 = time.perf_counter()
+    scored = tp = pred = truth = 0
+    for chunk in linker.stream_scored_comparisons_after_em():
+        scored += len(chunk)
+        p = chunk.match_probability.to_numpy() >= 0.8
+        t = (chunk.cluster_l == chunk.cluster_r).to_numpy()
+        tp += int((p & t).sum())
+        pred += int(p.sum())
+        truth += int(t.sum())
+    t_score = time.perf_counter() - t1
+    elapsed = time.perf_counter() - t0
+    return {
+        "rows": len(df),
+        "pairs": scored,
+        "seconds": round(elapsed, 3),
+        "pairs_per_sec": round(scored / elapsed),
+        "block_gamma_seconds": round(t_pairs, 3),
+        "em_seconds": round(t_em, 3),
+        "score_stream_seconds": round(t_score, 3),
+        "em_iterations": len(linker.params.param_history),
+        "lambda": round(linker.params.params["λ"], 5),
+        "pairs_truth": truth,
+        "precision": round(tp / max(pred, 1), 4),
+        "recall_blocked": round(tp / max(truth, 1), 4),
+    }
 
 
 def config_5(scale):

@@ -124,9 +124,23 @@ def _sort_groups(codes: np.ndarray, rows: np.ndarray):
     return rows_sorted, codes_sorted[starts], starts, sizes
 
 
-def _self_join(codes: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """All unordered within-group pairs (positions i<j) for non-null codes."""
-    rows = np.flatnonzero(codes >= 0).astype(np.int64)
+def _idx_dtype(n_rows: int):
+    return np.int32 if n_rows < 2**31 else np.int64
+
+
+def _self_join(
+    codes: np.ndarray, order: np.ndarray | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """All unordered within-group pairs for non-null codes.
+
+    With ``order`` (per-row ranks), group members are pre-sorted by rank so
+    each emitted pair already satisfies rank_i < rank_j — orientation comes
+    out of the join for free instead of costing a full-size gather + where
+    pass over billions of pairs. Emits int32 indices when the table allows.
+    """
+    rows = np.flatnonzero(codes >= 0).astype(_idx_dtype(len(codes)))
+    if order is not None:
+        rows = rows[np.argsort(order[rows], kind="stable")]
     rows_sorted, _, starts, sizes = _sort_groups(codes, rows)
     native_out = native.self_join_pairs(rows_sorted, starts, sizes)
     if native_out is not None:
@@ -202,6 +216,21 @@ def _uid_ranks(table: EncodedTable, link_type: str):
     return cache[link_type]
 
 
+def _drop_equal_key_pairs(
+    table: EncodedTable, link_type: str, i: np.ndarray, j: np.ndarray
+):
+    """Drop pairs whose ordering keys collide (duplicate uids in the input):
+    the reference's strict l.uid < r.uid / (source, uid) ordering excludes
+    them. Only reached when the input really contains duplicates."""
+    uid = table.unique_id
+    if link_type == "link_and_dedupe":
+        st = table.source_table
+        keep = ~((st[i] == st[j]) & (uid[i] == uid[j]))
+    else:
+        keep = uid[i] != uid[j]
+    return i[keep], j[keep]
+
+
 def _orient_pairs(table: EncodedTable, link_type: str, i: np.ndarray, j: np.ndarray):
     """Apply the reference's where-condition semantics to unordered pairs."""
     if link_type == "dedupe_only":
@@ -269,15 +298,14 @@ def block_using_rules(
     if not rules:
         return cartesian_block(settings, table, n_left)
 
-    all_rows = np.arange(table.n_rows, dtype=np.int64)
-    if link_type == "link_only":
-        assert n_left is not None
-        left_rows, right_rows = all_rows[:n_left], all_rows[n_left:]
-
     # Pair indices are stored int32 when the table allows (they always do —
     # int32 row indices cover 2^31 rows); at billions of candidate pairs this
     # halves the resident footprint of the pair set.
-    idx_dtype = np.int32 if table.n_rows < 2**31 else np.int64
+    idx_dtype = _idx_dtype(table.n_rows)
+    all_rows = np.arange(table.n_rows, dtype=idx_dtype)
+    if link_type == "link_only":
+        assert n_left is not None
+        left_rows, right_rows = all_rows[:n_left], all_rows[n_left:]
 
     # Sequential-rule dedup by PREDICATE, the literal semantics of the
     # reference's ``AND NOT ifnull(previous_rule, false)``
@@ -296,9 +324,16 @@ def block_using_rules(
         if join_cols:
             codes = _key_codes(table, join_cols)
             if link_type == "link_only":
+                # oriented by construction: left input on the l side
                 i, j = _cross_join(codes, left_rows, right_rows)
             else:
-                i, j = _self_join(codes)
+                # group members pre-sorted by uid rank -> pairs come out
+                # already oriented; only duplicate-key inputs need the
+                # drop-equal pass
+                ranks, keys_unique = _uid_ranks(table, link_type)
+                i, j = _self_join(codes, order=ranks)
+                if not keys_unique:
+                    i, j = _drop_equal_key_pairs(table, link_type, i, j)
         else:
             codes = None
             warnings.warn(
@@ -306,8 +341,7 @@ def block_using_rules(
                 "it against all row pairs (quadratic)."
             )
             i, j = _all_pairs(table, link_type, n_left)
-
-        i, j = _orient_pairs(table, link_type, i, j)
+            i, j = _orient_pairs(table, link_type, i, j)
         if residual is not None:
             i, j = _eval_residual(table, residual, i, j)
 
@@ -384,5 +418,5 @@ def cartesian_block(
     link_type = settings["link_type"]
     i, j = _all_pairs(table, link_type, n_left)
     i, j = _orient_pairs(table, link_type, i, j)
-    idx_dtype = np.int32 if table.n_rows < 2**31 else np.int64
+    idx_dtype = _idx_dtype(table.n_rows)
     return PairIndex(i.astype(idx_dtype, copy=False), j.astype(idx_dtype, copy=False))

@@ -187,8 +187,9 @@ def parse_blocking_rule(rule: str):
     s = re.sub(r"(?i)\bdmetaphone\(\s*(l|r)\.(\w+)\s*\)", r"\1.__dm_\2", s)
     if not s:
         raise SqlTranslationError("Empty blocking rule")
-    # Split on top-level AND only (no parens handling needed for AND of terms)
-    terms = re.split(r"(?i)\s+and\s+", s) if _is_top_level_and(s) else [s]
+    # Split on top-level AND only — quote- and paren-aware, so literals like
+    # 'rock and roll' or nested (a AND b) groups don't steer the split.
+    terms = [t for t in (p.strip() for p in _split_top_level(s, "and")) if t]
 
     eq_pairs = []
     residual_terms = []
@@ -203,19 +204,6 @@ def parse_blocking_rule(rule: str):
     if residual_terms:
         residual = sql_predicate_to_python(" and ".join(f"({t})" for t in residual_terms))
     return eq_pairs, residual
-
-
-def _is_top_level_and(s: str) -> bool:
-    """True if every AND in s sits at paren depth 0 (so splitting is safe)."""
-    depth = 0
-    for i, ch in enumerate(s):
-        if ch == "(":
-            depth += 1
-        elif ch == ")":
-            depth -= 1
-        elif depth > 0 and s[i : i + 4].lower() == " and":
-            return False
-    return True
 
 
 def sql_predicate_to_python(pred: str) -> str:

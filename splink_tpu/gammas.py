@@ -418,20 +418,24 @@ class GammaProgram:
 
         cols = settings["comparison_columns"]
 
+        # The packed table is an explicit argument, NOT a closure capture: a
+        # captured device array becomes a jaxpr constant, and at millions of
+        # rows that constant is serialised into the compile request (observed
+        # as HTTP 413 from the tunnelled TPU's remote-compile at ~4M rows).
         @jax.jit
-        def _gamma_batch(idx_l, idx_r):
-            rows_l = self._packed[idx_l]
-            rows_r = self._packed[idx_r]
+        def _gamma_batch_p(packed, idx_l, idx_r):
+            rows_l = packed[idx_l]
+            rows_r = packed[idx_r]
             ctx = PairContext(layout, rows_l, rows_r, reverse)
             gammas = [_spec_gamma(c, ctx) for c in cols]
             return jnp.stack(gammas, axis=1)
 
-        self._gamma_batch = _gamma_batch
+        self._gamma_batch = lambda il, ir: _gamma_batch_p(self._packed, il, ir)
 
         # The compiled-artifact analogue of the reference logging its
         # generated SQL at debug level (/root/reference/splink/gammas.py:120).
         probe = jnp.zeros(8, jnp.int32)
-        log_jaxpr("gamma_program", _gamma_batch, probe, probe)
+        log_jaxpr("gamma_program", self._gamma_batch, probe, probe)
 
     def compute(
         self, idx_l: np.ndarray, idx_r: np.ndarray, batch_size: int = DEFAULT_PAIR_BATCH

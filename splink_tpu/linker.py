@@ -177,8 +177,6 @@ class Splink:
     def _run_em_resident(self, G: np.ndarray, compute_ll: bool) -> None:
         """Fused on-device EM with the gamma matrix resident in HBM."""
         dtype = np.float64 if self.settings["float64"] else np.float32
-        lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
-
         mesh = mesh_from_settings(self.settings)
         weights = None
         if mesh is not None:
@@ -186,7 +184,15 @@ class Splink:
             weights = weights.astype(dtype)
         else:
             G_dev = self._G_dev if self._G_dev is not None else jnp.asarray(G)
+        self._run_em_fused(G_dev, weights, compute_ll)
 
+    def _run_em_fused(self, G_dev, weights, compute_ll: bool) -> None:
+        """Shared fused-EM driver: whole-run while_loop normally, stepped one
+        update at a time when a save_state_fn checkpoint hook must run
+        between iterations (the restart semantics of
+        /root/reference/splink/iterate.py:54-55)."""
+        dtype = np.float64 if self.settings["float64"] else np.float32
+        lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
         init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
         max_iterations = int(self.settings["max_iterations"])
         em_kwargs = dict(
@@ -204,9 +210,6 @@ class Splink:
                 self._replay_history(result, compute_ll)
                 converged = bool(result.converged)
             else:
-                # Per-iteration checkpoint hook: step the fused EM one update
-                # at a time so save_state_fn really runs between iterations
-                # (the restart semantics of /root/reference/splink/iterate.py:54-55).
                 converged = False
                 params_dev = init
                 for _ in range(max_iterations):
@@ -274,37 +277,9 @@ class Splink:
     ) -> None:
         """Fused EM on a weighted pattern matrix (counts as weights)."""
         dtype = np.float64 if self.settings["float64"] else np.float32
-        lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
-        init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
-        G_dev = jnp.asarray(G_pat)
-        w_dev = jnp.asarray(weights.astype(dtype))
-        max_iterations = int(self.settings["max_iterations"])
-        em_kwargs = dict(
-            max_levels=self.params.max_levels,
-            em_convergence=self.settings["em_convergence"],
-            weights=w_dev,
-            compute_ll=compute_ll,
+        self._run_em_fused(
+            jnp.asarray(G_pat), jnp.asarray(weights.astype(dtype)), compute_ll
         )
-        with StageTimer("em"):
-            if self.save_state_fn is None:
-                result = run_em(
-                    G_dev, init, max_iterations=max_iterations, **em_kwargs
-                )
-                self._replay_history(result, compute_ll)
-                converged = bool(result.converged)
-            else:
-                converged = False
-                params_dev = init
-                for _ in range(max_iterations):
-                    result = run_em(G_dev, params_dev, max_iterations=1, **em_kwargs)
-                    params_dev = result.params
-                    self._replay_history(result, compute_ll)
-                    self.save_state_fn(self.params, self.settings)
-                    if bool(result.converged):
-                        converged = True
-                        break
-        if converged:
-            logger.info("EM algorithm has converged")
 
     def _run_em_streamed_stats(self, G: np.ndarray, compute_ll: bool) -> None:
         """Streaming EM accumulating sufficient statistics per pass — the
@@ -359,6 +334,13 @@ class Splink:
         """
         G = self._ensure_gammas()
         self._run_em(G, compute_ll)
+        yield from self.stream_scored_comparisons_after_em()
+
+    def stream_scored_comparisons_after_em(self):
+        """Yield scored-comparison chunks using the current parameters
+        (EM — or a loaded model — already applied); see
+        stream_scored_comparisons."""
+        G = self._ensure_gammas()
         batch = int(self.settings["pair_batch_size"])
         for s in range(0, len(G), batch):
             yield self._build_df_e(G, slice(s, min(s + batch, len(G))))

@@ -52,21 +52,50 @@ def _load() -> ctypes.CDLL | None:
         if not os.path.exists(_LIB_PATH) and not _build():
             return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
-            lib.encode_fixed_width.argtypes = [
-                _u8p, _i64p, ctypes.c_int64, ctypes.c_int64, _u8p, _i32p,
-            ]
-            lib.count_self_pairs.restype = ctypes.c_int64
-            lib.count_self_pairs.argtypes = [_i64p, ctypes.c_int64]
-            lib.emit_self_pairs.argtypes = [_i64p] * 3 + [ctypes.c_int64, _i64p, _i64p]
-            lib.count_cross_pairs.restype = ctypes.c_int64
-            lib.count_cross_pairs.argtypes = [_i64p, _i64p, ctypes.c_int64]
-            lib.emit_cross_pairs.argtypes = [_i64p] * 6 + [ctypes.c_int64, _i64p, _i64p]
-            _lib = lib
+            lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except AttributeError:
+            # Stale cached .so from an older source revision (missing a newer
+            # symbol): rebuild once, then retry; numpy fallback if that fails.
+            logger.debug("native lib stale; rebuilding")
+            try:
+                os.remove(_LIB_PATH)
+            except OSError:
+                pass
+            if _build():
+                try:
+                    _lib = _bind(ctypes.CDLL(_LIB_PATH))
+                except (OSError, AttributeError) as e:  # pragma: no cover
+                    logger.debug("native rebuild failed (%s); numpy fallbacks", e)
+                    _lib = None
+            else:
+                _lib = None
         except OSError as e:  # pragma: no cover
             logger.debug("native load failed (%s); using numpy fallbacks", e)
             _lib = None
+        else:
+            _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare signatures; raises AttributeError if the .so is stale."""
+    lib.encode_fixed_width.argtypes = [
+        _u8p, _i64p, ctypes.c_int64, ctypes.c_int64, _u8p, _i32p,
+    ]
+    lib.count_self_pairs.restype = ctypes.c_int64
+    lib.count_self_pairs.argtypes = [_i64p, ctypes.c_int64]
+    lib.emit_self_pairs.argtypes = [_i64p] * 3 + [ctypes.c_int64, _i64p, _i64p]
+    lib.emit_self_pairs_i32.argtypes = [
+        _i32p, _i64p, _i64p, ctypes.c_int64, _i32p, _i32p,
+    ]
+    lib.count_cross_pairs.restype = ctypes.c_int64
+    lib.count_cross_pairs.argtypes = [_i64p, _i64p, ctypes.c_int64]
+    lib.emit_cross_pairs.argtypes = [_i64p] * 6 + [ctypes.c_int64, _i64p, _i64p]
+    lib.emit_cross_pairs_i32.argtypes = [
+        _i32p, _i64p, _i64p, _i32p, _i64p, _i64p,
+        ctypes.c_int64, _i32p, _i32p,
+    ]
+    return lib
 
 
 def available() -> bool:
@@ -97,37 +126,69 @@ def encode_fixed_width(data: np.ndarray, offsets: np.ndarray, width: int):
 
 
 def self_join_pairs(rows_sorted: np.ndarray, starts: np.ndarray, sizes: np.ndarray):
-    """Emit all unordered within-group pairs; None -> caller uses numpy path."""
+    """Emit all unordered within-group pairs; None -> caller uses numpy path.
+
+    Output dtype follows the rows dtype: int32 rows emit int32 pairs (the
+    preferred path — at billions of pairs the index buffers dominate host
+    memory), anything else goes through the int64 kernel.
+    """
     lib = _load()
     if lib is None:
         return None
-    rows_sorted = np.ascontiguousarray(rows_sorted, np.int64)
     starts = np.ascontiguousarray(starts, np.int64)
     sizes = np.ascontiguousarray(sizes, np.int64)
     total = lib.count_self_pairs(_ptr(sizes, _i64p), len(sizes))
+    if rows_sorted.dtype == np.int32:
+        rows32 = np.ascontiguousarray(rows_sorted, np.int32)
+        out_i = np.empty(total, np.int32)
+        out_j = np.empty(total, np.int32)
+        lib.emit_self_pairs_i32(
+            _ptr(rows32, _i32p), _ptr(starts, _i64p), _ptr(sizes, _i64p),
+            len(sizes), _ptr(out_i, _i32p), _ptr(out_j, _i32p),
+        )
+        return out_i, out_j
+    rows64 = np.ascontiguousarray(rows_sorted, np.int64)
     out_i = np.empty(total, np.int64)
     out_j = np.empty(total, np.int64)
     lib.emit_self_pairs(
-        _ptr(rows_sorted, _i64p), _ptr(starts, _i64p), _ptr(sizes, _i64p),
+        _ptr(rows64, _i64p), _ptr(starts, _i64p), _ptr(sizes, _i64p),
         len(sizes), _ptr(out_i, _i64p), _ptr(out_j, _i64p),
     )
     return out_i, out_j
 
 
 def cross_join_pairs(l_rows, l_starts, l_sizes, r_rows, r_starts, r_sizes):
-    """Emit all cross-table pairs for matched key groups; None -> numpy path."""
+    """Emit all cross-table pairs for matched key groups; None -> numpy path.
+
+    Like self_join_pairs, int32 row arrays use the int32 kernel."""
     lib = _load()
     if lib is None:
         return None
-    arrs = [
-        np.ascontiguousarray(a, np.int64)
-        for a in (l_rows, l_starts, l_sizes, r_rows, r_starts, r_sizes)
-    ]
-    total = lib.count_cross_pairs(_ptr(arrs[2], _i64p), _ptr(arrs[5], _i64p), len(arrs[2]))
+    l_starts = np.ascontiguousarray(l_starts, np.int64)
+    l_sizes = np.ascontiguousarray(l_sizes, np.int64)
+    r_starts = np.ascontiguousarray(r_starts, np.int64)
+    r_sizes = np.ascontiguousarray(r_sizes, np.int64)
+    total = lib.count_cross_pairs(
+        _ptr(l_sizes, _i64p), _ptr(r_sizes, _i64p), len(l_sizes)
+    )
+    if l_rows.dtype == np.int32 and r_rows.dtype == np.int32:
+        lr = np.ascontiguousarray(l_rows, np.int32)
+        rr = np.ascontiguousarray(r_rows, np.int32)
+        out_i = np.empty(total, np.int32)
+        out_j = np.empty(total, np.int32)
+        lib.emit_cross_pairs_i32(
+            _ptr(lr, _i32p), _ptr(l_starts, _i64p), _ptr(l_sizes, _i64p),
+            _ptr(rr, _i32p), _ptr(r_starts, _i64p), _ptr(r_sizes, _i64p),
+            len(l_sizes), _ptr(out_i, _i32p), _ptr(out_j, _i32p),
+        )
+        return out_i, out_j
+    lr = np.ascontiguousarray(l_rows, np.int64)
+    rr = np.ascontiguousarray(r_rows, np.int64)
     out_i = np.empty(total, np.int64)
     out_j = np.empty(total, np.int64)
     lib.emit_cross_pairs(
-        *(_ptr(a, _i64p) for a in arrs), len(arrs[2]),
-        _ptr(out_i, _i64p), _ptr(out_j, _i64p),
+        _ptr(lr, _i64p), _ptr(l_starts, _i64p), _ptr(l_sizes, _i64p),
+        _ptr(rr, _i64p), _ptr(r_starts, _i64p), _ptr(r_sizes, _i64p),
+        len(l_sizes), _ptr(out_i, _i64p), _ptr(out_j, _i64p),
     )
     return out_i, out_j

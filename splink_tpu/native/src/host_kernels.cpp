@@ -71,6 +71,26 @@ void emit_self_pairs(const int64_t* rows, const int64_t* group_starts,
   }
 }
 
+// int32 variant: at billions of candidate pairs the pair-index buffers are
+// the dominant host allocation, and int32 row indices cover 2^31 rows.
+void emit_self_pairs_i32(const int32_t* rows, const int64_t* group_starts,
+                         const int64_t* group_sizes, int64_t n_groups,
+                         int32_t* out_i, int32_t* out_j) {
+  int64_t k = 0;
+  for (int64_t g = 0; g < n_groups; ++g) {
+    const int64_t start = group_starts[g];
+    const int64_t s = group_sizes[g];
+    for (int64_t p = 0; p < s; ++p) {
+      const int32_t rp = rows[start + p];
+      for (int64_t q = p + 1; q < s; ++q) {
+        out_i[k] = rp;
+        out_j[k] = rows[start + q];
+        ++k;
+      }
+    }
+  }
+}
+
 // ---------------------------------------------------------------------------
 // Cross-join pair emission (link_only): for each key present on both sides,
 // emit the full left-group x right-group product.
@@ -91,6 +111,25 @@ void emit_cross_pairs(const int64_t* l_rows, const int64_t* l_starts,
     const int64_t rs = r_starts[g], re = rs + r_sizes[g];
     for (int64_t a = ls; a < le; ++a) {
       const int64_t ra = l_rows[a];
+      for (int64_t b = rs; b < re; ++b) {
+        out_i[k] = ra;
+        out_j[k] = r_rows[b];
+        ++k;
+      }
+    }
+  }
+}
+
+void emit_cross_pairs_i32(const int32_t* l_rows, const int64_t* l_starts,
+                          const int64_t* l_sizes, const int32_t* r_rows,
+                          const int64_t* r_starts, const int64_t* r_sizes,
+                          int64_t n_groups, int32_t* out_i, int32_t* out_j) {
+  int64_t k = 0;
+  for (int64_t g = 0; g < n_groups; ++g) {
+    const int64_t ls = l_starts[g], le = ls + l_sizes[g];
+    const int64_t rs = r_starts[g], re = rs + r_sizes[g];
+    for (int64_t a = ls; a < le; ++a) {
+      const int32_t ra = l_rows[a];
       for (int64_t b = rs; b < re; ++b) {
         out_i[k] = ra;
         out_j[k] = r_rows[b];
