@@ -284,7 +284,15 @@ class Splink:
     def _run_em_streamed_stats(self, G: np.ndarray, compute_ll: bool) -> None:
         """Streaming EM accumulating sufficient statistics per pass — the
         fallback when the pattern space is too large for a dense histogram,
-        and the mesh path (stats psum across devices)."""
+        and the mesh path (stats psum across devices).
+
+        Under a multi-controller run (jax.process_count() > 1) each host
+        streams only its global_pair_slice of the pair set; the psum inside
+        the sharded stats makes the union a global aggregate, like every
+        host's Spark executor reading its own partitions."""
+        import jax
+
+        from .parallel.distributed import global_pair_slice
         from .parallel.streaming import run_em_streamed
 
         dtype = np.float64 if self.settings["float64"] else np.float32
@@ -292,6 +300,8 @@ class Splink:
         init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
         batch = int(self.settings["pair_batch_size"])
         mesh = mesh_from_settings(self.settings)
+        if jax.process_count() > 1:
+            G = G[global_pair_slice(len(G))]
 
         def batches():
             for s in range(0, len(G), batch):

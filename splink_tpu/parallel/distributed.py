@@ -1,4 +1,4 @@
-"""Multi-host (multi-slice) initialisation.
+"""Multi-host (multi-slice) initialisation and work partitioning.
 
 The reference's multi-machine story is "submit to a Spark cluster". The
 splink_tpu analogue is JAX multi-controller: each host runs the same program,
@@ -7,11 +7,21 @@ spans every chip; XLA routes the M-step psum over ICI within a slice and DCN
 across slices. EM's collective traffic is tiny (the SufficientStats pytree,
 a few KB), so DCN latency is irrelevant — the design scales to any slice
 count the pair stream can feed.
+
+Support status (honest): the single-process path and the partitioning
+arithmetic are tested (tests/test_distributed.py); sharded EM correctness is
+proven on an 8-virtual-device mesh (tests/test_sharding.py). Real multi-host
+bring-up follows the standard jax.distributed.initialize pattern but has not
+run on a physical pod from this repo.
 """
 
 from __future__ import annotations
 
+import logging
+
 import jax
+
+logger = logging.getLogger("splink_tpu")
 
 
 def initialize_multihost(
@@ -19,22 +29,33 @@ def initialize_multihost(
     num_processes: int | None = None,
     process_id: int | None = None,
 ) -> None:
-    """Initialise JAX's multi-controller runtime (no-op if single-process).
+    """Initialise JAX's multi-controller runtime.
 
     On TPU pods the arguments are auto-detected from the environment; pass
-    them explicitly for manual bring-up.
+    them explicitly for manual bring-up. With no arguments and no cluster
+    environment this is a logged no-op (single-process run); explicit
+    arguments that fail to connect raise — a misconfigured cluster must not
+    silently degrade to one host.
     """
     if jax.process_count() > 1:
         return  # already initialised
+    explicit = coordinator_address is not None
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    except (ValueError, RuntimeError):
-        # Single-process environment (no coordinator): run locally.
-        pass
+    except (ValueError, RuntimeError) as e:
+        if explicit:
+            raise RuntimeError(
+                f"jax.distributed.initialize failed for coordinator "
+                f"{coordinator_address!r}: {e}"
+            ) from e
+        logger.info(
+            "no multi-host environment detected (%s); running single-process",
+            e,
+        )
 
 
 def global_pair_slice(n_pairs_global: int) -> slice:
@@ -42,5 +63,5 @@ def global_pair_slice(n_pairs_global: int) -> slice:
     for feeding. Hosts stream disjoint slices; the psum in the EM stats makes
     the union behave like one global aggregate."""
     per = -(-n_pairs_global // jax.process_count())
-    start = jax.process_index() * per
+    start = min(jax.process_index() * per, n_pairs_global)
     return slice(start, min(start + per, n_pairs_global))

@@ -1,0 +1,99 @@
+"""Multi-host partitioning and initialisation semantics.
+
+Real multi-host cannot run in CI; these tests pin the process_count=1 path
+and the partitioning arithmetic under mocked process topology (the JAX
+analogue of testing Spark partitioning logic without a cluster)."""
+
+import numpy as np
+import pytest
+
+import splink_tpu.parallel.distributed as dist
+
+
+def test_single_process_slice_covers_everything():
+    assert dist.global_pair_slice(1000) == slice(0, 1000)
+    assert dist.global_pair_slice(0) == slice(0, 0)
+
+
+def test_initialize_multihost_single_process_is_noop():
+    # no coordinator, no cluster env: logged no-op, no raise
+    dist.initialize_multihost()
+
+
+def test_initialize_multihost_explicit_misconfig_raises():
+    with pytest.raises((RuntimeError, ValueError)):
+        dist.initialize_multihost(
+            coordinator_address="256.0.0.1:0",  # invalid address
+            num_processes=2,
+            process_id=0,
+        )
+
+
+@pytest.mark.parametrize("n_procs", [2, 3, 8])
+@pytest.mark.parametrize("n_pairs", [0, 1, 7, 1000, 1001])
+def test_slices_partition_the_pair_axis(monkeypatch, n_procs, n_pairs):
+    """Across all processes the slices are disjoint, ordered, cover [0, n),
+    and are balanced to within one batch."""
+    import jax
+
+    slices = []
+    monkeypatch.setattr(jax, "process_count", lambda: n_procs)
+    for pid in range(n_procs):
+        monkeypatch.setattr(jax, "process_index", lambda pid=pid: pid)
+        slices.append(dist.global_pair_slice(n_pairs))
+
+    covered = []
+    for s in slices:
+        assert 0 <= s.start <= s.stop <= n_pairs
+        covered.extend(range(s.start, s.stop))
+    assert covered == list(range(n_pairs))
+    sizes = [s.stop - s.start for s in slices]
+    assert max(sizes) <= -(-n_pairs // n_procs) if n_pairs else max(sizes) == 0
+
+
+def test_multihost_streamed_em_equals_single_host(monkeypatch):
+    """Simulate two controller processes: each runs streamed-stats EM over
+    its global_pair_slice, their per-pass sufficient statistics are summed
+    (what the psum does on a real pod), and the parameter trajectory must
+    equal the single-host run."""
+    import jax.numpy as jnp
+
+    from splink_tpu.models.fellegi_sunter import (
+        FSParams,
+        sufficient_stats,
+        match_probability,
+        update_params,
+    )
+
+    rng = np.random.default_rng(0)
+    N, C = 10_000, 2
+    G = rng.integers(-1, 3, size=(N, C)).astype(np.int8)
+    init = FSParams(
+        lam=jnp.asarray(0.4),
+        m=jnp.asarray(np.tile([0.1, 0.2, 0.7], (C, 1))),
+        u=jnp.asarray(np.tile([0.7, 0.2, 0.1], (C, 1))),
+    )
+
+    def one_pass(params, Gs):
+        p = match_probability(jnp.asarray(Gs), params)
+        return sufficient_stats(jnp.asarray(Gs), p, 3)
+
+    # single host
+    single = update_params(one_pass(init, G))
+
+    # two simulated hosts: disjoint slices, stats added (the psum)
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    parts = []
+    for pid in range(2):
+        monkeypatch.setattr(jax, "process_index", lambda pid=pid: pid)
+        sl = dist.global_pair_slice(N)
+        parts.append(one_pass(init, G[sl]))
+    combined = update_params(parts[0] + parts[1])
+
+    np.testing.assert_allclose(np.asarray(combined.m), np.asarray(single.m), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(combined.u), np.asarray(single.u), rtol=1e-12)
+    np.testing.assert_allclose(
+        float(combined.lam), float(single.lam), rtol=1e-12
+    )
