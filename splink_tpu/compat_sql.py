@@ -127,9 +127,21 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
 
     raise SqlTranslationError(
         "Could not translate this case_expression into a splink_tpu comparison "
-        f"spec: {expr!r}. Provide a native spec instead, e.g. "
-        '{"comparison": {"kind": "jaro_winkler", "thresholds": [0.94, 0.88]}} '
-        "or register a custom comparison with splink_tpu.register_comparison()."
+        f"spec: {expr!r}.\n"
+        "Recognised CASE families (the shapes the reference's generators "
+        "emit, /root/reference/splink/case_statements.py:62-277):\n"
+        "  * strict equality                  -> kind 'exact'\n"
+        "  * jaro_winkler_sim(...) > t chains -> kind 'jaro_winkler'\n"
+        "  * levenshtein ratio <= t chains    -> kind 'levenshtein'\n"
+        "  * abs(a - b) < t chains            -> kind 'numeric_abs'\n"
+        "  * abs(a - b)/abs(max) < t chains   -> kind 'numeric_perc'\n"
+        "  * dmetaphone equality (2/3 level)  -> kind 'dmetaphone'\n"
+        "  * name-inversion jw + ifnull OR    -> kind 'name_inversion'\n"
+        "Hand-written CASE expressions outside these shapes cannot be "
+        "auto-migrated: provide a native spec instead, e.g. "
+        '{"comparison": {"kind": "jaro_winkler", "thresholds": [0.94, 0.88]}}, '
+        "or implement the logic with splink_tpu.register_comparison() and "
+        '{"comparison": {"kind": "custom", "name": ...}}.'
     )
 
 

@@ -62,6 +62,13 @@ class Splink:
             spark: ignored (the reference's SparkSession slot).
         """
         self.settings = complete_settings_dict(settings)
+        backend = self.settings["backend"]
+        if backend != "jax":  # schema enum also rejects; double-checked here
+            raise ValueError(
+                f"Unsupported backend {backend!r}: this build executes the "
+                "compute path with jax/XLA only."
+            )
+        logger.debug("execution backend: %s", backend)
         self.params = Params(self.settings, complete=False)
         self.df = df
         self.df_l = df_l

@@ -57,3 +57,18 @@ def test_blocking_rule_is_null_predicates():
     r = {"age": np.array([np.nan, np.nan])}
     out = eval(residual, {"_isna": pd.isna}, {"l": l, "r": r})
     assert list(out) == [True, False]
+
+
+def test_unrecognised_case_expression_lists_supported_shapes():
+    import pytest
+
+    from splink_tpu.compat_sql import SqlTranslationError, parse_case_expression
+
+    with pytest.raises(SqlTranslationError) as e:
+        parse_case_expression(
+            "case when soundex(col_l) = soundex(col_r) then 1 else 0 end", 2
+        )
+    msg = str(e.value)
+    for expected in ("jaro_winkler", "levenshtein", "numeric_abs",
+                     "register_comparison", "dmetaphone"):
+        assert expected in msg

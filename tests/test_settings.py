@@ -139,3 +139,23 @@ def test_levels_above_four_need_explicit_config():
         complete_settings_dict(
             _minimal(comparison_columns=[{"col_name": "a", "num_levels": 5}])
         )
+
+
+def test_backend_key_is_read_and_checked():
+    import pandas as pd
+    import pytest
+
+    from splink_tpu import Splink
+
+    df = pd.DataFrame({"unique_id": [0, 1], "a": ["x", "y"]})
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "a", "comparison": {"kind": "exact"}}],
+        "blocking_rules": ["l.a = r.a"],
+    }
+    # schema enum rejects unknown backends at validation
+    with pytest.raises(Exception):
+        Splink({**s, "backend": "torch"}, df=df)
+    # and the accepted value flows through
+    linker = Splink({**s, "backend": "jax"}, df=df)
+    assert linker.settings["backend"] == "jax"
