@@ -354,13 +354,13 @@ def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
 
     if kind == "qgram_jaccard":
         sim = qgram_ops.qgram_jaccard(
-            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, spec.get("q", 2), 256
+            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, spec.get("q", 2)
         )
         return bucket_similarity(sim, thresholds, pc.null)
 
     if kind == "qgram_cosine":
         sim = 1.0 - qgram_ops.qgram_cosine_distance(
-            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, spec.get("q", 2), 256
+            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, spec.get("q", 2)
         )
         return bucket_similarity(sim, thresholds, pc.null)
 

