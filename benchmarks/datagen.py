@@ -53,17 +53,28 @@ _SYL2 = ["bert", "dan", "fred", "lia", "line", "mund", "nard", "rick", "son",
 
 
 def _name_pool(rng, base: list[str], size: int) -> np.ndarray:
-    """Expand a real-name seed list to `size` distinct names with generated
+    """Expand a real-name seed list to `size` DISTINCT names with generated
     syllable combinations, keeping a Zipf-ish frequency skew (real names are
-    heavy-tailed, which is exactly what term-frequency adjustment exploits)."""
-    pool = list(base)
-    while len(pool) < size:
-        pool.append(
-            _SYL1[rng.integers(len(_SYL1))]
-            + _SYL2[rng.integers(len(_SYL2))]
-            + (_SYL2[rng.integers(len(_SYL2))] if rng.random() < 0.3 else "")
-        )
-    pool = np.array(sorted(set(pool)))
+    heavy-tailed, which is exactly what term-frequency adjustment exploits).
+
+    Distinctness matters: an earlier version sampled random 2-3 syllable
+    combos and deduped, silently capping the pool at ~2.8k names — at 10M
+    rows that made name-equality blocking rules explode into billions of
+    spurious pairs and handed EM a dominant same-name cluster."""
+    import itertools
+
+    pool = set(base)
+    # enumerate syllable products of increasing length until enough distinct
+    for n_syl in (2, 3, 4, 5):
+        if len(pool) >= size:
+            break
+        parts = [_SYL1] + [_SYL2] * (n_syl - 1)
+        for combo in itertools.product(*parts):
+            pool.add("".join(combo))
+            if len(pool) >= size:
+                break
+    pool = np.array(sorted(pool))
+    rng.shuffle(pool)  # detach frequency rank from alphabetical order
     weights = 1.0 / np.arange(1, len(pool) + 1) ** 0.8
     return pool, weights / weights.sum()
 
