@@ -45,6 +45,10 @@ logger = logging.getLogger("splink_tpu")
 
 DEFAULT_PAIR_BATCH = 1 << 20
 
+# Largest dense gamma-pattern space the pattern-id pipeline handles; beyond
+# this the linker streams sufficient statistics instead.
+MAX_PATTERNS = 1 << 22
+
 # Registry for custom comparisons: name -> callable(ctx, col_settings) -> gamma
 _CUSTOM_COMPARISONS: dict[str, callable] = {}
 
@@ -436,6 +440,96 @@ class GammaProgram:
         # generated SQL at debug level (/root/reference/splink/gammas.py:120).
         probe = jnp.zeros(8, jnp.int32)
         log_jaxpr("gamma_program", self._gamma_batch, probe, probe)
+
+        # Pattern-id pipeline: gamma vectors mixed-radix-encode into a single
+        # pattern id (strides over levels_c + 1), the complete sufficient
+        # statistic per pair. One device pass then yields BOTH the per-pair
+        # ids (int16/int32 host array, 3x smaller than the gamma matrix) and
+        # their histogram (EM's input); scoring afterwards is a host LUT
+        # gather with no further device traffic.
+        self.level_counts = [int(c["num_levels"]) for c in cols]
+        strides, self.n_patterns = pattern_strides_for(self.level_counts)
+        if self.n_patterns <= MAX_PATTERNS:
+            strides_dev = jnp.asarray(strides, jnp.int32)
+
+            @jax.jit
+            def _pattern_batch(packed, idx_l, idx_r, valid, acc):
+                G = _gamma_batch_p(packed, idx_l, idx_r).astype(jnp.int32)
+                pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
+                masked = jnp.where(
+                    jnp.arange(pid.shape[0]) < valid, pid, self.n_patterns
+                )
+                acc = acc + jnp.bincount(masked, length=self.n_patterns + 1)
+                return pid, acc
+
+            self._pattern_batch = lambda il, ir, v, acc: _pattern_batch(
+                self._packed, il, ir, v, acc
+            )
+        else:
+            # pattern space too large (strides overflow int32 well before the
+            # dense histogram would OOM); callers must use the gamma-matrix
+            # paths
+            self._pattern_batch = None
+
+    def compute_pattern_ids(
+        self,
+        idx_l: np.ndarray,
+        idx_r: np.ndarray,
+        batch_size: int = DEFAULT_PAIR_BATCH,
+    ):
+        """One pass over the pair set: (pattern_ids, counts).
+
+        pattern_ids is (n,) uint16 when the pattern space allows (int32
+        otherwise); counts is the (n_patterns,) int64 histogram. The int32
+        device accumulator flushes to host int64 every _HIST_FLUSH_BATCHES
+        batches so counts cannot overflow.
+        """
+        if self._pattern_batch is None:
+            raise ValueError(
+                f"pattern space {self.n_patterns} exceeds MAX_PATTERNS "
+                f"({MAX_PATTERNS}); use the gamma-matrix paths"
+            )
+        n = len(idx_l)
+        id_dtype = np.uint16 if self.n_patterns <= (1 << 16) else np.int32
+        pids = np.empty(n, id_dtype)
+        total = np.zeros(self.n_patterns, np.int64)
+        if n == 0:
+            return pids, total
+        batch_size = min(batch_size, max(n, 1))
+        flush_every = max(min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1)
+        acc = jnp.zeros(self.n_patterns + 1, jnp.int32)
+        in_acc = 0
+        pending = None
+        for start in range(0, n, batch_size):
+            stop = min(start + batch_size, n)
+            bl = idx_l[start:stop]
+            br = idx_r[start:stop]
+            if stop - start < batch_size:
+                pad = batch_size - (stop - start)
+                bl = np.concatenate([bl, np.zeros(pad, bl.dtype)])
+                br = np.concatenate([br, np.zeros(pad, br.dtype)])
+            pid, acc = self._pattern_batch(
+                jnp.asarray(bl), jnp.asarray(br), stop - start, acc
+            )
+            if pending is not None:
+                ps, pe, prev = pending
+                pids[ps:pe] = np.asarray(prev)[: pe - ps].astype(id_dtype)
+            pending = (start, stop, pid)
+            in_acc += 1
+            if in_acc >= flush_every:
+                total += np.asarray(acc[:-1], np.int64)
+                acc = jnp.zeros(self.n_patterns + 1, jnp.int32)
+                in_acc = 0
+        ps, pe, prev = pending
+        pids[ps:pe] = np.asarray(prev)[: pe - ps].astype(id_dtype)
+        if in_acc:
+            total += np.asarray(acc[:-1], np.int64)
+        return pids, total
+
+    def patterns_matrix(self) -> np.ndarray:
+        """(n_patterns, n_cols) int8: the gamma row each pattern id decodes
+        to."""
+        return patterns_matrix_for(self.level_counts)
 
     def compute(
         self, idx_l: np.ndarray, idx_r: np.ndarray, batch_size: int = DEFAULT_PAIR_BATCH

@@ -80,6 +80,9 @@ class Splink:
         self._pairs: PairIndex | None = None
         self._G: np.ndarray | None = None
         self._G_dev = None  # device-resident copy (resident regime only)
+        self._P: np.ndarray | None = None  # per-pair pattern ids (streamed)
+        self._pattern_counts: np.ndarray | None = None
+        self._pattern_program = None
 
     # ------------------------------------------------------------------
 
@@ -146,6 +149,93 @@ class Splink:
                 )
         return self._G
 
+    def _use_pattern_pipeline(self) -> bool:
+        """Whether the streamed pattern-id pipeline applies: large pair set,
+        bounded pattern space, no mesh (the mesh path shards gamma batches),
+        and no custom comparison kernels — a registered kernel could emit
+        gammas outside [-1, num_levels-1], which would alias pattern ids."""
+        from .gammas import MAX_PATTERNS, pattern_strides_for
+
+        pairs = self._ensure_pairs()
+        if pairs.n_pairs <= int(self.settings["max_resident_pairs"]):
+            return False
+        if mesh_from_settings(self.settings) is not None:
+            return False
+        for c in self.settings["comparison_columns"]:
+            if (c.get("comparison") or {}).get("kind") == "custom":
+                return False
+        level_counts = [
+            int(c["num_levels"]) for c in self.settings["comparison_columns"]
+        ]
+        _, n_patterns = pattern_strides_for(level_counts)
+        return n_patterns <= MAX_PATTERNS
+
+    def _ensure_pattern_ids(self):
+        """(pattern_ids, counts, program): ONE device pass over the pair
+        index computing gammas, pattern ids and their histogram. The gamma
+        matrix itself never materialises — per-pair state is a uint16/int32
+        id, and every later stage (EM, scoring, output columns) derives from
+        the ≤ prod(levels+1)-row pattern tables. This is also what keeps
+        host<->device traffic to a single pass over the pairs."""
+        if self._P is None:
+            table = self._ensure_encoded()
+            pairs = self._ensure_pairs()
+            with StageTimer("gammas_patterns"):
+                self._pattern_program = GammaProgram(self.settings, table)
+                self._P, self._pattern_counts = (
+                    self._pattern_program.compute_pattern_ids(
+                        pairs.idx_l,
+                        pairs.idx_r,
+                        batch_size=self.settings["pair_batch_size"],
+                    )
+                )
+        return self._P, self._pattern_counts, self._pattern_program
+
+    def _pattern_score_luts(self):
+        """Per-pattern lookup tables (host): match probability and, when
+        intermediates are retained, per-column prob_m/prob_u. Reuses the
+        batched scoring path, which bounds HBM at any pattern count."""
+        _, _, program = self._ensure_pattern_ids()
+        PM = program.patterns_matrix()
+        dtype = np.float64 if self.settings["float64"] else np.float32
+        lam, m, u, _ = self.params.to_arrays(dtype=dtype)
+        params_dev = FSParams(
+            lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)
+        )
+        p, pm, pu = self._score_batched(PM, params_dev)
+        return PM, p, pm, pu
+
+    def _stream_pattern_chunks(self):
+        """Yield scored chunks from the pattern-id pipeline: pure numpy LUT
+        gathers per chunk, no device round-trips."""
+        P, _, _ = self._ensure_pattern_ids()
+        pairs = self._ensure_pairs()
+        PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
+        batch = int(self.settings["pair_batch_size"])
+        with StageTimer("score_patterns"):
+            for s in range(0, len(P), batch):
+                rows = slice(s, min(s + batch, len(P)))
+                Pc = P[rows].astype(np.int32, copy=False)
+                yield self._assemble_df_e(
+                    PM[Pc],
+                    pairs.idx_l[rows],
+                    pairs.idx_r[rows],
+                    p_lut[Pc],
+                    pm_lut[Pc] if pm_lut is not None else None,
+                    pu_lut[Pc] if pu_lut is not None else None,
+                )
+
+    def _run_em_patterns(self, compute_ll: bool) -> None:
+        _, counts, program = self._ensure_pattern_ids()
+        patterns = program.patterns_matrix()
+        seen = counts > 0
+        logger.info(
+            "pattern-compressed EM: %d pairs -> %d distinct gamma patterns",
+            int(counts.sum()),
+            int(seen.sum()),
+        )
+        self._run_em_resident_weighted(patterns[seen], counts[seen], compute_ll)
+
     # ------------------------------------------------------------------
     # Public API (reference parity)
     # ------------------------------------------------------------------
@@ -153,6 +243,10 @@ class Splink:
     def manually_apply_fellegi_sunter_weights(self):
         """Score using the m/u values in the settings, without running EM
         (/root/reference/splink/__init__.py:111-119)."""
+        if self._use_pattern_pipeline():
+            return pd.concat(
+                list(self._stream_pattern_chunks()), ignore_index=True
+            )
         G = self._ensure_gammas()
         df_e = self._build_df_e(G)
         self._G_dev = None  # release the HBM copy once scoring is done
@@ -162,12 +256,17 @@ class Splink:
         """Estimate parameters by EM and return scored comparisons
         (/root/reference/splink/__init__.py:121-145).
 
-        When the candidate-pair count exceeds ``max_resident_pairs`` the EM
-        runs in streaming mode: the host-resident gamma matrix is fed to the
-        device in micro-batches and sufficient statistics accumulate across
-        them (splink_tpu/parallel/streaming.py) instead of keeping the whole
-        matrix in HBM.
+        When the candidate-pair count exceeds ``max_resident_pairs`` the
+        pipeline switches to the pattern-id regime: one device pass encodes
+        each pair's gamma vector as a mixed-radix pattern id and histograms
+        them, EM runs on the weighted pattern matrix, and scoring is a host
+        LUT gather — pair data crosses the host<->device link exactly once.
         """
+        if self._use_pattern_pipeline():
+            self._run_em_patterns(compute_ll)
+            return pd.concat(
+                list(self._stream_pattern_chunks()), ignore_index=True
+            )
         G = self._ensure_gammas()
         self._run_em(G, compute_ll)
         df_e = self._build_df_e(G)
@@ -233,51 +332,10 @@ class Splink:
     def _run_em_streamed(self, G: np.ndarray, compute_ll: bool) -> None:
         """Streaming EM over host-resident gamma micro-batches.
 
-        Without a mesh this uses pattern compression — the observation behind
-        the reference's M-step group-by (/root/reference/splink/
-        maximisation_step.py:41-59): a gamma vector takes at most
-        prod(num_levels_c + 1) distinct values, so ONE device pass builds a
-        pattern histogram and every EM iteration then runs on the tiny
-        weighted pattern matrix instead of re-scanning all pairs."""
-        mesh = mesh_from_settings(self.settings)
-        if mesh is not None:
-            self._run_em_streamed_stats(G, compute_ll)
-            return
-
-        from .gammas import (
-            pattern_counts_from_gammas,
-            pattern_strides_for,
-            patterns_matrix_for,
-        )
-
-        level_counts = [
-            int(c["num_levels"]) for c in self.settings["comparison_columns"]
-        ]
-        # The dense histogram is prod(levels_c + 1) buckets; with very many
-        # columns that explodes (5^14 ~ 6e9), so fall back to pair-streaming
-        # sufficient statistics past a sane bound.
-        _, n_patterns = pattern_strides_for(level_counts)
-        if n_patterns > (1 << 22):
-            logger.info(
-                "pattern space too large for histogram EM (%d); streaming "
-                "sufficient statistics instead",
-                n_patterns,
-            )
-            self._run_em_streamed_stats(G, compute_ll)
-            return
-        batch = int(self.settings["pair_batch_size"])
-        with StageTimer("em_histogram"):
-            counts = pattern_counts_from_gammas(G, level_counts, batch)
-            patterns = patterns_matrix_for(level_counts)
-            seen = counts > 0
-            G_pat = patterns[seen]
-            weights = counts[seen]
-        logger.info(
-            "pattern-compressed EM: %d pairs -> %d distinct gamma patterns",
-            len(G),
-            len(G_pat),
-        )
-        self._run_em_resident_weighted(G_pat, weights, compute_ll)
+        Reached only when the pattern-id pipeline declined the job (mesh set,
+        custom kernels, or a pattern space past MAX_PATTERNS) — otherwise
+        large pair sets never materialise G at all (_run_em_patterns)."""
+        self._run_em_streamed_stats(G, compute_ll)
 
     def _run_em_resident_weighted(
         self, G_pat: np.ndarray, weights: np.ndarray, compute_ll: bool
@@ -349,6 +407,10 @@ class Splink:
         (/root/reference/splink/__init__.py:121-145); chunked emission is the
         single-host equivalent — each chunk can be appended to parquet etc.
         """
+        if self._use_pattern_pipeline():
+            self._run_em_patterns(compute_ll)
+            yield from self._stream_pattern_chunks()
+            return
         G = self._ensure_gammas()
         self._run_em(G, compute_ll)
         yield from self.stream_scored_comparisons_after_em()
@@ -357,6 +419,9 @@ class Splink:
         """Yield scored-comparison chunks using the current parameters
         (EM — or a loaded model — already applied); see
         stream_scored_comparisons."""
+        if self._use_pattern_pipeline():
+            yield from self._stream_pattern_chunks()
+            return
         G = self._ensure_gammas()
         batch = int(self.settings["pair_batch_size"])
         for s in range(0, len(G), batch):
@@ -496,21 +561,26 @@ class Splink:
         """Assemble the scored comparisons DataFrame with the reference's
         column layout (/root/reference/splink/expectation_step.py:128-165).
         ``rows`` restricts output to a slice of the pair set (streaming)."""
-        table = self._ensure_encoded()
         pairs = self._ensure_pairs()
-        settings = self.settings
 
         il, ir = pairs.idx_l, pairs.idx_r
         if rows is not None:
             G, il, ir = G[rows], il[rows], ir[rows]
 
-        dtype = np.float64 if settings["float64"] else np.float32
+        dtype = np.float64 if self.settings["float64"] else np.float32
         lam, m, u, _ = self.params.to_arrays(dtype=dtype)
         params_dev = FSParams(
             lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)
         )
         with StageTimer("score"):
             p, prob_m, prob_u = self._score_batched(G, params_dev)
+        return self._assemble_df_e(G, il, ir, p, prob_m, prob_u)
+
+    def _assemble_df_e(self, G, il, ir, p, prob_m, prob_u):
+        """Column assembly shared by the device-scoring and pattern-LUT
+        paths; all inputs are host arrays aligned with (il, ir)."""
+        table = self._ensure_encoded()
+        settings = self.settings
         uid = settings["unique_id_column_name"]
         cols: dict[str, np.ndarray] = {"match_probability": p}
 
