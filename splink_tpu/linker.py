@@ -126,7 +126,31 @@ class Splink:
             with StageTimer("blocking"):
                 self._pairs = block_using_rules(self.settings, table, self._n_left)
             logger.info("blocking produced %d candidate pairs", self._pairs.n_pairs)
+            self._maybe_spill_pairs()
         return self._pairs
+
+    def _maybe_spill_pairs(self) -> None:
+        """Move the pair index to disk-backed memmaps (streamed regime with
+        spill_dir set): downstream code slices them identically, but tens of
+        GB shift from anonymous memory to the evictable page cache."""
+        spill_dir = self.settings["spill_dir"]
+        if (
+            not spill_dir
+            or self._pairs.n_pairs <= int(self.settings["max_resident_pairs"])
+        ):
+            return
+        import tempfile
+
+        os.makedirs(spill_dir, exist_ok=True)
+        self._spill_tmp = tempfile.mkdtemp(prefix="splink_pairs_", dir=spill_dir)
+        for name in ("idx_l", "idx_r"):
+            arr = getattr(self._pairs, name)
+            path = os.path.join(self._spill_tmp, f"{name}.bin")
+            mm = np.memmap(path, dtype=arr.dtype, mode="w+", shape=arr.shape)
+            mm[:] = arr
+            mm.flush()
+            setattr(self._pairs, name, mm)
+        logger.info("pair index spilled to %s", self._spill_tmp)
 
     def _ensure_gammas(self) -> np.ndarray:
         if self._G is None:

@@ -53,6 +53,7 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "mesh",
     "pair_batch_size",
     "max_resident_pairs",
+    "spill_dir",
     "float64",
 ]
 

@@ -143,3 +143,36 @@ def test_pattern_pipeline_matches_resident_pipeline():
     np.testing.assert_allclose(
         resident.params.params["λ"], patterned.params.params["λ"], rtol=1e-6
     )
+
+
+def test_spill_dir_memmaps_pair_index(tmp_path):
+    import numpy as np
+    import pandas as pd
+
+    from splink_tpu import Splink
+
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(300),
+            "name": np.array(["a", "b", "c"], dtype=object)[rng.integers(0, 3, 300)],
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "name", "comparison": {"kind": "exact"}}],
+        "blocking_rules": ["l.name = r.name"],
+        "max_resident_pairs": 1024,
+        "spill_dir": str(tmp_path),
+        "max_iterations": 3,
+    }
+    linker = Splink(s, df=df)
+    pairs = linker._ensure_pairs()
+    assert pairs.n_pairs > 1024
+    assert isinstance(pairs.idx_l, np.memmap)
+    out = linker.get_scored_comparisons()
+    assert len(out) == pairs.n_pairs
+    # spilled and unspilled agree
+    linker2 = Splink({**s, "spill_dir": ""}, df=df)
+    out2 = linker2.get_scored_comparisons()
+    pd.testing.assert_frame_equal(out, out2)
