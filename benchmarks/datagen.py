@@ -108,8 +108,11 @@ def make_people(
         ]
     )
     cities = np.array(CITIES)[rng.integers(0, len(CITIES), n_base)]
+    # postcode cardinality scales with population (UK: ~37 people/postcode);
+    # a fixed tiny range made postcode blocks quadratic at 10M+ rows
+    n_post = max(30, n_base // 2000)
     postcodes = np.array(
-        [f"{c[0:2].upper()}{n}" for c, n in zip(cities, rng.integers(1, 30, n_base))]
+        [f"{c[0:2].upper()}{n}" for c, n in zip(cities, rng.integers(1, n_post, n_base))]
     )
 
     rows = {

@@ -139,10 +139,17 @@ class Splink:
             or self._pairs.n_pairs <= int(self.settings["max_resident_pairs"])
         ):
             return
+        import shutil
         import tempfile
+        import weakref
 
         os.makedirs(spill_dir, exist_ok=True)
         self._spill_tmp = tempfile.mkdtemp(prefix="splink_pairs_", dir=spill_dir)
+        # reclaim the spill files when the linker goes away (unlink is safe
+        # while the memmaps are open; space frees on close)
+        self._spill_finalizer = weakref.finalize(
+            self, shutil.rmtree, self._spill_tmp, True
+        )
         for name in ("idx_l", "idx_r"):
             arr = getattr(self._pairs, name)
             path = os.path.join(self._spill_tmp, f"{name}.bin")
