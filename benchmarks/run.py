@@ -150,13 +150,14 @@ def config_4(scale):
         "retain_matching_columns": False,
         "retain_intermediate_calculation_columns": False,
         "additional_columns_to_retain": ["cluster"],
-        "spill_dir": "/tmp",  # pair index -> page cache, not anonymous RAM
+        "spill_dir": os.environ.get(
+            "SPLINK_TPU_SPILL_DIR", os.path.join(os.path.dirname(__file__), "spill")
+        ),
     }
     n_rows = len(df)
     t0 = time.perf_counter()
     linker = Splink(settings, df=df)
-    linker._ensure_encoded()
-    linker.df = None  # drop the raw frame: encoded table carries everything
+    linker.release_input()
     del df
 
     t1 = time.perf_counter()
@@ -225,12 +226,15 @@ def config_5(scale):
         "max_resident_pairs": 1024,  # force the streamed regime at any size
         "retain_matching_columns": False,
         "retain_intermediate_calculation_columns": False,
-        "spill_dir": "/tmp",
+        # /tmp is tmpfs (RAM-backed) on many distros, which would defeat the
+        # point of spilling; default next to this script, allow override.
+        "spill_dir": os.environ.get(
+            "SPLINK_TPU_SPILL_DIR", os.path.join(os.path.dirname(__file__), "spill")
+        ),
     }
     n_rows = len(df)
     linker = Splink(settings, df=df)
-    linker._ensure_encoded()
-    linker.df = None
+    linker.release_input()
     del df
     scored = 0
     for chunk in linker.stream_scored_comparisons():

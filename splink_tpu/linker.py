@@ -38,6 +38,41 @@ except ImportError:  # pragma: no cover
     pd = None
 
 
+def _sweep_stale_spill_dirs(spill_dir: str) -> None:
+    """Reclaim splink_pairs_* dirs whose owning process is gone.
+
+    The per-linker weakref finalizer never runs on SIGKILL/OOM-kill — the
+    most likely death for a job big enough to spill — so each spill dir
+    records its owner pid and the next spilling run sweeps dirs whose pid is
+    dead. Dirs without a pid file (mid-creation, or foreign) are left alone.
+    """
+    import shutil
+
+    try:
+        entries = os.listdir(spill_dir)
+    except OSError:
+        return
+    for name in entries:
+        if not name.startswith("splink_pairs_"):
+            continue
+        path = os.path.join(spill_dir, name)
+        pid_file = os.path.join(path, "owner.pid")
+        try:
+            with open(pid_file) as fh:
+                pid = int(fh.read().strip())
+        except (OSError, ValueError):
+            continue
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)  # signal 0: existence check only
+        except ProcessLookupError:
+            logger.info("reclaiming stale spill dir %s (pid %d dead)", path, pid)
+            shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            continue  # e.g. EPERM: pid exists under another user
+
+
 class Splink:
     @check_types
     def __init__(
@@ -73,6 +108,7 @@ class Splink:
         self.df = df
         self.df_l = df_l
         self.df_r = df_r
+        self._n_left_released: int | None = None
         self.save_state_fn = save_state_fn
         self._check_args()
 
@@ -109,7 +145,27 @@ class Splink:
 
     @property
     def _n_left(self) -> int | None:
-        return None if self.settings["link_type"] == "dedupe_only" else len(self.df_l)
+        if self.settings["link_type"] == "dedupe_only":
+            return None
+        if self.df_l is not None:
+            return len(self.df_l)
+        return self._n_left_released
+
+    def release_input(self) -> None:
+        """Encode the input dataframe(s), then drop the linker's references to
+        them so the raw pandas data can be garbage-collected by the caller.
+
+        Everything downstream (blocking, scoring, retained output columns)
+        reads from the columnar :class:`EncodedTable` built here, so the
+        original frames are not needed again. Useful before streaming very
+        large jobs to halve peak host memory.
+        """
+        self._ensure_encoded()
+        if self.df_l is not None:
+            self._n_left_released = len(self.df_l)
+        self.df = None
+        self.df_l = None
+        self.df_r = None
 
     def _ensure_encoded(self) -> EncodedTable:
         if self._table is None:
@@ -144,7 +200,12 @@ class Splink:
         import weakref
 
         os.makedirs(spill_dir, exist_ok=True)
+        _sweep_stale_spill_dirs(spill_dir)
         self._spill_tmp = tempfile.mkdtemp(prefix="splink_pairs_", dir=spill_dir)
+        # Record the owning pid so a later run can reclaim this dir if we die
+        # without running the finalizer (SIGKILL / OOM-kill).
+        with open(os.path.join(self._spill_tmp, "owner.pid"), "w") as fh:
+            fh.write(str(os.getpid()))
         # reclaim the spill files when the linker goes away (unlink is safe
         # while the memmaps are open; space frees on close)
         self._spill_finalizer = weakref.finalize(

@@ -204,3 +204,35 @@ def test_custom_comparison_registered():
     df_e = linker.get_scored_comparisons()
     assert "gamma_initials" in df_e.columns
     assert set(df_e.gamma_initials.unique()) <= {-1, 0, 1}
+
+
+def test_release_input_dedupe_scores_identically():
+    df = synth_people()
+    a = Splink(dedupe_settings(), df=df)
+    sa = a.get_scored_comparisons()
+    b = Splink(dedupe_settings(), df=df)
+    b.release_input()
+    assert b.df is None
+    sb = b.get_scored_comparisons()
+    cols = ["unique_id_l", "unique_id_r", "match_probability"]
+    pd.testing.assert_frame_equal(
+        sa[cols].sort_values(cols[:2]).reset_index(drop=True),
+        sb[cols].sort_values(cols[:2]).reset_index(drop=True),
+    )
+
+
+def test_release_input_link_only_keeps_n_left():
+    df = synth_people()
+    df_l, df_r = df.iloc[:70].copy(), df.iloc[70:].copy()
+    s = dedupe_settings(link_type="link_only")
+    a = Splink(s, df_l=df_l, df_r=df_r)
+    sa = a.get_scored_comparisons()
+    b = Splink(s, df_l=df_l, df_r=df_r)
+    b.release_input()
+    assert b.df_l is None and b._n_left == 70
+    sb = b.get_scored_comparisons()
+    cols = ["unique_id_l", "unique_id_r", "match_probability"]
+    pd.testing.assert_frame_equal(
+        sa[cols].sort_values(cols[:2]).reset_index(drop=True),
+        sb[cols].sort_values(cols[:2]).reset_index(drop=True),
+    )

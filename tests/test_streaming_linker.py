@@ -176,3 +176,54 @@ def test_spill_dir_memmaps_pair_index(tmp_path):
     linker2 = Splink({**s, "spill_dir": ""}, df=df)
     out2 = linker2.get_scored_comparisons()
     pd.testing.assert_frame_equal(out, out2)
+
+
+def test_release_input_with_streamed_spill_pipeline(tmp_path):
+    """The config-5 production combination: release_input() + streamed
+    pattern pipeline + spilled pair index must score like the resident path."""
+    df = _df(n=600, seed=7)
+    base = _settings(float64=True)  # f32 summation order diverges ~1e-4
+    resident = Splink(base, df=df)
+    df_res = resident.get_scored_comparisons()
+
+    s = _settings(
+        float64=True,
+        max_resident_pairs=1024,
+        pair_batch_size=1024,
+        spill_dir=str(tmp_path),
+        retain_matching_columns=False,
+        retain_intermediate_calculation_columns=False,
+    )
+    linker = Splink(s, df=df)
+    linker.release_input()
+    assert linker.df is None
+    chunks = list(linker.stream_scored_comparisons())
+    pairs = linker._ensure_pairs()
+    assert isinstance(pairs.idx_l, np.memmap)
+    df_str = pd.concat(chunks, ignore_index=True)
+    m = df_res.merge(
+        df_str, on=["unique_id_l", "unique_id_r"], suffixes=("_a", "_b")
+    )
+    assert len(m) == len(df_res) == len(df_str)
+    np.testing.assert_allclose(
+        m.match_probability_a, m.match_probability_b, rtol=1e-3, atol=1e-5
+    )
+
+
+def test_stale_spill_dirs_swept(tmp_path):
+    import os
+
+    from splink_tpu.linker import _sweep_stale_spill_dirs
+
+    dead = tmp_path / "splink_pairs_dead"
+    dead.mkdir()
+    (dead / "owner.pid").write_text("999999999")  # no such pid
+    alive = tmp_path / "splink_pairs_alive"
+    alive.mkdir()
+    (alive / "owner.pid").write_text(str(os.getpid()))
+    foreign = tmp_path / "splink_pairs_nopid"
+    foreign.mkdir()
+    _sweep_stale_spill_dirs(str(tmp_path))
+    assert not dead.exists()
+    assert alive.exists()
+    assert foreign.exists()
