@@ -1,0 +1,1030 @@
+"""General SQL CASE-expression compiler: arbitrary ``case_expression`` → JAX.
+
+The reference accepts ANY SQL CASE expression for a comparison column
+(/root/reference/splink/settings.py:133-139) and executes it row-wise in
+Spark. ``compat_sql.parse_case_expression`` fast-paths the shapes the
+reference's generators emit into native comparison specs; this module is the
+fallback for everything else: a tokenizer + recursive-descent parser over a
+SQL expression subset and a vectorised evaluator with SQL three-valued
+logic, compiled against a :class:`splink_tpu.gammas.PairContext` so the
+expression runs inside the one jitted gamma program like every other kernel.
+
+Supported surface (enough for hand-written comparison CASEs):
+
+* ``CASE WHEN <pred> THEN <expr> ... [ELSE <expr>] END`` (nestable; a
+  missing ELSE yields SQL NULL, which maps to gamma level -1)
+* boolean ``AND`` / ``OR`` / ``NOT`` with three-valued null semantics
+* comparisons ``= != <> < <= > >=``, ``IS [NOT] NULL``
+* arithmetic ``+ - * /``, unary minus, ``abs``, ``least``, ``greatest``
+* column refs ``<col>_l`` / ``<col>_r`` (string or numeric; string equality
+  across *different* columns compares characters, not token ids)
+* literals: numbers, ``'strings'``, ``NULL``, booleans ``TRUE``/``FALSE``
+* string functions: ``jaro_winkler_sim``, ``levenshtein``,
+  ``jaccard_sim``, ``cosine_distance`` (q-gram q=2, or wrap the args in
+  ``QNgramTokeniser(...)`` for other q), ``length``, ``lower``, ``upper``,
+  ``ifnull`` / ``coalesce``, ``dmetaphone`` (same column on both sides)
+
+The jar UDF names (/root/reference/tests/test_spark.py:44-56) resolve to the
+corresponding splink_tpu kernels.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from .compat_sql import SqlTranslationError
+
+# --------------------------------------------------------------------------
+# Tokenizer
+# --------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    \s*(
+        (?P<num>[0-9]*\.[0-9]+(?:[eE][-+]?[0-9]+)?|[0-9]+(?:[eE][-+]?[0-9]+)?)
+      | (?P<str>'(?:[^']|'')*')
+      | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+      | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\+|-|\*|/)
+    )
+    """,
+    re.VERBOSE,
+)
+
+_KEYWORDS = {"case", "when", "then", "else", "end", "and", "or", "not", "is",
+             "null", "true", "false"}
+
+
+def _tokenize(s: str) -> list[tuple[str, str]]:
+    tokens: list[tuple[str, str]] = []
+    pos = 0
+    while pos < len(s):
+        m = _TOKEN_RE.match(s, pos)
+        if not m or m.end() == pos:
+            if s[pos:].strip():
+                raise SqlTranslationError(
+                    f"Unrecognised character in case_expression at ...{s[pos:pos+25]!r}"
+                )
+            break
+        pos = m.end()
+        if m.group("num") is not None:
+            tokens.append(("num", m.group("num")))
+        elif m.group("str") is not None:
+            tokens.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.group("ident") is not None:
+            ident = m.group("ident")
+            low = ident.lower()
+            tokens.append(("kw", low) if low in _KEYWORDS else ("ident", ident))
+        else:
+            tokens.append(("op", m.group("op")))
+    tokens.append(("eof", ""))
+    return tokens
+
+
+# --------------------------------------------------------------------------
+# AST
+# --------------------------------------------------------------------------
+# Nodes are plain tuples: ("case", [(cond, val), ...], else_or_None)
+#                         ("or"|"and", a, b)   ("not", a)
+#                         ("cmp", op, a, b)    ("isnull", a, negate)
+#                         ("arith", op, a, b)  ("neg", a)
+#                         ("func", name, [args])
+#                         ("col", base, side)  ("ident", name)
+#                         ("num", float)       ("lit", str)
+#                         ("null",)            ("bool", True/False)
+
+_COLREF = re.compile(r"^(.*)_(l|r)$")
+
+
+class _Parser:
+    def __init__(self, tokens, expr):
+        self.toks = tokens
+        self.i = 0
+        self.expr = expr
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, kind, value=None):
+        t = self.next()
+        if t[0] != kind or (value is not None and t[1] != value):
+            raise SqlTranslationError(
+                f"Expected {value or kind} but found {t[1]!r} in "
+                f"case_expression: {self.expr!r}"
+            )
+        return t
+
+    def at_kw(self, *words):
+        t = self.peek()
+        return t[0] == "kw" and t[1] in words
+
+    # expr := case | or_expr
+    def parse_expr(self):
+        if self.at_kw("case"):
+            return self.parse_case()
+        return self.parse_or()
+
+    def parse_case(self):
+        self.expect("kw", "case")
+        branches = []
+        while self.at_kw("when"):
+            self.next()
+            cond = self.parse_or()
+            self.expect("kw", "then")
+            branches.append((cond, self.parse_expr()))
+        if not branches:
+            raise SqlTranslationError(
+                f"CASE without WHEN branches in case_expression: {self.expr!r}"
+            )
+        els = None
+        if self.at_kw("else"):
+            self.next()
+            els = self.parse_expr()
+        self.expect("kw", "end")
+        return ("case", branches, els)
+
+    def parse_or(self):
+        node = self.parse_and()
+        while self.at_kw("or"):
+            self.next()
+            node = ("or", node, self.parse_and())
+        return node
+
+    def parse_and(self):
+        node = self.parse_not()
+        while self.at_kw("and"):
+            self.next()
+            node = ("and", node, self.parse_not())
+        return node
+
+    def parse_not(self):
+        if self.at_kw("not"):
+            self.next()
+            return ("not", self.parse_not())
+        return self.parse_cmp()
+
+    def parse_cmp(self):
+        node = self.parse_add()
+        t = self.peek()
+        if t[0] == "op" and t[1] in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            op = self.next()[1]
+            if op == "<>":
+                op = "!="
+            return ("cmp", op, node, self.parse_add())
+        if self.at_kw("is"):
+            self.next()
+            negate = False
+            if self.at_kw("not"):
+                self.next()
+                negate = True
+            self.expect("kw", "null")
+            return ("isnull", node, negate)
+        return node
+
+    def parse_add(self):
+        node = self.parse_mul()
+        while True:
+            t = self.peek()
+            if t[0] == "op" and t[1] in ("+", "-"):
+                op = self.next()[1]
+                node = ("arith", op, node, self.parse_mul())
+            else:
+                return node
+
+    def parse_mul(self):
+        node = self.parse_unary()
+        while True:
+            t = self.peek()
+            if t[0] == "op" and t[1] in ("*", "/"):
+                op = self.next()[1]
+                node = ("arith", op, node, self.parse_unary())
+            else:
+                return node
+
+    def parse_unary(self):
+        t = self.peek()
+        if t[0] == "op" and t[1] == "-":
+            self.next()
+            return ("neg", self.parse_unary())
+        return self.parse_primary()
+
+    def parse_primary(self):
+        t = self.next()
+        if t[0] == "num":
+            return ("num", float(t[1]))
+        if t[0] == "str":
+            return ("lit", t[1])
+        if t[0] == "kw" and t[1] == "null":
+            return ("null",)
+        if t[0] == "kw" and t[1] in ("true", "false"):
+            return ("bool", t[1] == "true")
+        if t[0] == "kw" and t[1] == "case":
+            self.i -= 1
+            return self.parse_case()
+        if t[0] == "ident":
+            if self.peek() == ("op", "("):
+                self.next()
+                args = []
+                if self.peek() != ("op", ")"):
+                    args.append(self.parse_expr())
+                    while self.peek() == ("op", ","):
+                        self.next()
+                        args.append(self.parse_expr())
+                self.expect("op", ")")
+                return ("func", t[1].lower(), args)
+            m = _COLREF.match(t[1])
+            if m:
+                return ("col", m.group(1), m.group(2))
+            return ("ident", t[1])
+        if t == ("op", "("):
+            node = self.parse_expr()
+            self.expect("op", ")")
+            return node
+        raise SqlTranslationError(
+            f"Unexpected token {t[1]!r} in case_expression: {self.expr!r}"
+        )
+
+
+_AST_CACHE: dict[str, tuple] = {}
+
+
+def parse_sql_expression(expr: str):
+    """Parse a SQL expression into the module's AST (cached)."""
+    key = expr
+    if key not in _AST_CACHE:
+        # Tokenize the RAW expression — the tokenizer skips whitespace
+        # itself, and collapsing whitespace up front would corrupt quoted
+        # literals like 'new  york'. Normalised text is for messages only.
+        display = re.sub(r"\s+", " ", expr).strip()
+        p = _Parser(_tokenize(expr), display)
+        node = p.parse_expr()
+        if p.peek()[0] != "eof":
+            raise SqlTranslationError(
+                f"Trailing tokens after expression in case_expression: "
+                f"{display[: 40]!r}... (stopped at {p.peek()[1]!r})"
+            )
+        _AST_CACHE[key] = node
+    return _AST_CACHE[key]
+
+
+# --------------------------------------------------------------------------
+# Static analysis (used by settings completion / encoding)
+# --------------------------------------------------------------------------
+
+_TOKENISER_Q = re.compile(r"^q([2-6])?gramtokeniser$")
+
+_STRING_FUNCS = {"jaro_winkler_sim", "levenshtein", "jaccard_sim",
+                 "cosine_distance", "length", "lower", "upper", "dmetaphone",
+                 "dmetaphone_alt"}
+_NUMERIC_FUNCS = {"abs", "least", "greatest", "round", "floor", "ceil"}
+
+
+def analyse_case_expression(expr: str) -> dict:
+    """-> {"columns": {name: "string"|"numeric"}, "phonetic": set[str],
+          "levels": set[int]} for a parsed case_expression.
+
+    Column types are inferred from use: arithmetic, numeric functions or
+    comparison against a number literal ⇒ numeric; everything else string.
+    ``levels`` collects the integer THEN/ELSE outcomes so the caller can
+    check them against num_levels.
+    """
+    ast = parse_sql_expression(expr)
+    cols: dict[str, str] = {}
+    phonetic: set[str] = set()
+    levels: set[int] = set()
+
+    def mark(node, numeric=False):
+        kind = node[0]
+        if kind == "col":
+            cur = cols.get(node[1])
+            cols[node[1]] = "numeric" if numeric or cur == "numeric" else (
+                cur or "string"
+            )
+        elif kind == "case":
+            for cond, val in node[1]:
+                mark(cond)
+                _collect_level(val, levels)
+                mark(val)
+            if node[2] is not None:
+                _collect_level(node[2], levels)
+                mark(node[2])
+        elif kind in ("or", "and"):
+            mark(node[1])
+            mark(node[2])
+        elif kind == "not":
+            mark(node[1])
+        elif kind == "cmp":
+            _, op, a, b = node
+            if op in ("<", "<=", ">", ">="):
+                # ordering comparisons only exist for numerics here (string
+                # ordering is unsupported), so both sides are numeric
+                mark(a, numeric=True)
+                mark(b, numeric=True)
+            else:
+                mark(a, numeric=b[0] == "num")
+                mark(b, numeric=a[0] == "num")
+        elif kind == "isnull":
+            mark(node[1])
+        elif kind == "arith":
+            mark(node[2], numeric=True)
+            mark(node[3], numeric=True)
+        elif kind == "neg":
+            mark(node[1], numeric=True)
+        elif kind == "func":
+            name, args = node[1], node[2]
+            if name in ("dmetaphone", "dmetaphone_alt"):
+                for a in args:
+                    if a[0] == "col":
+                        phonetic.add(a[1])
+                    mark(a)
+            elif name in _NUMERIC_FUNCS:
+                for a in args:
+                    mark(a, numeric=True)
+            else:
+                for a in args:
+                    mark(a)
+
+    mark(ast)
+    return {"columns": cols, "phonetic": phonetic, "levels": levels}
+
+
+def _collect_level(node, out: set[int]) -> None:
+    if node[0] == "num" and float(node[1]).is_integer():
+        out.add(int(node[1]))
+    elif node[0] == "neg" and node[1][0] == "num":
+        out.add(-int(node[1][1]))
+
+
+def _validate_functions(ast, expr: str) -> None:
+    """Static check that every function in the AST has an evaluator handler
+    (so unsupported SQL fails at settings-completion time, not at trace
+    time). QNgramTokeniser is only legal as a q-gram-function argument."""
+
+    def walk(node, parent_func=None):
+        kind = node[0]
+        if kind == "func":
+            name = node[1]
+            if _TOKENISER_Q.match(name):
+                if parent_func not in ("jaccard_sim", "cosine_distance"):
+                    raise SqlTranslationError(
+                        f"{name} must appear as an argument of jaccard_sim "
+                        f"or cosine_distance: {expr!r}"
+                    )
+            elif not hasattr(_Evaluator, f"_fn_{name}"):
+                supported = sorted(
+                    n[4:] for n in dir(_Evaluator) if n.startswith("_fn_")
+                )
+                raise SqlTranslationError(
+                    f"Unsupported function {name!r} in case_expression "
+                    f"{expr!r}. Supported functions: {', '.join(supported)}."
+                )
+            for a in node[2]:
+                walk(a, parent_func=name)
+        elif kind == "case":
+            for cond, val in node[1]:
+                walk(cond)
+                walk(val)
+            if node[2] is not None:
+                walk(node[2])
+        elif kind in ("or", "and"):
+            walk(node[1])
+            walk(node[2])
+        elif kind in ("not", "neg", "isnull"):
+            walk(node[1])
+        elif kind == "cmp":
+            walk(node[2])
+            walk(node[3])
+        elif kind == "arith":
+            walk(node[2])
+            walk(node[3])
+
+    walk(ast)
+
+
+# --------------------------------------------------------------------------
+# Evaluator (jax-traceable; runs inside the gamma program)
+# --------------------------------------------------------------------------
+
+
+class _Str:
+    """A vector string value: chars (b, w), length (b,), null (b,) plus the
+    originating column/token ids when the value is an untransformed column
+    side (enables the cheap token-equality path)."""
+
+    __slots__ = ("chars", "length", "null", "tok", "origin")
+
+    def __init__(self, chars, length, null, tok=None, origin=None):
+        self.chars = chars
+        self.length = length
+        self.null = null
+        self.tok = tok
+        self.origin = origin  # column name, for same-vocab token equality
+
+
+class _Num:
+    __slots__ = ("val", "null")
+
+    def __init__(self, val, null):
+        self.val = val
+        self.null = null
+
+
+class _Bool:
+    """Three-valued logic: val where ~null, unknown where null."""
+
+    __slots__ = ("val", "null")
+
+    def __init__(self, val, null):
+        self.val = val
+        self.null = null
+
+
+class _Lit:
+    __slots__ = ("value",)  # python float | str | None | bool
+
+    def __init__(self, value):
+        self.value = value
+
+
+def compile_case_expression(expr: str, num_levels: int):
+    """-> fn(ctx) evaluating ``expr`` to an int8 gamma array.
+
+    Raises SqlTranslationError at compile time for constructs outside the
+    supported subset; the returned closure is jax-traceable.
+    """
+    ast = parse_sql_expression(expr)
+    info = analyse_case_expression(expr)
+    bad = [lv for lv in info["levels"] if not (-1 <= lv < num_levels)]
+    if bad:
+        raise SqlTranslationError(
+            f"case_expression produces gamma level(s) {sorted(bad)} outside "
+            f"[-1, {num_levels - 1}] for num_levels={num_levels}: {expr!r}"
+        )
+    _validate_functions(ast, expr)
+
+    def run(ctx):
+        import jax.numpy as jnp
+
+        from .ops.gamma import GAMMA_DTYPE
+
+        ev = _Evaluator(ctx)
+        out = ev.eval(ast)
+        if isinstance(out, _Lit):
+            raise SqlTranslationError(
+                f"case_expression is a constant ({out.value!r}); it must "
+                f"depend on at least one column: {expr!r}"
+            )
+        if isinstance(out, _Bool):
+            out = _Num(out.val.astype(jnp.float32), out.null)
+        if not isinstance(out, _Num):
+            raise SqlTranslationError(
+                f"case_expression must evaluate to a numeric gamma level, "
+                f"not a string: {expr!r}"
+            )
+        gamma = jnp.where(out.null, jnp.float32(-1), out.val)
+        return gamma.astype(GAMMA_DTYPE)
+
+    return run
+
+
+class _Evaluator:
+    def __init__(self, ctx):
+        import jax.numpy as jnp
+
+        self.ctx = ctx
+        self.jnp = jnp
+
+    # -- helpers ----------------------------------------------------------
+
+    def _batch_shape(self, *vals):
+        for v in vals:
+            if isinstance(v, _Num):
+                return v.val.shape
+            if isinstance(v, _Str):
+                return v.length.shape
+            if isinstance(v, _Bool):
+                return v.val.shape
+        return None
+
+    def _as_num(self, v, like=None):
+        jnp = self.jnp
+        if isinstance(v, _Num):
+            return v
+        if isinstance(v, _Lit):
+            if not isinstance(v.value, (int, float)) or isinstance(v.value, bool):
+                raise SqlTranslationError(
+                    f"Expected a numeric operand, got {v.value!r}"
+                )
+            shape = self._batch_shape(like) if like is not None else None
+            if shape is None:
+                raise SqlTranslationError(
+                    "Cannot type a bare literal without column context"
+                )
+            return _Num(
+                jnp.full(shape, float(v.value), jnp.float32),
+                jnp.zeros(shape, bool),
+            )
+        raise SqlTranslationError("Expected a numeric operand, got a string")
+
+    def _encode_literal(self, text: str, width: int):
+        cps = [ord(c) for c in text][:width]
+        arr = np.zeros((width,), dtype=np.uint32)
+        arr[: len(cps)] = cps
+        return arr, len(text)
+
+    def _str_align(self, a: _Str, b: _Str):
+        from .gammas import _pad_chars
+
+        jnp = self.jnp
+        width = max(a.chars.shape[1], b.chars.shape[1])
+        ca, cb = _pad_chars(a.chars, width), _pad_chars(b.chars, width)
+        if ca.dtype != cb.dtype:
+            ca = ca.astype(jnp.uint32)
+            cb = cb.astype(jnp.uint32)
+        return ca, cb
+
+    def _lit_as_str(self, lit: _Lit, like: _Str) -> _Str:
+        jnp = self.jnp
+        if not isinstance(lit.value, str):
+            raise SqlTranslationError(
+                f"Cannot compare a string column with {lit.value!r}"
+            )
+        width = max(like.chars.shape[1], len(lit.value))
+        arr, ln = self._encode_literal(lit.value, width)
+        shape = like.length.shape
+        chars = jnp.broadcast_to(
+            jnp.asarray(arr, dtype=jnp.uint32), (shape[0], width)
+        )
+        if like.chars.dtype == jnp.uint8 and all(c < 256 for c in arr):
+            chars = chars.astype(jnp.uint8)
+        return _Str(
+            chars,
+            jnp.full(shape, ln, jnp.int32),
+            jnp.zeros(shape, bool),
+        )
+
+    def _str_equal(self, a: _Str, b: _Str):
+        jnp = self.jnp
+        if (
+            a.tok is not None
+            and b.tok is not None
+            and a.origin is not None
+            and a.origin == b.origin
+        ):
+            return a.tok == b.tok
+        ca, cb = self._str_align(a, b)
+        return (ca == cb).all(axis=1) & (a.length == b.length)
+
+    # -- node dispatch ----------------------------------------------------
+
+    def eval(self, node):
+        return getattr(self, f"_eval_{node[0]}")(node)
+
+    def _eval_num(self, node):
+        return _Lit(node[1])
+
+    def _eval_lit(self, node):
+        return _Lit(node[1])
+
+    def _eval_null(self, node):
+        return _Lit(None)
+
+    def _eval_bool(self, node):
+        return _Lit(node[1])
+
+    def _eval_ident(self, node):
+        raise SqlTranslationError(
+            f"Unrecognised identifier {node[1]!r}: column references must be "
+            "written <column>_l / <column>_r"
+        )
+
+    def _eval_col(self, node):
+        _, base, side = node
+        pc = self.ctx.col(base)
+        if pc.num_l is not None:
+            val = pc.num_l if side == "l" else pc.num_r
+            null = pc.null_l if side == "l" else pc.null_r
+            return _Num(val.astype(self.jnp.float32), null)
+        if side == "l":
+            return _Str(pc.chars_l, pc.len_l, pc.null_l, pc.tok_l, base)
+        return _Str(pc.chars_r, pc.len_r, pc.null_r, pc.tok_r, base)
+
+    def _eval_case(self, node):
+        jnp = self.jnp
+        _, branches, els = node
+        conds, vals = [], []
+        for cond, val in branches:
+            c = self.eval(cond)
+            if not isinstance(c, _Bool):
+                raise SqlTranslationError(
+                    "CASE WHEN condition must be boolean"
+                )
+            conds.append(c)
+            vals.append(self.eval(val))
+        shape = conds[0].val.shape
+
+        def as_branch_num(v):
+            # an explicit THEN NULL / ELSE NULL is the SQL-NULL value
+            if isinstance(v, _Lit) and v.value is None:
+                return _Num(
+                    jnp.zeros(shape, jnp.float32), jnp.ones(shape, bool)
+                )
+            return self._as_num(v, like=conds[0]) if not isinstance(v, _Num) else v
+
+        # default: SQL NULL when no branch matches and no ELSE
+        if els is None:
+            out_val = jnp.zeros(shape, jnp.float32)
+            out_null = jnp.ones(shape, bool)
+        else:
+            e = as_branch_num(self.eval(els))
+            out_val, out_null = e.val, e.null
+        # apply branches in reverse so earlier WHENs win
+        for c, v in zip(reversed(conds), reversed(vals)):
+            v = as_branch_num(v)
+            fire = c.val & ~c.null
+            out_val = jnp.where(fire, v.val, out_val)
+            out_null = jnp.where(fire, v.null, out_null)
+        return _Num(out_val, out_null)
+
+    def _eval_or(self, node):
+        a, b = self._bool(node[1]), self._bool(node[2])
+        true = (a.val & ~a.null) | (b.val & ~b.null)
+        null = ~true & (a.null | b.null)
+        return _Bool(true, null)
+
+    def _eval_and(self, node):
+        a, b = self._bool(node[1]), self._bool(node[2])
+        false = (~a.val & ~a.null) | (~b.val & ~b.null)
+        null = ~false & (a.null | b.null)
+        return _Bool(~false & ~null, null)
+
+    def _eval_not(self, node):
+        a = self._bool(node[1])
+        return _Bool(~a.val & ~a.null, a.null)
+
+    def _bool(self, node):
+        v = self.eval(node)
+        if isinstance(v, _Lit):
+            if isinstance(v.value, bool):
+                raise SqlTranslationError(
+                    "Constant TRUE/FALSE must appear inside a comparison"
+                )
+            raise SqlTranslationError(
+                f"Expected a boolean expression, got literal {v.value!r}"
+            )
+        if not isinstance(v, _Bool):
+            raise SqlTranslationError(
+                "Expected a boolean expression (a comparison or IS NULL)"
+            )
+        return v
+
+    def _eval_isnull(self, node):
+        jnp = self.jnp
+        _, sub, negate = node
+        v = self.eval(sub)
+        if isinstance(v, _Lit):
+            raise SqlTranslationError(
+                "IS NULL on a constant is not supported"
+            )
+        null = v.null
+        out = ~null if negate else null
+        return _Bool(out, jnp.zeros(out.shape, bool))
+
+    def _eval_cmp(self, node):
+        jnp = self.jnp
+        _, op, an, bn = node
+        a, b = self.eval(an), self.eval(bn)
+        # NULL literal comparisons are always unknown
+        if (isinstance(a, _Lit) and a.value is None) or (
+            isinstance(b, _Lit) and b.value is None
+        ):
+            other = b if isinstance(a, _Lit) and a.value is None else a
+            shape = self._batch_shape(other)
+            if shape is None:
+                raise SqlTranslationError(
+                    "Comparison between two constants is not supported"
+                )
+            return _Bool(jnp.zeros(shape, bool), jnp.ones(shape, bool))
+        # string comparison
+        if isinstance(a, _Str) or isinstance(b, _Str):
+            if isinstance(a, _Lit):
+                a = self._lit_as_str(a, b)
+            if isinstance(b, _Lit):
+                b = self._lit_as_str(b, a)
+            if not (isinstance(a, _Str) and isinstance(b, _Str)):
+                raise SqlTranslationError(
+                    "Cannot compare a string with a number"
+                )
+            if op not in ("=", "!="):
+                raise SqlTranslationError(
+                    f"String comparison only supports = and != (got {op!r})"
+                )
+            eq = self._str_equal(a, b)
+            null = a.null | b.null
+            return _Bool((eq if op == "=" else ~eq) & ~null, null)
+        # boolean = TRUE/FALSE
+        if isinstance(a, _Bool) or isinstance(b, _Bool):
+            if isinstance(b, _Lit) and isinstance(b.value, bool):
+                val = a.val if b.value else (~a.val & ~a.null)
+                return _Bool(val & ~a.null, a.null)
+            if isinstance(a, _Lit) and isinstance(a.value, bool):
+                val = b.val if a.value else (~b.val & ~b.null)
+                return _Bool(val & ~b.null, b.null)
+            raise SqlTranslationError(
+                "Boolean values can only be compared with TRUE/FALSE"
+            )
+        a = self._as_num(a, like=b)
+        b = self._as_num(b, like=a)
+        fns = {
+            "=": lambda x, y: x == y,
+            "!=": lambda x, y: x != y,
+            "<": lambda x, y: x < y,
+            "<=": lambda x, y: x <= y,
+            ">": lambda x, y: x > y,
+            ">=": lambda x, y: x >= y,
+        }
+        val = fns[op](a.val, b.val)
+        null = a.null | b.null
+        return _Bool(val & ~null, null)
+
+    def _eval_arith(self, node):
+        _, op, an, bn = node
+        a, b = self.eval(an), self.eval(bn)
+        if isinstance(a, _Lit) and isinstance(b, _Lit):
+            fns = {"+": lambda x, y: x + y, "-": lambda x, y: x - y,
+                   "*": lambda x, y: x * y, "/": lambda x, y: x / y}
+            return _Lit(fns[op](float(a.value), float(b.value)))
+        a = self._as_num(a, like=b)
+        b = self._as_num(b, like=a)
+        null = a.null | b.null
+        if op == "/":
+            # SQL (and the reference engine) yield NULL for x/0
+            zero = b.val == 0
+            return _Num(
+                a.val / self.jnp.where(zero, 1.0, b.val), null | zero
+            )
+        fns = {"+": lambda x, y: x + y, "-": lambda x, y: x - y,
+               "*": lambda x, y: x * y}
+        return _Num(fns[op](a.val, b.val), null)
+
+    def _eval_neg(self, node):
+        v = self.eval(node[1])
+        if isinstance(v, _Lit):
+            return _Lit(-float(v.value))
+        v = self._as_num(v)
+        return _Num(-v.val, v.null)
+
+    # -- functions --------------------------------------------------------
+
+    def _eval_func(self, node):
+        _, name, args = node
+        handler = getattr(self, f"_fn_{name}", None)
+        if handler is None:
+            m = _TOKENISER_Q.match(name)
+            if m:
+                raise SqlTranslationError(
+                    f"{name} must appear as an argument of jaccard_sim or "
+                    "cosine_distance"
+                )
+            raise SqlTranslationError(
+                f"Unsupported function {name!r} in case_expression. "
+                "Supported: jaro_winkler_sim, levenshtein, jaccard_sim, "
+                "cosine_distance, dmetaphone, length, lower, upper, abs, "
+                "least, greatest, round, floor, ceil, ifnull, coalesce."
+            )
+        return handler(args)
+
+    def _two_strings(self, args, fname):
+        if len(args) != 2:
+            raise SqlTranslationError(f"{fname} takes exactly 2 arguments")
+        a, b = self.eval(args[0]), self.eval(args[1])
+        if isinstance(a, _Lit):
+            if not isinstance(b, _Str):
+                raise SqlTranslationError(f"{fname} expects string arguments")
+            a = self._lit_as_str(a, b)
+        if isinstance(b, _Lit):
+            if not isinstance(a, _Str):
+                raise SqlTranslationError(f"{fname} expects string arguments")
+            b = self._lit_as_str(b, a)
+        if not (isinstance(a, _Str) and isinstance(b, _Str)):
+            raise SqlTranslationError(f"{fname} expects string arguments")
+        return a, b
+
+    def _fn_jaro_winkler_sim(self, args):
+        from .ops import strings as string_ops
+
+        a, b = self._two_strings(args, "jaro_winkler_sim")
+        ca, cb = self._str_align(a, b)
+        sim = string_ops.jaro_winkler(ca, cb, a.length, b.length, 0.1, 0.0)
+        return _Num(sim, a.null | b.null)
+
+    _fn_jaro_winkler = _fn_jaro_winkler_sim
+
+    def _fn_levenshtein(self, args):
+        from .ops import strings as string_ops
+
+        a, b = self._two_strings(args, "levenshtein")
+        ca, cb = self._str_align(a, b)
+        d = string_ops.levenshtein(ca, cb, a.length, b.length)
+        return _Num(d.astype(self.jnp.float32), a.null | b.null)
+
+    def _qgram_args(self, args, fname):
+        """jaccard_sim(x, y) | jaccard_sim(QNgramTokeniser(x), ...) -> (a,b,q)."""
+        q = 2
+        unwrapped = []
+        for arg in args:
+            if arg[0] == "func":
+                m = _TOKENISER_Q.match(arg[1])
+                if m:
+                    q = int(m.group(1) or 2)
+                    if len(arg[2]) != 1:
+                        raise SqlTranslationError(
+                            f"{arg[1]} takes exactly one argument"
+                        )
+                    unwrapped.append(arg[2][0])
+                    continue
+            unwrapped.append(arg)
+        a, b = self._two_strings(unwrapped, fname)
+        return a, b, q
+
+    def _fn_jaccard_sim(self, args):
+        from .ops import qgram as qgram_ops
+
+        a, b, q = self._qgram_args(args, "jaccard_sim")
+        ca, cb = self._str_align(a, b)
+        sim = qgram_ops.qgram_jaccard(ca, cb, a.length, b.length, q)
+        return _Num(sim, a.null | b.null)
+
+    def _fn_cosine_distance(self, args):
+        from .ops import qgram as qgram_ops
+
+        a, b, q = self._qgram_args(args, "cosine_distance")
+        ca, cb = self._str_align(a, b)
+        d = qgram_ops.qgram_cosine_distance(ca, cb, a.length, b.length, q)
+        return _Num(d, a.null | b.null)
+
+    def _fn_dmetaphone(self, args):
+        from .data import phonetic_column_name
+
+        if len(args) != 1 or args[0][0] != "col":
+            raise SqlTranslationError(
+                "dmetaphone() is supported only directly on a column "
+                "reference, e.g. dmetaphone(name_l) = dmetaphone(name_r)"
+            )
+        _, base, side = args[0]
+        pc = self.ctx.col(phonetic_column_name(base))
+        if side == "l":
+            return _Str(pc.chars_l, pc.len_l, pc.null_l, pc.tok_l,
+                        phonetic_column_name(base))
+        return _Str(pc.chars_r, pc.len_r, pc.null_r, pc.tok_r,
+                    phonetic_column_name(base))
+
+    _fn_dmetaphone_alt = _fn_dmetaphone
+
+    def _fn_length(self, args):
+        if len(args) != 1:
+            raise SqlTranslationError("length takes exactly one argument")
+        v = self.eval(args[0])
+        if isinstance(v, _Lit):
+            return _Lit(float(len(str(v.value))))
+        if not isinstance(v, _Str):
+            raise SqlTranslationError("length expects a string argument")
+        return _Num(v.length.astype(self.jnp.float32), v.null)
+
+    _fn_len = _fn_length
+    _fn_char_length = _fn_length
+
+    def _case_shift(self, args, to_lower: bool):
+        jnp = self.jnp
+        if len(args) != 1:
+            raise SqlTranslationError("lower/upper take exactly one argument")
+        v = self.eval(args[0])
+        if isinstance(v, _Lit):
+            s = str(v.value)
+            return _Lit(s.lower() if to_lower else s.upper())
+        if not isinstance(v, _Str):
+            raise SqlTranslationError("lower/upper expect a string argument")
+        c = v.chars
+        if to_lower:
+            shifted = jnp.where((c >= 65) & (c <= 90), c + 32, c)
+        else:
+            shifted = jnp.where((c >= 97) & (c <= 122), c - 32, c)
+        return _Str(shifted.astype(c.dtype), v.length, v.null)
+
+    def _fn_lower(self, args):
+        return self._case_shift(args, True)
+
+    def _fn_upper(self, args):
+        return self._case_shift(args, False)
+
+    def _fn_abs(self, args):
+        if len(args) != 1:
+            raise SqlTranslationError("abs takes exactly one argument")
+        v = self.eval(args[0])
+        if isinstance(v, _Lit):
+            return _Lit(abs(float(v.value)))
+        v = self._as_num(v)
+        return _Num(self.jnp.abs(v.val), v.null)
+
+    def _minmax(self, args, fn, fname):
+        if len(args) < 2:
+            raise SqlTranslationError(f"{fname} takes at least 2 arguments")
+        vals = [self.eval(a) for a in args]
+        anchor = next((v for v in vals if isinstance(v, _Num)), None)
+        if anchor is None:
+            raise SqlTranslationError(
+                f"{fname} needs at least one column-typed argument"
+            )
+        jnp = self.jnp
+        nums = [self._as_num(v, like=anchor) for v in vals]
+        # SQL least/greatest skip nulls: result is null only when ALL
+        # arguments are null.
+        out = nums[0].val
+        null = nums[0].null
+        for v in nums[1:]:
+            out = jnp.where(
+                null, v.val, jnp.where(v.null, out, fn(out, v.val))
+            )
+            null = null & v.null
+        return _Num(out, null)
+
+    def _fn_least(self, args):
+        return self._minmax(args, self.jnp.minimum, "least")
+
+    def _fn_greatest(self, args):
+        return self._minmax(args, self.jnp.maximum, "greatest")
+
+    def _round_like(self, args, fn, fname):
+        if len(args) != 1:
+            raise SqlTranslationError(f"{fname} takes exactly one argument")
+        v = self._as_num(self.eval(args[0]))
+        return _Num(fn(v.val), v.null)
+
+    def _fn_round(self, args):
+        return self._round_like(args, self.jnp.round, "round")
+
+    def _fn_floor(self, args):
+        return self._round_like(args, self.jnp.floor, "floor")
+
+    def _fn_ceil(self, args):
+        return self._round_like(args, self.jnp.ceil, "ceil")
+
+    def _fn_ifnull(self, args):
+        if len(args) != 2:
+            raise SqlTranslationError("ifnull takes exactly 2 arguments")
+        return self._coalesce(args, "ifnull")
+
+    def _fn_coalesce(self, args):
+        if len(args) < 2:
+            raise SqlTranslationError("coalesce takes at least 2 arguments")
+        return self._coalesce(args, "coalesce")
+
+    def _coalesce(self, args, fname):
+        jnp = self.jnp
+        vals = [self.eval(a) for a in args]
+        anchor = next((v for v in vals if not isinstance(v, _Lit)), None)
+        if anchor is None:
+            raise SqlTranslationError(
+                f"{fname} needs at least one column-typed argument"
+            )
+        if isinstance(anchor, _Num):
+            nums = [
+                self._as_num(v, like=anchor)
+                if not (isinstance(v, _Lit) and v.value is None)
+                else _Num(
+                    jnp.zeros(anchor.val.shape, jnp.float32),
+                    jnp.ones(anchor.val.shape, bool),
+                )
+                for v in vals
+            ]
+            out, null = nums[0].val, nums[0].null
+            for v in nums[1:]:
+                out = jnp.where(null, v.val, out)
+                null = null & v.null
+            return _Num(out, null)
+        if isinstance(anchor, _Bool):
+            raise SqlTranslationError(f"{fname} on booleans is not supported")
+        strs = []
+        for v in vals:
+            if isinstance(v, _Lit):
+                if v.value is None:
+                    continue
+                v = self._lit_as_str(v, anchor)
+            if not isinstance(v, _Str):
+                raise SqlTranslationError(
+                    f"{fname} arguments must all be strings or all numeric"
+                )
+            strs.append(v)
+        out = strs[0]
+        for v in strs[1:]:
+            co, cv = self._str_align(out, v)
+            chars = jnp.where(out.null[:, None], cv, co)
+            length = jnp.where(out.null, v.length, out.length)
+            out = _Str(chars, length, out.null & v.null)
+        return out

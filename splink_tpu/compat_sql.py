@@ -66,6 +66,14 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
         # strict equality gates the TOP level, levenshtein-ratio thresholds
         # gate levels num_levels-2 .. 1.
         pairs = re.findall(rf"<=\s*{_NUM}\s*then\s*(\d+)", s)
+        anchored = re.findall(
+            rf"levenshtein\([^)]*\)\s*/[^<]*<=\s*{_NUM}\s*then\s*(\d+)", s
+        )
+        if pairs and len(anchored) != len(pairs):
+            raise SqlTranslationError(
+                "case_expression mixes levenshtein-ratio thresholds with "
+                f"other <= conditions; not a generated shape: {expr!r}"
+            )
         if pairs:
             levels = {int(lv) for _, lv in pairs}
             eq = re.search(r"when\s+(\w+)_l\s*=\s*\1_r\s+then\s+(\d+)", s)
@@ -85,7 +93,19 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
             ]}
 
     if re.search(r"abs\(", s) and "/" in s:
+        # Every `< t then n` must be the generated relative-difference term
+        # (abs(diff)/denominator < t); a mix of relative and absolute
+        # thresholds is a hand-written CASE and must not be collapsed into a
+        # single all-relative kernel.
         pairs = re.findall(rf"<\s*{_NUM}\s*then\s*(\d+)", s)
+        anchored = re.findall(
+            rf"abs\([^)]*\)\s*/[^<]*<\s*{_NUM}\s*then\s*(\d+)", s
+        )
+        if pairs and len(anchored) != len(pairs):
+            raise SqlTranslationError(
+                "case_expression mixes relative-difference thresholds with "
+                f"other < conditions; not a generated shape: {expr!r}"
+            )
         if pairs:
             _check_level_coverage(expr, pairs, num_levels)
             by_level = sorted(pairs, key=lambda p: -int(p[1]))
@@ -93,6 +113,12 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
 
     if re.search(r"abs\(", s):
         pairs = re.findall(rf"<\s*{_NUM}\s*then\s*(\d+)", s)
+        anchored = re.findall(rf"abs\([^)]*\)\s*<\s*{_NUM}\s*then\s*(\d+)", s)
+        if pairs and len(anchored) != len(pairs):
+            raise SqlTranslationError(
+                "case_expression mixes abs-difference thresholds with other "
+                f"< conditions; not a generated shape: {expr!r}"
+            )
         if pairs:
             _check_level_coverage(expr, pairs, num_levels)
             by_level = sorted(pairs, key=lambda p: -int(p[1]))
@@ -121,8 +147,17 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
             "with num_levels 2 (phonetic equality) or 3 (exact, then phonetic)."
         )
 
-    m = re.search(r"when\s+(\w+)_l\s*=\s*(\w+)_r\s+then\s+(\d+)", s)
-    if m and num_levels == 2:
+    # Strict-equality fast path: only the exact generated shape
+    # (/root/reference/splink/case_statements.py:62-71) — null branch,
+    # equality, else 0. Anything else (extra conditions, missing ELSE with
+    # its SQL-NULL semantics) belongs to the general CASE compiler.
+    m = re.fullmatch(
+        r"case\s+(?:when\s+(\w+)_l\s+is\s+null\s+or\s+\1_r\s+is\s+null\s+"
+        r"then\s*-1\s+)?when\s+(\w+)_l\s*=\s*\2_r\s+then\s+1\s+"
+        r"else\s+0\s+end",
+        s,
+    )
+    if m and num_levels == 2 and (m.group(1) is None or m.group(1) == m.group(2)):
         return {"kind": "exact"}
 
     raise SqlTranslationError(
@@ -137,8 +172,9 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
         "  * abs(a - b)/abs(max) < t chains   -> kind 'numeric_perc'\n"
         "  * dmetaphone equality (2/3 level)  -> kind 'dmetaphone'\n"
         "  * name-inversion jw + ifnull OR    -> kind 'name_inversion'\n"
-        "Hand-written CASE expressions outside these shapes cannot be "
-        "auto-migrated: provide a native spec instead, e.g. "
+        "Hand-written CASE expressions outside these shapes are compiled by "
+        "the general CASE compiler (splink_tpu/case_compiler.py) when used "
+        "via settings; alternatively provide a native spec, e.g. "
         '{"comparison": {"kind": "jaro_winkler", "thresholds": [0.94, 0.88]}}, '
         "or implement the logic with splink_tpu.register_comparison() and "
         '{"comparison": {"kind": "custom", "name": ...}}.'

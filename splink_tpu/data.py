@@ -187,6 +187,10 @@ def _columns_needed(settings: dict) -> tuple[dict[str, str], list[str]]:
     for col in settings["comparison_columns"]:
         if "col_name" in col:
             typed[col["col_name"]] = col.get("data_type", "string")
+        # usage-inferred types from a compiled CASE expression take
+        # precedence over the blanket string default for custom columns
+        for extra, typ in col.get("comparison", {}).get("column_types", {}).items():
+            typed.setdefault(extra, typ)
         for extra in col.get("custom_columns_used", []):
             typed.setdefault(extra, "string")
         for extra in col.get("comparison", {}).get("other_columns", []):
@@ -212,6 +216,7 @@ def _phonetic_columns_needed(settings: dict) -> set[str]:
     need: set[str] = set()
     for col in settings["comparison_columns"]:
         spec = col.get("comparison") or {}
+        need.update(spec.get("phonetic_columns", []))
         if spec.get("kind") == "dmetaphone":
             name = (
                 col.get("col_name")

@@ -146,6 +146,10 @@ def comparison_columns_used(settings: dict) -> set[str] | None:
             if kind == "dmetaphone":
                 used.add(phonetic_column_name(name))
         used.update(spec.get("other_columns", []))
+        used.update(spec.get("columns_used", []))
+        used.update(
+            phonetic_column_name(c) for c in spec.get("phonetic_columns", [])
+        )
     return used
 
 
@@ -304,6 +308,14 @@ def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
                 f"{spec.get('fn')!r}. Use splink_tpu.register_comparison()."
             )
         return fn(ctx, col_settings).astype(GAMMA_DTYPE)
+
+    if kind == "case_sql":
+        # Hand-written SQL CASE expression (the reference's arbitrary
+        # case_expression escape hatch), compiled by case_compiler into
+        # jax-traceable ops over the same PairContext.
+        from .case_compiler import compile_case_expression
+
+        return compile_case_expression(spec["expr"], levels)(ctx)
 
     pc = ctx.col(name)
     thresholds = tuple(spec.get("thresholds", ()))

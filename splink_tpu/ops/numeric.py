@@ -15,7 +15,12 @@ def abs_difference(a, b):
 
 
 def relative_difference(a, b):
-    """|a - b| / |max(a, b)| with a safe 0/0 -> 0."""
+    """|a - b| / |max(a, b)|; a zero denominator yields +inf.
+
+    SQL division by zero is NULL, so in the reference's generated CASE no
+    ``< t`` branch fires and the pair falls to the else level — +inf
+    reproduces that outcome (including for two exact zeros).
+    """
     denom = jnp.abs(jnp.maximum(a, b))
     diff = jnp.abs(a - b)
-    return jnp.where(denom > 0, diff / denom, jnp.where(diff > 0, jnp.inf, 0.0))
+    return jnp.where(denom > 0, diff / denom, jnp.inf)

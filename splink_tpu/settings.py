@@ -14,7 +14,7 @@ from __future__ import annotations
 import copy
 import warnings
 
-from .compat_sql import parse_case_expression
+from .compat_sql import SqlTranslationError, parse_case_expression
 from .validate import get_default_value, validate_settings
 
 # Default m/u priors, identical to the reference's
@@ -98,14 +98,55 @@ def _complete_comparison(col_settings: dict) -> None:
         if "kind" not in spec:
             raise ValueError(f"comparison spec {spec!r} is missing 'kind'")
     elif "case_expression" in col_settings:
-        # Reference-splink compatibility: translate the SQL CASE shape.
-        col_settings["comparison"] = parse_case_expression(
-            col_settings["case_expression"], levels
-        )
+        # Reference-splink compatibility: fast-path the CASE shapes the
+        # reference's generators emit onto native kernels; anything else is
+        # handed to the general CASE compiler (splink_tpu/case_compiler.py)
+        # which executes the expression faithfully inside the gamma program.
+        try:
+            col_settings["comparison"] = parse_case_expression(
+                col_settings["case_expression"], levels
+            )
+            # A numeric CASE shape implies the column is numeric even if
+            # data_type was left at the 'string' default.
+            if col_settings["comparison"]["kind"] in ("numeric_abs", "numeric_perc"):
+                col_settings["data_type"] = "numeric"
+        except SqlTranslationError as fast_err:
+            col_settings["comparison"] = _general_case_spec(
+                col_settings, levels, fast_err
+            )
     else:
         col_settings["comparison"] = _default_comparison(
             col_settings["data_type"], levels
         )
+
+
+def _general_case_spec(col_settings: dict, levels: int, fast_err) -> dict:
+    """Build a 'case_sql' comparison spec for a hand-written CASE expression
+    the shape-translator doesn't recognise, validating it compiles."""
+    from .case_compiler import analyse_case_expression, compile_case_expression
+
+    expr = col_settings["case_expression"]
+    try:
+        info = analyse_case_expression(expr)
+        compile_case_expression(expr, levels)  # compile-time validation
+    except SqlTranslationError as general_err:
+        raise SqlTranslationError(
+            f"case_expression could not be handled.\n"
+            f"Shape translator: {fast_err}\n"
+            f"General CASE compiler: {general_err}"
+        ) from general_err
+    # A CASE doing arithmetic on its own column implies the column is
+    # numeric even if data_type was left at the 'string' default.
+    primary = col_settings.get("col_name")
+    if primary and info["columns"].get(primary) == "numeric":
+        col_settings["data_type"] = "numeric"
+    return {
+        "kind": "case_sql",
+        "expr": expr,
+        "columns_used": sorted(info["columns"]),
+        "column_types": dict(info["columns"]),
+        "phonetic_columns": sorted(info["phonetic"]),
+    }
 
 
 def _complete_probabilities(col_settings: dict, key: str) -> None:
