@@ -1,0 +1,82 @@
+"""Blocking-only benchmark: host-side candidate-pair generation at scale.
+
+The 50M-pairs/sec north star is bounded by pair materialisation, not device
+FLOPs (SURVEY §7 "Hard parts" #2), so blocking throughput is measured on its
+own: datagen -> encode -> block_using_rules with the config-4 rule set
+(three rules, sequential-rule dedup semantics). No device work.
+
+Run:  python benchmarks/blocking_bench.py [--rows 10000000]
+
+Prints one JSON line: rows, pairs, seconds per stage, pairs/sec.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_000_000)
+    ap.add_argument("--spill-dir", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # no device work in this bench
+
+    from benchmarks.datagen import make_people
+    from splink_tpu.blocking import block_using_rules
+    from splink_tpu.data import encode_table
+    from splink_tpu.settings import complete_settings_dict
+
+    t0 = time.perf_counter()
+    df = make_people(args.rows, seed=9)
+    t_datagen = time.perf_counter() - t0
+
+    settings = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "first_name", "num_levels": 2}],
+        "blocking_rules": [
+            "l.dob = r.dob",
+            "l.postcode = r.postcode AND l.surname = r.surname",
+            "l.first_name = r.first_name AND l.surname = r.surname",
+        ],
+    }
+    if args.spill_dir:
+        settings["spill_dir"] = args.spill_dir
+    settings = complete_settings_dict(settings)
+
+    t0 = time.perf_counter()
+    table = encode_table(df, settings)
+    t_encode = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    pairs = block_using_rules(settings, table, None)
+    t_block = time.perf_counter() - t0
+
+    peak_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    print(
+        json.dumps(
+            {
+                "rows": len(df),
+                "pairs": int(pairs.n_pairs),
+                "datagen_s": round(t_datagen, 1),
+                "encode_s": round(t_encode, 1),
+                "blocking_s": round(t_block, 1),
+                "pairs_per_sec": round(pairs.n_pairs / t_block),
+                "peak_rss_gb": round(peak_gb, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

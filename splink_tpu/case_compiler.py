@@ -299,6 +299,24 @@ def analyse_case_expression(expr: str) -> dict:
     phonetic: set[str] = set()
     levels: set[int] = set()
 
+    def numericish(node) -> bool:
+        """Whether a node is structurally numeric (so the other side of an
+        equality must be numeric too)."""
+        kind = node[0]
+        if kind == "num":
+            return True
+        if kind == "neg":
+            return numericish(node[1])
+        if kind == "arith":
+            return True
+        if kind == "func":
+            return node[1] in _NUMERIC_FUNCS or node[1] in (
+                "length", "len", "char_length", "jaro_winkler_sim",
+                "jaro_winkler", "levenshtein", "jaccard_sim",
+                "cosine_distance",
+            )
+        return False
+
     def mark(node, numeric=False):
         kind = node[0]
         if kind == "col":
@@ -309,10 +327,8 @@ def analyse_case_expression(expr: str) -> dict:
         elif kind == "case":
             for cond, val in node[1]:
                 mark(cond)
-                _collect_level(val, levels)
                 mark(val)
             if node[2] is not None:
-                _collect_level(node[2], levels)
                 mark(node[2])
         elif kind in ("or", "and"):
             mark(node[1])
@@ -327,8 +343,8 @@ def analyse_case_expression(expr: str) -> dict:
                 mark(a, numeric=True)
                 mark(b, numeric=True)
             else:
-                mark(a, numeric=b[0] == "num")
-                mark(b, numeric=a[0] == "num")
+                mark(a, numeric=numericish(b))
+                mark(b, numeric=numericish(a))
         elif kind == "isnull":
             mark(node[1])
         elif kind == "arith":
@@ -351,14 +367,44 @@ def analyse_case_expression(expr: str) -> dict:
                     mark(a)
 
     mark(ast)
+    if ast[0] == "case":
+        _collect_outcomes(ast, levels, expr)
     return {"columns": cols, "phonetic": phonetic, "levels": levels}
 
 
-def _collect_level(node, out: set[int]) -> None:
-    if node[0] == "num" and float(node[1]).is_integer():
-        out.add(int(node[1]))
-    elif node[0] == "neg" and node[1][0] == "num":
-        out.add(-int(node[1][1]))
+def _collect_outcomes(case_node, out: set[int], expr: str) -> None:
+    """Collect the gamma-level outcomes of the ROOT CASE: its THEN/ELSE
+    leaves, recursing only into nested CASEs in *value* position (their
+    values are outcomes too; a CASE inside a condition is not)."""
+
+    def leaf(node):
+        if node[0] == "case":
+            _collect_outcomes(node, out, expr)
+        elif node[0] == "num":
+            if not float(node[1]).is_integer():
+                raise SqlTranslationError(
+                    f"CASE outcome {node[1]!r} is not an integer gamma "
+                    f"level: {expr!r}"
+                )
+            out.add(int(node[1]))
+        elif node[0] == "neg" and node[1][0] == "num":
+            if not float(node[1][1]).is_integer():
+                raise SqlTranslationError(
+                    f"CASE outcome -{node[1][1]!r} is not an integer gamma "
+                    f"level: {expr!r}"
+                )
+            out.add(-int(node[1][1]))
+        # non-literal outcomes (column refs, arithmetic) cannot be checked
+        # statically; they are validated by the int8 cast at run time
+
+    for _, val in case_node[1]:
+        leaf(val)
+    if case_node[2] is not None:
+        leaf(case_node[2])
+
+
+def _supported_functions() -> list[str]:
+    return sorted(n[4:] for n in dir(_Evaluator) if n.startswith("_fn_"))
 
 
 def _validate_functions(ast, expr: str) -> None:
@@ -377,12 +423,10 @@ def _validate_functions(ast, expr: str) -> None:
                         f"or cosine_distance: {expr!r}"
                     )
             elif not hasattr(_Evaluator, f"_fn_{name}"):
-                supported = sorted(
-                    n[4:] for n in dir(_Evaluator) if n.startswith("_fn_")
-                )
                 raise SqlTranslationError(
                     f"Unsupported function {name!r} in case_expression "
-                    f"{expr!r}. Supported functions: {', '.join(supported)}."
+                    f"{expr!r}. Supported functions: "
+                    f"{', '.join(_supported_functions())}."
                 )
             for a in node[2]:
                 walk(a, parent_func=name)
@@ -786,17 +830,11 @@ class _Evaluator:
         _, name, args = node
         handler = getattr(self, f"_fn_{name}", None)
         if handler is None:
-            m = _TOKENISER_Q.match(name)
-            if m:
-                raise SqlTranslationError(
-                    f"{name} must appear as an argument of jaccard_sim or "
-                    "cosine_distance"
-                )
+            # unreachable via compile_case_expression (static
+            # _validate_functions runs first); kept for direct evaluator use
             raise SqlTranslationError(
                 f"Unsupported function {name!r} in case_expression. "
-                "Supported: jaro_winkler_sim, levenshtein, jaccard_sim, "
-                "cosine_distance, dmetaphone, length, lower, upper, abs, "
-                "least, greatest, round, floor, ceil, ifnull, coalesce."
+                f"Supported functions: {', '.join(_supported_functions())}."
             )
         return handler(args)
 

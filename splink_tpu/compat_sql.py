@@ -57,6 +57,7 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
     if "jaro_winkler_sim" in s:
         pairs = re.findall(rf"jaro_winkler_sim\([^)]*\)\s*>\s*{_NUM}\s*then\s*(\d+)", s)
         if pairs:
+            _check_generated_frame(expr, s)
             _check_level_coverage(expr, pairs, num_levels)
             by_level = sorted(pairs, key=lambda p: -int(p[1]))
             return {"kind": "jaro_winkler", "thresholds": [float(t) for t, _ in by_level]}
@@ -75,6 +76,7 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
                 f"other <= conditions; not a generated shape: {expr!r}"
             )
         if pairs:
+            _check_generated_frame(expr, s)
             levels = {int(lv) for _, lv in pairs}
             eq = re.search(r"when\s+(\w+)_l\s*=\s*\1_r\s+then\s+(\d+)", s)
             if (
@@ -99,7 +101,7 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
         # single all-relative kernel.
         pairs = re.findall(rf"<\s*{_NUM}\s*then\s*(\d+)", s)
         anchored = re.findall(
-            rf"abs\([^)]*\)\s*/[^<]*<\s*{_NUM}\s*then\s*(\d+)", s
+            rf"abs\([^)]*\)\s*\)*\s*/[^<]*<\s*{_NUM}\s*then\s*(\d+)", s
         )
         if pairs and len(anchored) != len(pairs):
             raise SqlTranslationError(
@@ -107,44 +109,57 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
                 f"other < conditions; not a generated shape: {expr!r}"
             )
         if pairs:
+            _check_generated_frame(expr, s)
             _check_level_coverage(expr, pairs, num_levels)
             by_level = sorted(pairs, key=lambda p: -int(p[1]))
             return {"kind": "numeric_perc", "thresholds": [float(t) for t, _ in by_level]}
 
     if re.search(r"abs\(", s):
         pairs = re.findall(rf"<\s*{_NUM}\s*then\s*(\d+)", s)
-        anchored = re.findall(rf"abs\([^)]*\)\s*<\s*{_NUM}\s*then\s*(\d+)", s)
+        anchored = re.findall(
+            rf"abs\([^)]*\)\s*\)*\s*<\s*{_NUM}\s*then\s*(\d+)", s
+        )
         if pairs and len(anchored) != len(pairs):
             raise SqlTranslationError(
                 "case_expression mixes abs-difference thresholds with other "
                 f"< conditions; not a generated shape: {expr!r}"
             )
         if pairs:
+            _check_generated_frame(expr, s)
             _check_level_coverage(expr, pairs, num_levels)
             by_level = sorted(pairs, key=lambda p: -int(p[1]))
             return {"kind": "numeric_abs", "thresholds": [float(t) for t, _ in by_level]}
 
     if "dmetaphone" in s:
         # DoubleMetaphone-UDF comparison shapes: phonetic equality at level 1,
-        # optionally under strict equality at level 2.
-        m3 = re.search(
-            r"when\s+(\w+)_l\s*=\s*\1_r\s+then\s+2\s+when\s+"
-            r"dmetaphone\(\s*\1_l\s*\)\s*=\s*dmetaphone\(\s*\1_r\s*\)\s*then\s+1",
+        # optionally under strict equality at level 2. Full-shape match only —
+        # extra branches/conjuncts route to the general CASE compiler.
+        _NULLB = (
+            r"(?:when\s+(?P<nb>\w+)_l\s+is\s+null\s+or\s+(?P=nb)_r\s+is\s+null\s+"
+            r"then\s*-1\s+)?"
+        )
+        m3 = re.fullmatch(
+            r"case\s+" + _NULLB +
+            r"when\s+(?P<c>\w+)_l\s*=\s*(?P=c)_r\s+then\s+2\s+when\s+"
+            r"dmetaphone\(\s*(?P=c)_l\s*\)\s*=\s*dmetaphone\(\s*(?P=c)_r\s*\)\s*"
+            r"then\s+1\s+else\s+0\s+end",
             s,
         )
-        if m3 and num_levels == 3:
+        if m3 and num_levels == 3 and m3.group("nb") == m3.group("c"):
             return {"kind": "dmetaphone"}
-        m2 = re.search(
-            r"when\s+dmetaphone\(\s*(\w+)_l\s*\)\s*=\s*"
-            r"dmetaphone\(\s*\1_r\s*\)\s*then\s+1",
+        m2 = re.fullmatch(
+            r"case\s+" + _NULLB +
+            r"when\s+dmetaphone\(\s*(?P<c>\w+)_l\s*\)\s*=\s*"
+            r"dmetaphone\(\s*(?P=c)_r\s*\)\s*then\s+1\s+else\s+0\s+end",
             s,
         )
-        if m2 and num_levels == 2:
+        if m2 and num_levels == 2 and m2.group("nb") == m2.group("c"):
             return {"kind": "dmetaphone"}
         raise SqlTranslationError(
             f"Unrecognised dmetaphone case_expression shape: {expr!r}. "
             'Provide a native spec {"comparison": {"kind": "dmetaphone"}} '
-            "with num_levels 2 (phonetic equality) or 3 (exact, then phonetic)."
+            "with num_levels 2 (phonetic equality) or 3 (exact, then phonetic), "
+            "or rely on the general CASE compiler for hand-written variants."
         )
 
     # Strict-equality fast path: only the exact generated shape
@@ -152,12 +167,12 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
     # equality, else 0. Anything else (extra conditions, missing ELSE with
     # its SQL-NULL semantics) belongs to the general CASE compiler.
     m = re.fullmatch(
-        r"case\s+(?:when\s+(\w+)_l\s+is\s+null\s+or\s+\1_r\s+is\s+null\s+"
-        r"then\s*-1\s+)?when\s+(\w+)_l\s*=\s*\2_r\s+then\s+1\s+"
+        r"case\s+when\s+(\w+)_l\s+is\s+null\s+or\s+\1_r\s+is\s+null\s+"
+        r"then\s*-1\s+when\s+(\w+)_l\s*=\s*\2_r\s+then\s+1\s+"
         r"else\s+0\s+end",
         s,
     )
-    if m and num_levels == 2 and (m.group(1) is None or m.group(1) == m.group(2)):
+    if m and num_levels == 2 and m.group(1) == m.group(2):
         return {"kind": "exact"}
 
     raise SqlTranslationError(
@@ -179,6 +194,26 @@ def parse_case_expression(expr: str, num_levels: int) -> dict:
         "or implement the logic with splink_tpu.register_comparison() and "
         '{"comparison": {"kind": "custom", "name": ...}}.'
     )
+
+
+def _check_generated_frame(expr: str, s: str) -> None:
+    """The reference's generated CASE shapes all share one frame: a leading
+    ``X_l is null or X_r is null then -1`` branch, no AND anywhere and no
+    other OR. A hand-written CASE with extra conjuncts or without the null
+    branch must NOT be collapsed onto a narrower native kernel — raising here
+    routes it to the general CASE compiler, which executes it faithfully."""
+    if re.search(r"\band\b", s):
+        raise SqlTranslationError(
+            "case_expression contains AND conjuncts, which the generated "
+            f"shapes never do; not a generated shape: {expr!r}"
+        )
+    if len(re.findall(r"\bor\b", s)) != 1 or not re.search(
+        r"when\s+(\w+)_l\s+is\s+null\s+or\s+\1_r\s+is\s+null\s+then\s*-1", s
+    ):
+        raise SqlTranslationError(
+            "case_expression lacks the generated shapes' single "
+            f"'X_l is null or X_r is null then -1' branch: {expr!r}"
+        )
 
 
 def _check_level_coverage(expr: str, pairs, num_levels: int) -> None:

@@ -70,7 +70,11 @@ class EncodedTable:
             return self.strings[name].null_mask
         if name in self.numerics:
             return self.numerics[name].null_mask
-        return np.array([v is None for v in self.raw[name]])
+        # raw passthrough columns keep pandas' NaN for missing values — a
+        # bare `is None` check would let NaN through as a "known" value
+        import pandas as pd
+
+        return pd.isna(pd.Series(self.raw[name])).to_numpy()
 
     def string_ranks(self, name: str) -> tuple[np.ndarray, np.ndarray]:
         """(ranks, sorted_vocab) for a string column: ranks is (n,) float64 —

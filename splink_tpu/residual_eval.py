@@ -244,9 +244,10 @@ class _Evaluator:
         val = np.zeros(self.n, bool)
         known = ~unk
         if known.any():
-            val[known] = np.asarray(
-                ufunc(lvals[known], rvals[known]), dtype=bool
-            )
+            with np.errstate(invalid="ignore"):
+                val[known] = np.asarray(
+                    ufunc(lvals[known], rvals[known]), dtype=bool
+                )
         return Kleene(val, unk)
 
     def _raw_side(self, v):
@@ -304,9 +305,18 @@ class _Evaluator:
         v = self.value_eval(node)
         if isinstance(v, (np.ndarray, int, float)):
             return v
+        if isinstance(v, (StrOperand, RawOperand)):
+            # SQL implicitly casts in numeric contexts (CAST(col AS DOUBLE));
+            # unparseable values and nulls become NaN -> comparison unknown.
+            import pandas as pd
+
+            vals = pd.to_numeric(
+                pd.Series(v.values), errors="coerce"
+            ).to_numpy(dtype=np.float64, copy=True)
+            vals[v.null] = np.nan
+            return vals
         raise ResidualEvalError(
-            f"Expected a numeric operand, got {type(v).__name__} "
-            "(arithmetic on string columns is not supported)"
+            f"Expected a numeric operand, got {type(v).__name__}"
         )
 
     def column(self, node: ast.Subscript):

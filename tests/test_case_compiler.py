@@ -364,10 +364,11 @@ def test_division_by_zero_is_sql_null():
     df = pd.DataFrame(
         {"unique_id": range(3), "amount": [0.0, 0.0, 10.0]}
     )
-    # This IS the generated relative-difference shape, so it fast-paths to
-    # the numeric_perc kernel — whose zero-denominator semantics must match
-    # SQL's x/0 -> NULL -> branch skipped.
+    # This IS the generated relative-difference shape (incl. the null
+    # branch), so it fast-paths to the numeric_perc kernel — whose
+    # zero-denominator semantics must match SQL's x/0 -> NULL -> skipped.
     expr = """case
+        when amount_l is null or amount_r is null then -1
         when abs(amount_l - amount_r) / greatest(amount_l, amount_r) < 0.05
           then 1
         else 0 end"""
@@ -418,3 +419,113 @@ def test_greatest_skips_nulls_like_sql():
     # left row1: greatest(null, 7)=7>4 (null skipped) -> 1
     G2 = prog.compute(np.array([1]), np.array([2]))
     assert G2[:, 0].tolist() == [1]
+
+
+def test_extra_conjunct_never_fast_paths():
+    """A hand-written CASE with an extra AND conjunct must NOT collapse onto
+    a narrower native kernel (which would silently drop the conjunct)."""
+    from splink_tpu.compat_sql import parse_case_expression
+
+    for expr in [
+        "case when age_l > 18 and abs(age_l - age_r) < 2 then 1 else 0 end",
+        "case when name_l = name_r and jaro_winkler_sim(name_l, name_r) > 0.9"
+        " then 2 when jaro_winkler_sim(name_l, name_r) > 0.7 then 1 else 0 end",
+        "case when dmetaphone(name_l) = dmetaphone(name_r) then 1 "
+        "when length(name_l) > 2 then 1 else 0 end",
+    ]:
+        with pytest.raises(SqlTranslationError):
+            parse_case_expression(expr, 2)
+
+    # and the guard-bearing numeric expression executes correctly end-to-end
+    df = pd.DataFrame(
+        {"unique_id": range(4), "age": [30.0, 31.0, 17.0, 50.0]}
+    )
+    prog, s = _program(
+        [
+            {
+                "col_name": "age",
+                "num_levels": 2,
+                "case_expression": "case when age_l > 18 and "
+                "abs(age_l - age_r) < 2 then 1 else 0 end",
+            }
+        ],
+        df,
+    )
+    assert s["comparison_columns"][0]["comparison"]["kind"] == "case_sql"
+    G = prog.compute(*_pairs_vs_first(df))
+    # 30 vs 31: guard ok, diff 1 -> 1; vs 17: diff 13 -> 0; vs 50 -> 0
+    assert G[:, 0].tolist() == [1, 0, 0]
+
+
+def test_generated_shapes_still_fast_path():
+    from splink_tpu.compat_sql import parse_case_expression
+
+    jw3 = """case
+    when name_l is null or name_r is null then -1
+    when jaro_winkler_sim(name_l, name_r) > 0.94 then 2
+    when jaro_winkler_sim(name_l, name_r) > 0.88 then 1
+    else 0 end"""
+    assert parse_case_expression(jw3, 3)["kind"] == "jaro_winkler"
+    exact = """case
+    when city_l is null or city_r is null then -1
+    when city_l = city_r then 1
+    else 0 end"""
+    assert parse_case_expression(exact, 2)["kind"] == "exact"
+    perc3 = """case
+    when age_l is null or age_r is null then -1
+    when (abs(age_l - age_r))/abs(
+    case when age_l > age_r then age_l else age_r end
+    ) < 0.0001 then 2
+    when (abs(age_l - age_r))/abs(
+    case when age_l > age_r then age_l else age_r end
+    ) < 0.05 then 1
+    else 0 end"""
+    assert parse_case_expression(perc3, 3)["kind"] == "numeric_perc"
+
+
+def test_equality_with_negative_literal_and_arith_infers_numeric():
+    info = analyse_case_expression("case when code_l = -1 then 0 else 1 end")
+    assert info["columns"] == {"code": "numeric"}
+    info2 = analyse_case_expression(
+        "case when total_l = price_r * 2 then 1 else 0 end"
+    )
+    assert info2["columns"] == {"total": "numeric", "price": "numeric"}
+    df = pd.DataFrame({"unique_id": range(3), "code": [-1.0, -1.0, 4.0]})
+    prog, _ = _program(
+        [
+            {
+                "col_name": "code",
+                "num_levels": 2,
+                "case_expression": "case when code_l = -1 and code_r = -1 "
+                "then 1 else 0 end",
+            }
+        ],
+        df,
+    )
+    G = prog.compute(*_pairs_vs_first(df))
+    assert G[:, 0].tolist() == [1, 0]
+
+
+def test_nested_case_in_condition_position_not_level_checked():
+    # inner CASE used inside a condition produces 10, which is NOT a gamma
+    # outcome and must not be rejected
+    fn = compile_case_expression(
+        "case when (case when a_l = a_r then 10 else 0 end) = 10 then 1 "
+        "else 0 end",
+        num_levels=2,
+    )
+    assert fn is not None
+    # but a nested CASE in VALUE position contributes outcomes
+    with pytest.raises(SqlTranslationError, match="outside"):
+        compile_case_expression(
+            "case when a_l = a_r then case when b_l = b_r then 9 else 0 end "
+            "else 0 end",
+            num_levels=2,
+        )
+
+
+def test_non_integer_then_value_rejected():
+    with pytest.raises(SqlTranslationError, match="not an integer"):
+        compile_case_expression(
+            "case when name_l = name_r then 1.5 else 0 end", num_levels=2
+        )

@@ -199,3 +199,65 @@ def test_string_literals_containing_keywords(str_table):
     assert got.tolist() == [True, False, False]
     got = _eval(table, "l.band = 'jazz (fusion)' or l.band = 'pop'", i, j)
     assert got.tolist() == [False, True, True]
+
+
+def test_raw_passthrough_nan_is_null():
+    """Raw (non-encoded) columns carry pandas NaN for missing values; the
+    null mask must catch NaN, not just None, so residual comparisons follow
+    SQL unknown semantics instead of numpy NaN-compares-False."""
+    import numpy as np
+    import pandas as pd
+
+    from splink_tpu.data import encode_table
+    from splink_tpu.settings import complete_settings_dict
+
+    df = pd.DataFrame(
+        {
+            "unique_id": range(3),
+            "name": ["a", "b", "c"],
+            "score": [1.0, np.nan, 3.0],
+        }
+    )
+    s = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {"col_name": "name", "comparison": {"kind": "exact"}}
+            ],
+            "blocking_rules": ["l.name = r.name"],
+            "additional_columns_to_retain": ["score"],
+        }
+    )
+    table = encode_table(df, s)
+    assert table.is_null("score").tolist() == [False, True, False]
+
+
+def test_arithmetic_on_raw_passthrough_column():
+    """Blocking-rule arithmetic over a column that is not a comparison
+    column (raw passthrough) must implicitly cast to double like SQL, with
+    NaN/unparseable -> unknown."""
+    import numpy as np
+    import pandas as pd
+
+    from splink_tpu import Splink
+
+    df = pd.DataFrame(
+        {
+            "unique_id": range(5),
+            "name": ["a", "a", "a", "a", "a"],
+            "age": [30.0, 32.0, 50.0, np.nan, 31.0],
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "blocking_rules": ["l.name = r.name AND abs(l.age - r.age) < 5"],
+        "comparison_columns": [
+            {"col_name": "name", "comparison": {"kind": "exact"}}
+        ],
+        "max_iterations": 0,
+    }
+    linker = Splink(s, df=df)
+    out = linker.get_scored_comparisons()
+    got = {tuple(sorted((a, b))) for a, b in zip(out.unique_id_l, out.unique_id_r)}
+    # |30-32|<5, |30-31|<5, |32-31|<5; NaN row 3 joins nothing; row 2 too far
+    assert got == {(0, 1), (0, 4), (1, 4)}

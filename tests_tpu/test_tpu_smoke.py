@@ -116,3 +116,45 @@ class TestPipelineOnHardware:
         )
         assert abs(float(out.params.lam) - 0.25) < 0.02
         assert np.abs(np.asarray(out.params.m) - m_t).max() < 0.03
+
+
+class TestCaseCompilerOnHardware:
+    def test_case_sql_gamma_on_device(self):
+        """A hand-written case_expression (general CASE compiler) lowers and
+        runs inside the jitted gamma program on the chip."""
+        from splink_tpu.data import encode_table
+        from splink_tpu.gammas import GammaProgram
+        from splink_tpu.settings import complete_settings_dict
+
+        df = pd.DataFrame(
+            {
+                "unique_id": range(6),
+                "name": ["martha", "martha", "marhta", "marx", "zz", None],
+                "age": [40.0, 41.0, 39.0, 80.0, 40.0, None],
+            }
+        )
+        expr = """case
+            when name_l is null or name_r is null then -1
+            when name_l = name_r and abs(age_l - age_r) <= 1 then 2
+            when jaro_winkler_sim(name_l, name_r) > 0.9 then 1
+            else 0 end"""
+        s = complete_settings_dict(
+            {
+                "link_type": "dedupe_only",
+                "comparison_columns": [
+                    {
+                        "custom_name": "combo",
+                        "custom_columns_used": ["name", "age"],
+                        "num_levels": 3,
+                        "case_expression": expr,
+                    }
+                ],
+                "blocking_rules": ["l.unique_id = r.unique_id"],
+            }
+        )
+        table = encode_table(df, s)
+        prog = GammaProgram(s, table)
+        G = prog.compute(
+            np.zeros(5, np.int64), np.arange(1, 6, dtype=np.int64)
+        )
+        assert G[:, 0].tolist() == [2, 1, 0, 0, -1]
