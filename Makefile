@@ -1,4 +1,4 @@
-.PHONY: test tpu-smoke bench all
+.PHONY: test tpu-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -12,5 +12,9 @@ tpu-smoke:
 
 bench:
 	python bench.py
+
+# Host-side blocking throughput at 10M rows (no device work; ~15 min).
+bench-blocking:
+	python benchmarks/blocking_bench.py
 
 all: test tpu-smoke bench

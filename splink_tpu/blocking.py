@@ -53,10 +53,120 @@ class PairIndex:
 
     idx_l: np.ndarray  # (n_pairs,) int64
     idx_r: np.ndarray  # (n_pairs,) int64
+    # When blocking streamed the pairs straight to disk (spill_dir set),
+    # idx_l/idx_r are memmaps living in this directory; the linker adopts it
+    # for lifetime management.
+    spill_tmp: str | None = None
 
     @property
     def n_pairs(self) -> int:
         return len(self.idx_l)
+
+
+def _sweep_stale_spill_dirs(spill_dir: str) -> None:
+    """Reclaim splink_pairs_* dirs whose owning process is gone.
+
+    The weakref finalizer on a spilled PairIndex never runs on
+    SIGKILL/OOM-kill — the most likely death for a job big enough to spill —
+    so each spill dir records its owner pid and the next spilling run sweeps
+    dirs whose pid is dead, BEFORE it starts writing its own pair set. Dirs
+    without a pid file (mid-creation, or foreign) are left alone.
+    """
+    import os
+    import shutil
+
+    try:
+        entries = os.listdir(spill_dir)
+    except OSError:
+        return
+    for name in entries:
+        if not name.startswith("splink_pairs_"):
+            continue
+        path = os.path.join(spill_dir, name)
+        pid_file = os.path.join(path, "owner.pid")
+        try:
+            with open(pid_file) as fh:
+                pid = int(fh.read().strip())
+        except (OSError, ValueError):
+            continue
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)  # signal 0: existence check only
+        except ProcessLookupError:
+            logger.info("reclaiming stale spill dir %s (pid %d dead)", path, pid)
+            shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            continue  # e.g. EPERM: pid exists under another user
+
+
+class _PairSink:
+    """Accumulates per-rule pair chunks; either in RAM (concatenate at the
+    end) or streamed to spill files as they are produced, so the pair set
+    never exists twice in memory (chunks + concatenated copy)."""
+
+    def __init__(self, spill_dir: str | None, idx_dtype):
+        self.idx_dtype = idx_dtype
+        self.total = 0
+        self.spill_tmp = None
+        if spill_dir:
+            import os
+            import tempfile
+
+            os.makedirs(spill_dir, exist_ok=True)
+            # reclaim orphans before writing tens of GB next to them
+            _sweep_stale_spill_dirs(spill_dir)
+            self.spill_tmp = tempfile.mkdtemp(
+                prefix="splink_pairs_", dir=spill_dir
+            )
+            with open(os.path.join(self.spill_tmp, "owner.pid"), "w") as fh:
+                fh.write(str(os.getpid()))
+            self._files = [
+                open(os.path.join(self.spill_tmp, f"{name}.bin"), "wb")
+                for name in ("idx_l", "idx_r")
+            ]
+        else:
+            self._chunks_l: list[np.ndarray] = []
+            self._chunks_r: list[np.ndarray] = []
+
+    def append(self, i: np.ndarray, j: np.ndarray) -> None:
+        i = i.astype(self.idx_dtype, copy=False)
+        j = j.astype(self.idx_dtype, copy=False)
+        self.total += len(i)
+        if self.spill_tmp is not None:
+            i.tofile(self._files[0])
+            j.tofile(self._files[1])
+        else:
+            self._chunks_l.append(i)
+            self._chunks_r.append(j)
+
+    def finish(self) -> PairIndex:
+        if self.spill_tmp is None:
+            return PairIndex(
+                np.concatenate(self._chunks_l), np.concatenate(self._chunks_r)
+            )
+        import os
+        import shutil
+        import weakref
+
+        for fh in self._files:
+            fh.close()
+        arrs = []
+        for name in ("idx_l", "idx_r"):
+            path = os.path.join(self.spill_tmp, f"{name}.bin")
+            if self.total:
+                arrs.append(
+                    np.memmap(
+                        path, dtype=self.idx_dtype, mode="r", shape=(self.total,)
+                    )
+                )
+            else:
+                arrs.append(np.empty(0, self.idx_dtype))
+        out = PairIndex(arrs[0], arrs[1], spill_tmp=self.spill_tmp)
+        # reclaim the files when the pair index goes away (unlink while the
+        # memmaps are open is safe on POSIX; space frees on close)
+        weakref.finalize(out, shutil.rmtree, self.spill_tmp, True)
+        return out
 
 
 # ----------------------------------------------------------------------
@@ -316,7 +426,7 @@ def block_using_rules(
     # packed pair-id set per rule — minutes of host time and two extra
     # full-size copies at the 10M-row configs).
     prior_rules: list[tuple[np.ndarray | None, str | None]] = []
-    out_l, out_r = [], []
+    sink = _PairSink(settings.get("spill_dir"), idx_dtype)
     for rule in rules:
         eq_pairs, residual = parse_blocking_rule(rule)
         join_cols, residual = _split_join_keys(eq_pairs, residual)
@@ -351,11 +461,12 @@ def block_using_rules(
             i, j = i[keep], j[keep]
 
         prior_rules.append((codes, residual))
-        out_l.append(i.astype(idx_dtype, copy=False))
-        out_r.append(j.astype(idx_dtype, copy=False))
-        logger.debug("blocking rule %r -> %d new pairs", rule, len(i))
+        n_new = len(i)
+        sink.append(i, j)
+        del i, j
+        logger.debug("blocking rule %r -> %d new pairs", rule, n_new)
 
-    return PairIndex(np.concatenate(out_l), np.concatenate(out_r))
+    return sink.finish()
 
 
 def _rule_holds(

@@ -19,7 +19,7 @@ from typing import Callable
 import jax.numpy as jnp
 import numpy as np
 
-from .blocking import PairIndex, block_using_rules
+from .blocking import PairIndex, _sweep_stale_spill_dirs, block_using_rules
 from .check_types import check_types
 from .data import EncodedTable, concat_tables, encode_table
 from .em import run_em, score_pairs, score_pairs_with_intermediates
@@ -36,41 +36,6 @@ try:  # pandas is required for the linker facade (not for the kernels)
     import pandas as pd
 except ImportError:  # pragma: no cover
     pd = None
-
-
-def _sweep_stale_spill_dirs(spill_dir: str) -> None:
-    """Reclaim splink_pairs_* dirs whose owning process is gone.
-
-    The per-linker weakref finalizer never runs on SIGKILL/OOM-kill — the
-    most likely death for a job big enough to spill — so each spill dir
-    records its owner pid and the next spilling run sweeps dirs whose pid is
-    dead. Dirs without a pid file (mid-creation, or foreign) are left alone.
-    """
-    import shutil
-
-    try:
-        entries = os.listdir(spill_dir)
-    except OSError:
-        return
-    for name in entries:
-        if not name.startswith("splink_pairs_"):
-            continue
-        path = os.path.join(spill_dir, name)
-        pid_file = os.path.join(path, "owner.pid")
-        try:
-            with open(pid_file) as fh:
-                pid = int(fh.read().strip())
-        except (OSError, ValueError):
-            continue
-        if pid == os.getpid():
-            continue
-        try:
-            os.kill(pid, 0)  # signal 0: existence check only
-        except ProcessLookupError:
-            logger.info("reclaiming stale spill dir %s (pid %d dead)", path, pid)
-            shutil.rmtree(path, ignore_errors=True)
-        except OSError:
-            continue  # e.g. EPERM: pid exists under another user
 
 
 class Splink:
@@ -186,18 +151,28 @@ class Splink:
         return self._pairs
 
     def _maybe_spill_pairs(self) -> None:
-        """Move the pair index to disk-backed memmaps (streamed regime with
-        spill_dir set): downstream code slices them identically, but tens of
-        GB shift from anonymous memory to the evictable page cache."""
+        """Adopt (or create) disk-backed memmaps for the pair index in the
+        streamed regime with spill_dir set: downstream code slices them
+        identically, but tens of GB shift from anonymous memory to the
+        evictable page cache."""
         spill_dir = self.settings["spill_dir"]
+        import shutil
+        import weakref
+
+        if self._pairs.spill_tmp is not None:
+            # blocking already streamed the pairs straight to disk (having
+            # swept orphans first, never materialising a second in-RAM
+            # copy); its PairIndex owns the directory lifetime via its own
+            # finalizer
+            self._spill_tmp = self._pairs.spill_tmp
+            logger.info("pair index spilled to %s (streamed)", self._spill_tmp)
+            return
         if (
             not spill_dir
             or self._pairs.n_pairs <= int(self.settings["max_resident_pairs"])
         ):
             return
-        import shutil
         import tempfile
-        import weakref
 
         os.makedirs(spill_dir, exist_ok=True)
         _sweep_stale_spill_dirs(spill_dir)

@@ -227,3 +227,35 @@ def test_stale_spill_dirs_swept(tmp_path):
     assert not dead.exists()
     assert alive.exists()
     assert foreign.exists()
+
+
+def test_blocking_streams_pairs_to_spill_dir(tmp_path):
+    """With spill_dir set, blocking writes pair chunks straight to disk —
+    no in-RAM concatenated copy — and the PairIndex owns the directory."""
+    import gc
+    import os
+
+    from splink_tpu.blocking import block_using_rules
+    from splink_tpu.data import encode_table
+    from splink_tpu.settings import complete_settings_dict
+
+    df = _df(n=300, seed=2)
+    s = complete_settings_dict(
+        _settings(spill_dir=str(tmp_path), max_resident_pairs=1024)
+    )
+    table = encode_table(df, s)
+    pairs = block_using_rules(s, table, None)
+    assert pairs.spill_tmp is not None
+    assert isinstance(pairs.idx_l, np.memmap)
+    spill_files = os.listdir(pairs.spill_tmp)
+    assert {"idx_l.bin", "idx_r.bin", "owner.pid"} <= set(spill_files)
+    # identical pair set to the unspilled path
+    s2 = complete_settings_dict(_settings())
+    ref = block_using_rules(s2, table, None)
+    np.testing.assert_array_equal(np.asarray(pairs.idx_l), ref.idx_l)
+    np.testing.assert_array_equal(np.asarray(pairs.idx_r), ref.idx_r)
+    # dropping the PairIndex reclaims the directory
+    tmp = pairs.spill_tmp
+    del pairs
+    gc.collect()
+    assert not os.path.exists(tmp)
