@@ -14,11 +14,65 @@ publishes no numbers — BASELINE.md: "None exist").
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 TARGET_PAIRS_PER_SEC_PER_CHIP = 50e6 / 8  # north star: 50M/s on a v5e-8
+
+# A dead accelerator tunnel can make `import jax` / device init block FOREVER
+# inside a C-level call (no Python signal delivery), which reads as a stalled
+# benchmark. Probe device init in a subprocess first — a subprocess timeout
+# kills reliably — and fail fast and loud if it never comes up.
+DEVICE_INIT_TIMEOUT_S = int(os.environ.get("SPLINK_TPU_BENCH_INIT_TIMEOUT", 600))
+
+
+def _probe_device_init():
+    import tempfile
+
+    # stderr goes to a FILE, not a pipe: if the probe child forks helpers
+    # that outlive a timeout kill, inherited pipe write-ends would block the
+    # parent's read forever; a file has no reader to block. The child runs in
+    # its own session so the whole process group can be killed.
+    with tempfile.TemporaryFile() as errf:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=errf,
+            start_new_session=True,
+        )
+        try:
+            ok = proc.wait(timeout=DEVICE_INIT_TIMEOUT_S) == 0
+            errf.seek(0)
+            detail = errf.read().decode(errors="replace")[-300:]
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)  # child + any helpers
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+            ok = False
+            detail = f"no response within {DEVICE_INIT_TIMEOUT_S}s"
+    if not ok:
+        print(
+            json.dumps(
+                {
+                    "metric": "scored_record_pairs_per_sec_per_chip",
+                    "value": 0,
+                    "unit": "pairs/sec",
+                    "vs_baseline": 0.0,
+                    "error": "device init failed (accelerator tunnel down?): "
+                    + detail.strip(),
+                }
+            ),
+            flush=True,
+        )
+        sys.exit(2)
 
 N_ROWS = 1_000_000
 N_PAIRS = 8 * (1 << 20)  # ~8.4M pairs
@@ -73,6 +127,7 @@ def _make_df(rng, n_rows):
 
 
 def main():
+    _probe_device_init()
     import jax
     import jax.numpy as jnp
 

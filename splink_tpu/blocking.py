@@ -140,6 +140,22 @@ class _PairSink:
             self._chunks_l.append(i)
             self._chunks_r.append(j)
 
+    def abort(self) -> None:
+        """Close handles and reclaim the partial spill dir after a failure
+        mid-blocking — the owning process is still alive, so the stale-dir
+        sweep would (correctly) not touch it."""
+        if self.spill_tmp is None:
+            return
+        import shutil
+
+        for fh in self._files:
+            try:
+                fh.close()
+            except OSError:
+                pass
+        shutil.rmtree(self.spill_tmp, ignore_errors=True)
+        self.spill_tmp = None
+
     def finish(self) -> PairIndex:
         if self.spill_tmp is None:
             return PairIndex(
@@ -413,9 +429,6 @@ def block_using_rules(
     # halves the resident footprint of the pair set.
     idx_dtype = _idx_dtype(table.n_rows)
     all_rows = np.arange(table.n_rows, dtype=idx_dtype)
-    if link_type == "link_only":
-        assert n_left is not None
-        left_rows, right_rows = all_rows[:n_left], all_rows[n_left:]
 
     # Sequential-rule dedup by PREDICATE, the literal semantics of the
     # reference's ``AND NOT ifnull(previous_rule, false)``
@@ -427,6 +440,21 @@ def block_using_rules(
     # full-size copies at the 10M-row configs).
     prior_rules: list[tuple[np.ndarray | None, str | None]] = []
     sink = _PairSink(settings.get("spill_dir"), idx_dtype)
+    try:
+        return _block_rules_into(
+            sink, rules, settings, table, link_type, all_rows, n_left, prior_rules
+        )
+    except BaseException:
+        sink.abort()
+        raise
+
+
+def _block_rules_into(
+    sink, rules, settings, table, link_type, all_rows, n_left, prior_rules
+) -> PairIndex:
+    if link_type == "link_only":
+        assert n_left is not None
+        left_rows, right_rows = all_rows[:n_left], all_rows[n_left:]
     for rule in rules:
         eq_pairs, residual = parse_blocking_rule(rule)
         join_cols, residual = _split_join_keys(eq_pairs, residual)
@@ -525,9 +553,16 @@ def cartesian_block(
     settings: dict, table: EncodedTable, n_left: int | None = None
 ) -> PairIndex:
     """All pairwise comparisons (the fallback when no rules are given,
-    /root/reference/splink/blocking.py:183-184, 219-318)."""
+    /root/reference/splink/blocking.py:183-184, 219-318). Shares the
+    rule-path's pair sink, so spill_dir streams the cartesian index to disk
+    too."""
     link_type = settings["link_type"]
     i, j = _all_pairs(table, link_type, n_left)
     i, j = _orient_pairs(table, link_type, i, j)
-    idx_dtype = _idx_dtype(table.n_rows)
-    return PairIndex(i.astype(idx_dtype, copy=False), j.astype(idx_dtype, copy=False))
+    sink = _PairSink(settings.get("spill_dir"), _idx_dtype(table.n_rows))
+    try:
+        sink.append(i, j)
+    except BaseException:
+        sink.abort()
+        raise
+    return sink.finish()

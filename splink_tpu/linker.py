@@ -19,7 +19,7 @@ from typing import Callable
 import jax.numpy as jnp
 import numpy as np
 
-from .blocking import PairIndex, _sweep_stale_spill_dirs, block_using_rules
+from .blocking import PairIndex, block_using_rules
 from .check_types import check_types
 from .data import EncodedTable, concat_tables, encode_table
 from .em import run_em, score_pairs, score_pairs_with_intermediates
@@ -151,49 +151,15 @@ class Splink:
         return self._pairs
 
     def _maybe_spill_pairs(self) -> None:
-        """Adopt (or create) disk-backed memmaps for the pair index in the
-        streamed regime with spill_dir set: downstream code slices them
-        identically, but tens of GB shift from anonymous memory to the
-        evictable page cache."""
-        spill_dir = self.settings["spill_dir"]
-        import shutil
-        import weakref
-
+        """Note the blocking-created spill dir (streamed regime): blocking's
+        pair sink streams every pair chunk straight to disk-backed memmaps
+        when spill_dir is set — rule path and cartesian fallback alike — so
+        there is nothing left to copy here. The PairIndex owns the directory
+        lifetime via its weakref finalizer; the stale-orphan sweep ran before
+        any bytes were written."""
         if self._pairs.spill_tmp is not None:
-            # blocking already streamed the pairs straight to disk (having
-            # swept orphans first, never materialising a second in-RAM
-            # copy); its PairIndex owns the directory lifetime via its own
-            # finalizer
             self._spill_tmp = self._pairs.spill_tmp
             logger.info("pair index spilled to %s (streamed)", self._spill_tmp)
-            return
-        if (
-            not spill_dir
-            or self._pairs.n_pairs <= int(self.settings["max_resident_pairs"])
-        ):
-            return
-        import tempfile
-
-        os.makedirs(spill_dir, exist_ok=True)
-        _sweep_stale_spill_dirs(spill_dir)
-        self._spill_tmp = tempfile.mkdtemp(prefix="splink_pairs_", dir=spill_dir)
-        # Record the owning pid so a later run can reclaim this dir if we die
-        # without running the finalizer (SIGKILL / OOM-kill).
-        with open(os.path.join(self._spill_tmp, "owner.pid"), "w") as fh:
-            fh.write(str(os.getpid()))
-        # reclaim the spill files when the linker goes away (unlink is safe
-        # while the memmaps are open; space frees on close)
-        self._spill_finalizer = weakref.finalize(
-            self, shutil.rmtree, self._spill_tmp, True
-        )
-        for name in ("idx_l", "idx_r"):
-            arr = getattr(self._pairs, name)
-            path = os.path.join(self._spill_tmp, f"{name}.bin")
-            mm = np.memmap(path, dtype=arr.dtype, mode="w+", shape=arr.shape)
-            mm[:] = arr
-            mm.flush()
-            setattr(self._pairs, name, mm)
-        logger.info("pair index spilled to %s", self._spill_tmp)
 
     def _ensure_gammas(self) -> np.ndarray:
         if self._G is None:

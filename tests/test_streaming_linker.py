@@ -213,7 +213,7 @@ def test_release_input_with_streamed_spill_pipeline(tmp_path):
 def test_stale_spill_dirs_swept(tmp_path):
     import os
 
-    from splink_tpu.linker import _sweep_stale_spill_dirs
+    from splink_tpu.blocking import _sweep_stale_spill_dirs
 
     dead = tmp_path / "splink_pairs_dead"
     dead.mkdir()
@@ -259,3 +259,24 @@ def test_blocking_streams_pairs_to_spill_dir(tmp_path):
     del pairs
     gc.collect()
     assert not os.path.exists(tmp)
+
+
+def test_blocking_failure_reclaims_partial_spill(tmp_path):
+    """An error after the first rule has streamed pairs must close handles
+    and remove the partial spill dir (the owner is alive, so the stale
+    sweep would rightly skip it)."""
+    import os
+
+    import pytest
+
+    from splink_tpu.blocking import block_using_rules
+    from splink_tpu.data import encode_table
+    from splink_tpu.settings import complete_settings_dict
+
+    df = _df(n=200, seed=1)
+    s = complete_settings_dict(_settings(spill_dir=str(tmp_path)))
+    table = encode_table(df, s)
+    s["blocking_rules"] = ["l.city = r.city", "l.nonexistent = r.nonexistent"]
+    with pytest.raises(KeyError):
+        block_using_rules(s, table, None)
+    assert [d for d in os.listdir(tmp_path) if d.startswith("splink_pairs_")] == []
