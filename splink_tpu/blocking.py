@@ -158,6 +158,9 @@ class _PairSink:
 
     def finish(self) -> PairIndex:
         if self.spill_tmp is None:
+            if len(self._chunks_l) == 1:
+                # np.concatenate on a one-element list still copies
+                return PairIndex(self._chunks_l[0], self._chunks_r[0])
             return PairIndex(
                 np.concatenate(self._chunks_l), np.concatenate(self._chunks_r)
             )
@@ -549,20 +552,63 @@ def _all_pairs(table: EncodedTable, link_type: str, n_left: int | None):
     return tri[0].astype(np.int64), tri[1].astype(np.int64)
 
 
+def _iter_all_pairs_chunks(table: EncodedTable, link_type: str, n_left, chunk):
+    """Yield the cartesian pair set in bounded-memory (i, j) chunks of at
+    most ~``chunk`` pairs, in the same order _all_pairs produces."""
+    n = table.n_rows
+    if link_type == "link_only":
+        assert n_left is not None
+        n_right = n - n_left
+        rows_per = max(1, chunk // max(n_right, 1))
+        right = np.arange(n_left, n, dtype=np.int64)
+        for a in range(0, n_left, rows_per):
+            b = min(a + rows_per, n_left)
+            i = np.repeat(np.arange(a, b, dtype=np.int64), n_right)
+            j = np.tile(right, b - a)
+            yield i, j
+        return
+    # dedupe-style upper triangle (i < j), emitted row-block by row-block
+    a = 0
+    while a < n - 1:
+        b = a + 1
+        total = n - 1 - a
+        while b < n - 1 and total + (n - 1 - b) <= chunk:
+            total += n - 1 - b
+            b += 1
+        counts = (n - 1) - np.arange(a, b, dtype=np.int64)
+        i = np.repeat(np.arange(a, b, dtype=np.int64), counts)
+        starts = np.repeat(np.arange(a, b, dtype=np.int64) + 1, counts)
+        within = np.arange(len(i), dtype=np.int64) - np.repeat(
+            np.cumsum(counts) - counts, counts
+        )
+        j = starts + within
+        yield i, j
+        a = b
+
+
 def cartesian_block(
     settings: dict, table: EncodedTable, n_left: int | None = None
 ) -> PairIndex:
     """All pairwise comparisons (the fallback when no rules are given,
-    /root/reference/splink/blocking.py:183-184, 219-318). Shares the
-    rule-path's pair sink, so spill_dir streams the cartesian index to disk
-    too."""
+    /root/reference/splink/blocking.py:183-184, 219-318). With spill_dir the
+    pair set is generated and streamed to disk in bounded-memory chunks."""
     link_type = settings["link_type"]
-    i, j = _all_pairs(table, link_type, n_left)
-    i, j = _orient_pairs(table, link_type, i, j)
-    sink = _PairSink(settings.get("spill_dir"), _idx_dtype(table.n_rows))
+    spill_dir = settings.get("spill_dir")
+    idx_dtype = _idx_dtype(table.n_rows)
+    if not spill_dir:
+        i, j = _all_pairs(table, link_type, n_left)
+        i, j = _orient_pairs(table, link_type, i, j)
+        return PairIndex(
+            i.astype(idx_dtype, copy=False), j.astype(idx_dtype, copy=False)
+        )
+    sink = _PairSink(spill_dir, idx_dtype)
     try:
-        sink.append(i, j)
+        for i, j in _iter_all_pairs_chunks(
+            table, link_type, n_left, _CARTESIAN_CHUNK
+        ):
+            i, j = _orient_pairs(table, link_type, i, j)
+            sink.append(i, j)
+        return sink.finish()
     except BaseException:
         sink.abort()
         raise
-    return sink.finish()

@@ -1,0 +1,153 @@
+"""Link-type pair-set semantics with unique_ids that REPEAT across the two
+input datasets — ids are only unique within a dataset, and `_source_table`
+disambiguates. Data and expected pair sets are the reference's
+(/root/reference/tests/conftest.py:67-87, tests/test_spark.py:471-612).
+
+Also the reference's tiny-numbers regression (issue #48,
+/root/reference/tests/test_spark.py:130-160): astronomically small
+m-probabilities must not underflow scoring — this build works in log space.
+"""
+
+import numpy as np
+import pandas as pd
+
+from splink_tpu import Splink
+
+
+def _data_l():
+    return pd.DataFrame(
+        [
+            {"unique_id": 1, "surname": "Linacre", "first_name": "Robin"},
+            {"unique_id": 2, "surname": "Smith", "first_name": "John"},
+            {"unique_id": 3, "surname": "Smith", "first_name": "John"},
+        ]
+    )
+
+
+def _data_r():
+    return pd.DataFrame(
+        [
+            {"unique_id": 1, "surname": "Linacre", "first_name": "Robin"},
+            {"unique_id": 2, "surname": "Smith", "first_name": "John"},
+            {"unique_id": 3, "surname": "Smith", "first_name": "Robin"},
+        ]
+    )
+
+
+_BASE = {
+    "comparison_columns": [{"col_name": "first_name"}, {"col_name": "surname"}],
+    "blocking_rules": ["l.first_name = r.first_name", "l.surname = r.surname"],
+    "max_iterations": 0,
+}
+
+
+def _tagged(df):
+    df = df.copy()
+    df["u_l"] = df["unique_id_l"].astype(str) + df["_source_table_l"].str.slice(0, 1)
+    df["u_r"] = df["unique_id_r"].astype(str) + df["_source_table_r"].str.slice(0, 1)
+    return df
+
+
+def test_link_and_dedupe_repeat_ids():
+    s = dict(_BASE, link_type="link_and_dedupe")
+    df = Splink(s, df_l=_data_l(), df_r=_data_r())
+    df = _tagged(df.manually_apply_fellegi_sunter_weights())
+    df = df.sort_values(
+        ["_source_table_l", "_source_table_r", "unique_id_l", "unique_id_r"]
+    )
+    # /root/reference/tests/test_spark.py:492-494
+    assert list(df["u_l"]) == ["2l", "1l", "1l", "2l", "2l", "3l", "3l", "1r", "2r"]
+    assert list(df["u_r"]) == ["3l", "1r", "3r", "2r", "3r", "2r", "3r", "3r", "3r"]
+
+
+def test_link_and_dedupe_repeat_ids_cartesian():
+    s = {
+        "comparison_columns": _BASE["comparison_columns"],
+        "link_type": "link_and_dedupe",
+        "blocking_rules": [],
+        "max_iterations": 0,
+    }
+    df = Splink(s, df_l=_data_l(), df_r=_data_r())
+    df = _tagged(df.manually_apply_fellegi_sunter_weights())
+    df = df.sort_values(
+        ["_source_table_l", "unique_id_l", "_source_table_r", "unique_id_r"]
+    )
+    # /root/reference/tests/test_spark.py:516-518
+    assert list(df["u_l"]) == [
+        "1l", "1l", "1l", "1l", "1l", "2l", "2l", "2l", "2l",
+        "3l", "3l", "3l", "1r", "1r", "2r",
+    ]
+    assert list(df["u_r"]) == [
+        "2l", "3l", "1r", "2r", "3r", "3l", "1r", "2r", "3r",
+        "1r", "2r", "3r", "2r", "3r", "3r",
+    ]
+
+
+def test_link_only_repeat_ids():
+    s = dict(_BASE, link_type="link_only")
+    df = Splink(s, df_l=_data_l(), df_r=_data_r())
+    df = df.manually_apply_fellegi_sunter_weights()
+    df = df.sort_values(["unique_id_l", "unique_id_r"])
+    # /root/reference/tests/test_spark.py:562-563
+    assert list(df["unique_id_l"]) == [1, 1, 2, 2, 3, 3]
+    assert list(df["unique_id_r"]) == [1, 3, 2, 3, 2, 3]
+
+
+def test_link_only_repeat_ids_cartesian():
+    s = dict(_BASE, link_type="link_only", blocking_rules=[])
+    df = Splink(s, df_l=_data_l(), df_r=_data_r())
+    df = df.manually_apply_fellegi_sunter_weights()
+    df = df.sort_values(["unique_id_l", "unique_id_r"])
+    # /root/reference/tests/test_spark.py:585-586
+    assert list(df["unique_id_l"]) == [1, 1, 1, 2, 2, 2, 3, 3, 3]
+    assert list(df["unique_id_r"]) == [1, 2, 3, 1, 2, 3, 1, 2, 3]
+
+
+def test_dedupe_only_repeat_ids():
+    s = dict(_BASE, link_type="dedupe_only")
+    df = Splink(s, df=_data_l())
+    df = df.manually_apply_fellegi_sunter_weights()
+    # /root/reference/tests/test_spark.py:610-611
+    assert list(df["unique_id_l"]) == [2]
+    assert list(df["unique_id_r"]) == [3]
+
+
+def test_tiny_numbers_do_not_underflow():
+    rng = np.random.default_rng(0)
+    n = 60
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "mob": rng.integers(1, 13, n).astype(float),
+            "surname": rng.choice(["Smith", "Jones", "Brown", "Evans"], n),
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "proportion_of_matches": 0.4,
+        "comparison_columns": [
+            {
+                "col_name": "mob",
+                "data_type": "numeric",
+                "num_levels": 2,
+                "m_probabilities": [
+                    5.9380419956766985e-25,
+                    1 - 5.9380419956766985e-25,
+                ],
+                "u_probabilities": [0.8, 0.2],
+            },
+            {"col_name": "surname", "num_levels": 2},
+        ],
+        "blocking_rules": ["l.mob = r.mob", "l.surname = r.surname"],
+        "max_iterations": 0,
+    }
+    linker = Splink(s, df=df)
+    out = linker.manually_apply_fellegi_sunter_weights()
+    p = out["match_probability"].to_numpy()
+    assert np.isfinite(p).all()
+    assert (p >= 0).all() and (p <= 1).all()
+    # pairs disagreeing on mob carry the 5.9e-25 m-prob; the probability is
+    # astronomically small but must be a positive finite number, not 0/NaN
+    # (the reference needed issue #48 for this; log-space scoring is immune)
+    disagree = out[out.gamma_mob == 0]
+    assert len(disagree) and (disagree["match_probability"].to_numpy() > 0).all()

@@ -280,3 +280,45 @@ def test_blocking_failure_reclaims_partial_spill(tmp_path):
     with pytest.raises(KeyError):
         block_using_rules(s, table, None)
     assert [d for d in os.listdir(tmp_path) if d.startswith("splink_pairs_")] == []
+
+
+def test_cartesian_spill_chunks_match_resident(tmp_path, monkeypatch):
+    """Chunked cartesian spill emission must produce exactly the resident
+    cartesian pair set, for every link type, across chunk boundaries."""
+    import splink_tpu.blocking as blocking_mod
+    from splink_tpu.blocking import block_using_rules
+    from splink_tpu.data import encode_table
+    from splink_tpu.settings import complete_settings_dict
+
+    monkeypatch.setattr(blocking_mod, "_CARTESIAN_CHUNK", 7)  # force many chunks
+
+    df = _df(n=20, seed=5)
+    for link_type, kwargs in [
+        ("dedupe_only", {}),
+        ("link_only", {}),
+        ("link_and_dedupe", {}),
+    ]:
+        s = {
+            "link_type": link_type,
+            "comparison_columns": [
+                {"col_name": "first_name", "comparison": {"kind": "exact"}}
+            ],
+            "blocking_rules": [],
+        }
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            s = complete_settings_dict(s)
+        if link_type == "dedupe_only":
+            table = encode_table(df, s)
+            n_left = None
+        else:
+            from splink_tpu.data import concat_tables
+
+            table = concat_tables(df.iloc[:8], df.iloc[8:], s)
+            n_left = 8
+        resident = block_using_rules(dict(s, spill_dir=""), table, n_left)
+        spilled = block_using_rules(dict(s, spill_dir=str(tmp_path)), table, n_left)
+        np.testing.assert_array_equal(np.asarray(spilled.idx_l), resident.idx_l)
+        np.testing.assert_array_equal(np.asarray(spilled.idx_r), resident.idx_r)
