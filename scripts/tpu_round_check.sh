@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# One-pass hardware validation: run this when the TPU tunnel is up to
+# collect every number the round needs. Prints a summary; does not edit
+# any tracked file — copy results into BENCHMARKS.md / README by hand.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== 1/4 tpu smoke tier (tests_tpu/) =="
+python -m pytest tests_tpu/ -q || exit 1
+
+echo "== 2/4 headline bench (bench.py) =="
+python bench.py || exit 1
+
+echo "== 3/4 BASELINE configs 1-3 =="
+for c in 1 2 3; do
+  echo "-- config $c"
+  python benchmarks/run.py --config "$c" || exit 1
+done
+
+echo "== 4/4 BASELINE configs 4-5 (large; streamed regime) =="
+for c in 4 5; do
+  echo "-- config $c"
+  python benchmarks/run.py --config "$c" || exit 1
+done
+
+echo "ALL GREEN"

@@ -543,36 +543,27 @@ class _Evaluator:
 
         self.ctx = ctx
         self.jnp = jnp
+        # batch size, so constant sub-expressions can broadcast
+        self.n = ctx._rows_l.shape[0]
 
     # -- helpers ----------------------------------------------------------
 
-    def _batch_shape(self, *vals):
-        for v in vals:
-            if isinstance(v, _Num):
-                return v.val.shape
-            if isinstance(v, _Str):
-                return v.length.shape
-            if isinstance(v, _Bool):
-                return v.val.shape
-        return None
-
-    def _as_num(self, v, like=None):
+    def _as_num(self, v):
         jnp = self.jnp
         if isinstance(v, _Num):
             return v
         if isinstance(v, _Lit):
+            if v.value is None:
+                return _Num(
+                    jnp.zeros((self.n,), jnp.float32), jnp.ones((self.n,), bool)
+                )
             if not isinstance(v.value, (int, float)) or isinstance(v.value, bool):
                 raise SqlTranslationError(
                     f"Expected a numeric operand, got {v.value!r}"
                 )
-            shape = self._batch_shape(like) if like is not None else None
-            if shape is None:
-                raise SqlTranslationError(
-                    "Cannot type a bare literal without column context"
-                )
             return _Num(
-                jnp.full(shape, float(v.value), jnp.float32),
-                jnp.zeros(shape, bool),
+                jnp.full((self.n,), float(v.value), jnp.float32),
+                jnp.zeros((self.n,), bool),
             )
         raise SqlTranslationError("Expected a numeric operand, got a string")
 
@@ -664,22 +655,14 @@ class _Evaluator:
         _, branches, els = node
         conds, vals = [], []
         for cond, val in branches:
-            c = self.eval(cond)
-            if not isinstance(c, _Bool):
-                raise SqlTranslationError(
-                    "CASE WHEN condition must be boolean"
-                )
-            conds.append(c)
+            conds.append(self._bool(cond))
             vals.append(self.eval(val))
         shape = conds[0].val.shape
 
         def as_branch_num(v):
-            # an explicit THEN NULL / ELSE NULL is the SQL-NULL value
-            if isinstance(v, _Lit) and v.value is None:
-                return _Num(
-                    jnp.zeros(shape, jnp.float32), jnp.ones(shape, bool)
-                )
-            return self._as_num(v, like=conds[0]) if not isinstance(v, _Num) else v
+            # _as_num broadcasts literals and maps THEN NULL / ELSE NULL to
+            # the all-null value
+            return self._as_num(v) if not isinstance(v, _Num) else v
 
         # default: SQL NULL when no branch matches and no ELSE
         if els is None:
@@ -712,13 +695,19 @@ class _Evaluator:
         a = self._bool(node[1])
         return _Bool(~a.val & ~a.null, a.null)
 
+    def _bool_const(self, value) -> "_Bool":
+        jnp = self.jnp
+        return _Bool(
+            jnp.full((self.n,), value is True), jnp.full((self.n,), value is None)
+        )
+
     def _bool(self, node):
         v = self.eval(node)
         if isinstance(v, _Lit):
-            if isinstance(v.value, bool):
-                raise SqlTranslationError(
-                    "Constant TRUE/FALSE must appear inside a comparison"
-                )
+            # constant condition (folded comparison, TRUE/FALSE, or NULL):
+            # broadcast — SQL allows e.g. `WHEN 1 = 1 THEN ...`
+            if v.value is None or isinstance(v.value, bool):
+                return self._bool_const(v.value)
             raise SqlTranslationError(
                 f"Expected a boolean expression, got literal {v.value!r}"
             )
@@ -733,9 +722,8 @@ class _Evaluator:
         _, sub, negate = node
         v = self.eval(sub)
         if isinstance(v, _Lit):
-            raise SqlTranslationError(
-                "IS NULL on a constant is not supported"
-            )
+            null = v.value is None
+            return self._bool_const((not null) if negate else null)
         null = v.null
         out = ~null if negate else null
         return _Bool(out, jnp.zeros(out.shape, bool))
@@ -748,13 +736,23 @@ class _Evaluator:
         if (isinstance(a, _Lit) and a.value is None) or (
             isinstance(b, _Lit) and b.value is None
         ):
-            other = b if isinstance(a, _Lit) and a.value is None else a
-            shape = self._batch_shape(other)
-            if shape is None:
+            return self._bool_const(None)
+        if isinstance(a, _Lit) and isinstance(b, _Lit):
+            # constant comparison: fold to a constant boolean
+            av, bv = a.value, b.value
+            if isinstance(av, str) != isinstance(bv, str):
                 raise SqlTranslationError(
-                    "Comparison between two constants is not supported"
+                    "Cannot compare a string with a number"
                 )
-            return _Bool(jnp.zeros(shape, bool), jnp.ones(shape, bool))
+            fns = {
+                "=": lambda x, y: x == y,
+                "!=": lambda x, y: x != y,
+                "<": lambda x, y: x < y,
+                "<=": lambda x, y: x <= y,
+                ">": lambda x, y: x > y,
+                ">=": lambda x, y: x >= y,
+            }
+            return self._bool_const(fns[op](av, bv))
         # string comparison
         if isinstance(a, _Str) or isinstance(b, _Str):
             if isinstance(a, _Lit):
@@ -783,8 +781,8 @@ class _Evaluator:
             raise SqlTranslationError(
                 "Boolean values can only be compared with TRUE/FALSE"
             )
-        a = self._as_num(a, like=b)
-        b = self._as_num(b, like=a)
+        a = self._as_num(a)
+        b = self._as_num(b)
         fns = {
             "=": lambda x, y: x == y,
             "!=": lambda x, y: x != y,
@@ -801,11 +799,16 @@ class _Evaluator:
         _, op, an, bn = node
         a, b = self.eval(an), self.eval(bn)
         if isinstance(a, _Lit) and isinstance(b, _Lit):
+            # SQL constant folding: NULL operands and x/0 yield NULL
+            if a.value is None or b.value is None:
+                return _Lit(None)
+            if op == "/" and float(b.value) == 0:
+                return _Lit(None)
             fns = {"+": lambda x, y: x + y, "-": lambda x, y: x - y,
                    "*": lambda x, y: x * y, "/": lambda x, y: x / y}
             return _Lit(fns[op](float(a.value), float(b.value)))
-        a = self._as_num(a, like=b)
-        b = self._as_num(b, like=a)
+        a = self._as_num(a)
+        b = self._as_num(b)
         null = a.null | b.null
         if op == "/":
             # SQL (and the reference engine) yield NULL for x/0
@@ -820,7 +823,7 @@ class _Evaluator:
     def _eval_neg(self, node):
         v = self.eval(node[1])
         if isinstance(v, _Lit):
-            return _Lit(-float(v.value))
+            return _Lit(None if v.value is None else -float(v.value))
         v = self._as_num(v)
         return _Num(-v.val, v.null)
 
@@ -974,13 +977,8 @@ class _Evaluator:
         if len(args) < 2:
             raise SqlTranslationError(f"{fname} takes at least 2 arguments")
         vals = [self.eval(a) for a in args]
-        anchor = next((v for v in vals if isinstance(v, _Num)), None)
-        if anchor is None:
-            raise SqlTranslationError(
-                f"{fname} needs at least one column-typed argument"
-            )
         jnp = self.jnp
-        nums = [self._as_num(v, like=anchor) for v in vals]
+        nums = [self._as_num(v) for v in vals]
         # SQL least/greatest skip nulls: result is null only when ALL
         # arguments are null.
         out = nums[0].val
@@ -1028,12 +1026,13 @@ class _Evaluator:
         vals = [self.eval(a) for a in args]
         anchor = next((v for v in vals if not isinstance(v, _Lit)), None)
         if anchor is None:
-            raise SqlTranslationError(
-                f"{fname} needs at least one column-typed argument"
+            # all-constant coalesce folds to its first non-NULL value
+            return _Lit(
+                next((v.value for v in vals if v.value is not None), None)
             )
         if isinstance(anchor, _Num):
             nums = [
-                self._as_num(v, like=anchor)
+                self._as_num(v)
                 if not (isinstance(v, _Lit) and v.value is None)
                 else _Num(
                     jnp.zeros(anchor.val.shape, jnp.float32),

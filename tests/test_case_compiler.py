@@ -529,3 +529,22 @@ def test_non_integer_then_value_rejected():
         compile_case_expression(
             "case when name_l = name_r then 1.5 else 0 end", num_levels=2
         )
+
+
+def test_constant_null_arithmetic_and_division():
+    # SQL constant folding: NULL + 1 is NULL, 1/0 is NULL — conditions using
+    # them are unknown and fall through; no raw TypeError/ZeroDivisionError
+    df = pd.DataFrame({"unique_id": range(3), "n": [1.0, 2.0, 3.0]})
+    for cond in ["n_l > null + 1", "n_l > 1/0", "n_l > -(null)"]:
+        prog, _ = _program(
+            [
+                {
+                    "col_name": "n",
+                    "num_levels": 2,
+                    "case_expression": f"case when {cond} then 1 else 0 end",
+                }
+            ],
+            df,
+        )
+        G = prog.compute(*_pairs_vs_first(df))
+        assert G[:, 0].tolist() == [0, 0], cond

@@ -1,0 +1,313 @@
+"""Property test: random hand-written CASE expressions vs a pure-Python
+three-valued-logic oracle.
+
+The generator builds (sql_text, oracle_fn) pairs compositionally, so the
+oracle's semantics are written independently of the compiler's evaluator:
+SQL NULL is Python None, comparisons/boolean ops follow Kleene logic,
+x/0 is NULL, least/greatest skip NULLs, a CASE with no matching branch and
+no ELSE is NULL, and a NULL gamma outcome is level -1.
+
+Functions with nontrivial numeric kernels (jaro_winkler etc.) are exercised
+by the deterministic tests in test_case_compiler.py; here we cover the
+expression algebra, which is where subtle null-semantics bugs live.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu.data import encode_table
+from splink_tpu.gammas import GammaProgram
+from splink_tpu.settings import complete_settings_dict
+
+NUM_LEVELS = 4
+
+
+class Gen:
+    """Random (sql_text, oracle) generator over a fixed column schema."""
+
+    STR_COLS = ["s1", "s2"]
+    NUM_COLS = ["n1", "n2"]
+
+    def __init__(self, rng):
+        self.rng = rng
+
+    def pick(self, options):
+        weights = np.array([w for w, _ in options], float)
+        k = self.rng.choice(len(options), p=weights / weights.sum())
+        return options[k][1]()
+
+    # ---- numeric-valued expressions: (sql, fn(l, r) -> float | None) ----
+
+    def num_expr(self, depth):
+        opts = [
+            (3, self.num_col),
+            (2, self.num_literal),
+        ]
+        if depth > 0:
+            opts += [
+                (2, lambda: self.num_arith(depth)),
+                (1, lambda: self.num_abs(depth)),
+                (1, lambda: self.num_minmax(depth)),
+                (1, lambda: self.num_length()),
+            ]
+        return self.pick(opts)
+
+    def num_col(self):
+        col = self.rng.choice(self.NUM_COLS)
+        side = self.rng.choice(["l", "r"])
+        return f"{col}_{side}", lambda l, r: (l if side == "l" else r)[col]
+
+    def num_literal(self):
+        v = round(float(self.rng.uniform(-5, 5)), 2)
+        # negative literals exercise unary minus
+        return repr(v), lambda l, r: v
+
+    def num_arith(self, depth):
+        (sa, fa), (sb, fb) = self.num_expr(depth - 1), self.num_expr(depth - 1)
+        op = self.rng.choice(["+", "-", "*", "/"])
+
+        def fn(l, r):
+            a, b = fa(l, r), fb(l, r)
+            if a is None or b is None:
+                return None
+            if op == "/":
+                return None if b == 0 else a / b
+            return {"+": a + b, "-": a - b, "*": a * b}[op]
+
+        return f"({sa} {op} {sb})", fn
+
+    def num_abs(self, depth):
+        s, f = self.num_expr(depth - 1)
+        return f"abs({s})", lambda l, r: (
+            None if f(l, r) is None else abs(f(l, r))
+        )
+
+    def num_minmax(self, depth):
+        (sa, fa), (sb, fb) = self.num_expr(depth - 1), self.num_expr(depth - 1)
+        name = self.rng.choice(["least", "greatest"])
+        red = min if name == "least" else max
+
+        def fn(l, r):
+            vals = [v for v in (fa(l, r), fb(l, r)) if v is not None]
+            return red(vals) if vals else None
+
+        return f"{name}({sa}, {sb})", fn
+
+    def num_length(self):
+        s, f = self.str_expr(0)
+        return f"length({s})", lambda l, r: (
+            None if f(l, r) is None else float(len(f(l, r)))
+        )
+
+    # ---- string-valued expressions ----
+
+    def str_expr(self, depth):
+        opts = [(3, self.str_col), (1, self.str_literal)]
+        if depth > 0:
+            opts += [
+                (1, lambda: self.str_case_shift(depth)),
+                (1, lambda: self.str_ifnull(depth)),
+            ]
+        return self.pick(opts)
+
+    def str_col(self):
+        col = self.rng.choice(self.STR_COLS)
+        side = self.rng.choice(["l", "r"])
+        return f"{col}_{side}", lambda l, r: (l if side == "l" else r)[col]
+
+    def str_literal(self):
+        v = self.rng.choice(["ann", "Bob", "", "new  york", "x'y"])
+        sql = "'" + v.replace("'", "''") + "'"
+        return sql, lambda l, r: v
+
+    def str_case_shift(self, depth):
+        s, f = self.str_expr(depth - 1)
+        name = self.rng.choice(["lower", "upper"])
+        py = str.lower if name == "lower" else str.upper
+        return f"{name}({s})", lambda l, r: (
+            None if f(l, r) is None else py(f(l, r))
+        )
+
+    def str_ifnull(self, depth):
+        (sa, fa), (sb, fb) = self.str_expr(depth - 1), self.str_expr(depth - 1)
+
+        def fn(l, r):
+            a = fa(l, r)
+            return fb(l, r) if a is None else a
+
+        return f"ifnull({sa}, {sb})", fn
+
+    # ---- boolean expressions: fn -> True | False | None (unknown) ----
+
+    def bool_expr(self, depth):
+        opts = [
+            (3, lambda: self.cmp_num(depth)),
+            (2, lambda: self.cmp_str(depth)),
+            (2, self.isnull),
+        ]
+        if depth > 0:
+            opts += [
+                (2, lambda: self.bool_binop(depth)),
+                (1, lambda: self.bool_not(depth)),
+            ]
+        return self.pick(opts)
+
+    def cmp_num(self, depth):
+        (sa, fa), (sb, fb) = self.num_expr(depth), self.num_expr(depth)
+        op = self.rng.choice(["<", "<=", ">", ">=", "=", "!="])
+        py = {
+            "<": lambda a, b: a < b,
+            "<=": lambda a, b: a <= b,
+            ">": lambda a, b: a > b,
+            ">=": lambda a, b: a >= b,
+            "=": lambda a, b: a == b,
+            "!=": lambda a, b: a != b,
+        }[op]
+
+        def fn(l, r):
+            a, b = fa(l, r), fb(l, r)
+            if a is None or b is None:
+                return None
+            return py(a, b)
+
+        return f"{sa} {op} {sb}", fn
+
+    def cmp_str(self, depth):
+        (sa, fa), (sb, fb) = self.str_expr(depth), self.str_expr(depth)
+        op = self.rng.choice(["=", "!="])
+
+        def fn(l, r):
+            a, b = fa(l, r), fb(l, r)
+            if a is None or b is None:
+                return None
+            return (a == b) if op == "=" else (a != b)
+
+        return f"{sa} {op} {sb}", fn
+
+    def isnull(self):
+        if self.rng.random() < 0.5:
+            s, f = self.str_col()
+        else:
+            s, f = self.num_col()
+        negate = self.rng.random() < 0.5
+        kw = "is not null" if negate else "is null"
+
+        def fn(l, r):
+            null = f(l, r) is None
+            return (not null) if negate else null
+
+        return f"{s} {kw}", fn
+
+    def bool_binop(self, depth):
+        (sa, fa), (sb, fb) = (
+            self.bool_expr(depth - 1),
+            self.bool_expr(depth - 1),
+        )
+        is_and = self.rng.random() < 0.5
+
+        def fn(l, r):
+            a, b = fa(l, r), fb(l, r)
+            if is_and:
+                if a is False or b is False:
+                    return False
+                if a is None or b is None:
+                    return None
+                return True
+            if a is True or b is True:
+                return True
+            if a is None or b is None:
+                return None
+            return False
+
+        word = "and" if is_and else "or"
+        return f"({sa} {word} {sb})", fn
+
+    def bool_not(self, depth):
+        s, f = self.bool_expr(depth - 1)
+        return f"not ({s})", lambda l, r: (
+            None if f(l, r) is None else not f(l, r)
+        )
+
+    # ---- CASE ----
+
+    def case_expr(self, n_branches):
+        branches = [
+            (self.bool_expr(2), int(self.rng.integers(0, NUM_LEVELS)))
+            for _ in range(n_branches)
+        ]
+        has_else = self.rng.random() < 0.7
+        else_level = int(self.rng.integers(0, NUM_LEVELS)) if has_else else None
+        parts = ["case"]
+        for (sql, _), level in branches:
+            parts.append(f"when {sql} then {level}")
+        if has_else:
+            parts.append(f"else {else_level}")
+        parts.append("end")
+
+        def fn(l, r):
+            for (_, cond), level in branches:
+                if cond(l, r) is True:
+                    return level
+            return else_level if has_else else None
+
+        return " ".join(parts), fn
+
+
+def _rows(rng, n):
+    strs = ["ann", "Bob", "new  york", "", "zz", None, "x'y"]
+    nums = [0.0, 1.0, -2.5, 3.75, None]
+    return [
+        {
+            "s1": strs[rng.integers(len(strs))],
+            "s2": strs[rng.integers(len(strs))],
+            "n1": nums[rng.integers(len(nums))],
+            "n2": nums[rng.integers(len(nums))],
+        }
+        for _ in range(n)
+    ]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_case_expressions_match_oracle(seed):
+    rng = np.random.default_rng(seed)
+    gen = Gen(rng)
+    rows = _rows(rng, 24)
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(len(rows)),
+            **{
+                k: [row[k] for row in rows]
+                for k in ("s1", "s2", "n1", "n2")
+            },
+        }
+    )
+    idx_l = rng.integers(0, len(rows), 40)
+    idx_r = rng.integers(0, len(rows), 40)
+
+    for _ in range(6):
+        sql, oracle = gen.case_expr(int(rng.integers(1, 4)))
+        s = complete_settings_dict(
+            {
+                "link_type": "dedupe_only",
+                "comparison_columns": [
+                    {
+                        "custom_name": "prop",
+                        "custom_columns_used": ["s1", "s2", "n1", "n2"],
+                        "num_levels": NUM_LEVELS,
+                        "case_expression": sql,
+                    }
+                ],
+                "blocking_rules": ["l.unique_id = r.unique_id"],
+            }
+        )
+        table = encode_table(df, s)
+        prog = GammaProgram(s, table)
+        G = prog.compute(idx_l.astype(np.int64), idx_r.astype(np.int64))
+        expected = [
+            -1
+            if (lv := oracle(rows[a], rows[b])) is None
+            else lv
+            for a, b in zip(idx_l, idx_r)
+        ]
+        assert G[:, 0].tolist() == expected, f"mismatch for: {sql}"
