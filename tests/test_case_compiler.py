@@ -548,3 +548,21 @@ def test_constant_null_arithmetic_and_division():
         )
         G = prog.compute(*_pairs_vs_first(df))
         assert G[:, 0].tolist() == [0, 0], cond
+
+
+def test_parser_never_crashes_on_token_soup():
+    """Random token soup must produce SqlTranslationError (or parse), never
+    IndexError/TypeError/etc — settings errors should always be readable."""
+    import random
+
+    rng = random.Random(0)
+    toks = ["case", "when", "then", "else", "end", "and", "or", "not", "is",
+            "null", "(", ")", ",", "=", "<", ">", "<=", ">=", "<>", "+", "-",
+            "*", "/", "'abc'", "'", "1.5", "name_l", "name_r", "abs", "x",
+            "_l", "jaro_winkler_sim", "ifnull", ";", "@", "1e999"]
+    for _ in range(500):
+        s = " ".join(rng.choice(toks) for _ in range(rng.randint(1, 15)))
+        try:
+            parse_sql_expression(s)
+        except SqlTranslationError:
+            pass
