@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import os
+import warnings
 from typing import Callable
 
 import jax.numpy as jnp
@@ -69,6 +70,7 @@ class Splink:
                 "compute path with jax/XLA only."
             )
         logger.debug("execution backend: %s", backend)
+        self._float_dtype_cache = None
         self.params = Params(self.settings, complete=False)
         self.df = df
         self.df_l = df_l
@@ -107,6 +109,38 @@ class Splink:
     # ------------------------------------------------------------------
     # Pipeline stages
     # ------------------------------------------------------------------
+
+    @property
+    def _float_dtype(self):
+        """Resolved compute dtype for EM/scoring, honouring ``float64``.
+
+        Resolved lazily (first compute) because checking the backend
+        initialises it. float64 on a non-TPU backend enables jax x64 mode —
+        a PROCESS-WIDE, irreversible switch (jax has no per-computation
+        dtype mode); without it jax silently downcasts every float64 array
+        to float32 and the setting would be a no-op. TPU has no float64, so
+        there the setting warns and falls back to float32 as documented in
+        the settings schema.
+        """
+        if self._float_dtype_cache is None:
+            self._float_dtype_cache = np.float32
+            if self.settings["float64"]:
+                import jax
+
+                if jax.default_backend() == "tpu":
+                    warnings.warn(
+                        "float64 requested but the TPU backend has no "
+                        "float64 support; running in float32"
+                    )
+                else:
+                    if not jax.config.jax_enable_x64:
+                        jax.config.update("jax_enable_x64", True)
+                        logger.info(
+                            "float64 requested: enabled jax x64 mode "
+                            "(process-wide)"
+                        )
+                    self._float_dtype_cache = np.float64
+        return self._float_dtype_cache
 
     @property
     def _n_left(self) -> int | None:
@@ -230,7 +264,7 @@ class Splink:
         batched scoring path, which bounds HBM at any pattern count."""
         _, _, program = self._ensure_pattern_ids()
         PM = program.patterns_matrix()
-        dtype = np.float64 if self.settings["float64"] else np.float32
+        dtype = self._float_dtype
         lam, m, u, _ = self.params.to_arrays(dtype=dtype)
         params_dev = FSParams(
             lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)
@@ -315,7 +349,7 @@ class Splink:
 
     def _run_em_resident(self, G: np.ndarray, compute_ll: bool) -> None:
         """Fused on-device EM with the gamma matrix resident in HBM."""
-        dtype = np.float64 if self.settings["float64"] else np.float32
+        dtype = self._float_dtype
         mesh = mesh_from_settings(self.settings)
         weights = None
         if mesh is not None:
@@ -330,7 +364,7 @@ class Splink:
         update at a time when a save_state_fn checkpoint hook must run
         between iterations (the restart semantics of
         /root/reference/splink/iterate.py:54-55)."""
-        dtype = np.float64 if self.settings["float64"] else np.float32
+        dtype = self._float_dtype
         lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
         init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
         max_iterations = int(self.settings["max_iterations"])
@@ -374,7 +408,7 @@ class Splink:
         self, G_pat: np.ndarray, weights: np.ndarray, compute_ll: bool
     ) -> None:
         """Fused EM on a weighted pattern matrix (counts as weights)."""
-        dtype = np.float64 if self.settings["float64"] else np.float32
+        dtype = self._float_dtype
         self._run_em_fused(
             jnp.asarray(G_pat), jnp.asarray(weights.astype(dtype)), compute_ll
         )
@@ -393,7 +427,7 @@ class Splink:
         from .parallel.distributed import global_pair_slice
         from .parallel.streaming import run_em_streamed
 
-        dtype = np.float64 if self.settings["float64"] else np.float32
+        dtype = self._float_dtype
         lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
         init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
         batch = int(self.settings["pair_batch_size"])
@@ -554,12 +588,13 @@ class Splink:
         batch = min(int(self.settings["pair_batch_size"]), max(n, 1))
         n_cols = G.shape[1] if G.ndim == 2 else 0
         want_inter = bool(self.settings["retain_intermediate_calculation_columns"])
+        out_dtype = self._float_dtype
         # Device copy is reusable only when scoring the exact same full matrix
         src_dev = self._G_dev if self._G_dev is not None and G is self._G else None
-        p = np.empty(n, np.float32)
+        p = np.empty(n, out_dtype)
         if want_inter:
-            prob_m = np.empty((n, n_cols), np.float32)
-            prob_u = np.empty((n, n_cols), np.float32)
+            prob_m = np.empty((n, n_cols), out_dtype)
+            prob_u = np.empty((n, n_cols), out_dtype)
         else:
             prob_m = prob_u = None
         pending = None  # (start, stop, device results)
@@ -600,7 +635,7 @@ class Splink:
         if rows is not None:
             G, il, ir = G[rows], il[rows], ir[rows]
 
-        dtype = np.float64 if self.settings["float64"] else np.float32
+        dtype = self._float_dtype
         lam, m, u, _ = self.params.to_arrays(dtype=dtype)
         params_dev = FSParams(
             lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)

@@ -236,3 +236,48 @@ def test_release_input_link_only_keeps_n_left():
         sa[cols].sort_values(cols[:2]).reset_index(drop=True),
         sb[cols].sort_values(cols[:2]).reset_index(drop=True),
     )
+
+
+def test_float64_setting_enables_x64_in_fresh_process():
+    """Outside the test suite (whose conftest enables x64 globally),
+    settings float64=True must itself enable jax x64 mode — otherwise jax
+    silently downcasts every float64 array to float32 and the setting is a
+    no-op."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import pandas as pd\n"
+        "from splink_tpu import Splink\n"
+        "df = pd.DataFrame({'unique_id': [0, 1, 2], 'a': ['x', 'x', 'y']})\n"
+        "s = {'link_type': 'dedupe_only',\n"
+        "     'comparison_columns': [{'col_name': 'a',\n"
+        "                             'comparison': {'kind': 'exact'}}],\n"
+        "     'blocking_rules': ['l.a = r.a'], 'float64': True,\n"
+        "     'max_iterations': 2}\n"
+        "l = Splink(s, df=df)\n"
+        "out = l.get_scored_comparisons()\n"
+        "assert jax.config.jax_enable_x64, 'x64 not enabled'\n"
+        "assert out.match_probability.dtype == 'float64', out.match_probability.dtype\n"
+        "print('OK')\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Strip this host's tunnelled-TPU sitecustomize dir ("axon_site"): it
+    # pre-imports jax against a remote accelerator at interpreter startup,
+    # which can hang the subprocess when the tunnel is down (see
+    # tests/conftest.py on the pre-imported-jax environment). Dead code on
+    # machines without it.
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+           if p and "axon_site" not in p]
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert res.returncode == 0 and "OK" in res.stdout, res.stdout + res.stderr
