@@ -123,10 +123,13 @@ class Splink:
         the settings schema.
         """
         if self._float_dtype_cache is None:
-            self._float_dtype_cache = np.float32
+            resolved = np.float32
             if self.settings["float64"]:
                 import jax
 
+                # resolve fully before caching: an exception here (flaky
+                # backend init, interrupt) must not poison the cache with
+                # the float32 fallback
                 if jax.default_backend() == "tpu":
                     warnings.warn(
                         "float64 requested but the TPU backend has no "
@@ -139,7 +142,8 @@ class Splink:
                             "float64 requested: enabled jax x64 mode "
                             "(process-wide)"
                         )
-                    self._float_dtype_cache = np.float64
+                    resolved = np.float64
+            self._float_dtype_cache = resolved
         return self._float_dtype_cache
 
     @property
@@ -207,7 +211,9 @@ class Splink:
                 and mesh_from_settings(self.settings) is None
             )
             with StageTimer("gammas"):
-                program = GammaProgram(self.settings, table)
+                program = GammaProgram(
+                    self.settings, table, float_dtype=self._float_dtype
+                )
                 self._G, self._G_dev = program.compute_with_device(
                     pairs.idx_l,
                     pairs.idx_r,
@@ -248,7 +254,9 @@ class Splink:
             table = self._ensure_encoded()
             pairs = self._ensure_pairs()
             with StageTimer("gammas_patterns"):
-                self._pattern_program = GammaProgram(self.settings, table)
+                self._pattern_program = GammaProgram(
+                    self.settings, table, float_dtype=self._float_dtype
+                )
                 self._P, self._pattern_counts = (
                     self._pattern_program.compute_pattern_ids(
                         pairs.idx_l,
