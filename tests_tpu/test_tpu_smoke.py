@@ -158,3 +158,35 @@ class TestCaseCompilerOnHardware:
             np.zeros(5, np.int64), np.arange(1, 6, dtype=np.int64)
         )
         assert G[:, 0].tolist() == [2, 1, 0, 0, -1]
+
+
+class TestFloat64FallbackOnHardware:
+    def test_float64_setting_warns_and_runs_f32_on_tpu(self):
+        """TPU has no float64: the setting must warn and fall back to
+        float32 rather than enabling x64 and failing to lower."""
+        import warnings
+
+        import splink_tpu
+
+        df = pd.DataFrame(
+            {
+                "unique_id": range(40),
+                "name": [f"n{i % 7}" for i in range(40)],
+                "city": ["a", "b"] * 20,
+            }
+        )
+        settings = {
+            "link_type": "dedupe_only",
+            "blocking_rules": ["l.city = r.city"],
+            "comparison_columns": [
+                {"col_name": "name", "comparison": {"kind": "exact"}}
+            ],
+            "float64": True,
+            "max_iterations": 3,
+        }
+        linker = splink_tpu.Splink(settings, df=df)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            out = linker.get_scored_comparisons()
+        assert out.match_probability.dtype == np.float32
+        assert any("float64" in str(w.message) for w in caught)
