@@ -6,9 +6,10 @@ test:
 
 # Hardware smoke tier: real TPU lowering of Pallas kernels + pipeline.
 # Separate invocation because tests/conftest.py pins its process to CPU.
-# Skips cleanly when no TPU backend is present.
+# Skips cleanly when no TPU backend is present; exits 5 (nothing collected)
+# when the accelerator backend is unreachable — treated as a skip.
 tpu-smoke:
-	python -m pytest tests_tpu/ -q
+	python -m pytest tests_tpu/ -q || [ $$? -eq 5 ]
 
 bench:
 	python bench.py

@@ -1,0 +1,59 @@
+"""Shared accelerator-init probe (bench.py and tests_tpu/conftest.py).
+
+A dead accelerator tunnel can make ``import jax`` / device init block
+FOREVER inside a C-level call where no Python signal fires. Probing in a
+subprocess is the only reliable guard: a subprocess can always be killed
+(as a group — helpers a plugin forks must die too).
+
+Lives at the repo root, NOT inside splink_tpu: importing anything under the
+package would itself import jax and hang under the exact condition being
+probed.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+DEFAULT_TIMEOUT_S = 600
+
+
+def probe_device_init(timeout_s: int | None = None) -> tuple[bool, str]:
+    """-> (ok, detail). ok=True when ``import jax; jax.devices()`` completes
+    in a fresh subprocess. detail distinguishes a timeout (tunnel hang) from
+    a fast failure (broken install — stderr tail included)."""
+    if timeout_s is None:
+        timeout_s = int(
+            os.environ.get("SPLINK_TPU_BENCH_INIT_TIMEOUT", DEFAULT_TIMEOUT_S)
+        )
+    # stderr goes to a FILE, not a pipe: helper processes that survive a
+    # timeout kill would hold a pipe's write end open forever; a file has no
+    # reader to block.
+    with tempfile.TemporaryFile() as errf:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=errf,
+            start_new_session=True,
+        )
+        try:
+            rc = proc.wait(timeout=timeout_s)
+            errf.seek(0)
+            tail = errf.read().decode(errors="replace")[-300:].strip()
+            if rc == 0:
+                return True, ""
+            return False, f"device init failed (rc={rc}): {tail}"
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)  # child + any helpers
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+            return False, (
+                f"device init did not respond within {timeout_s}s "
+                "(accelerator tunnel down?)"
+            )

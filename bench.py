@@ -25,39 +25,13 @@ TARGET_PAIRS_PER_SEC_PER_CHIP = 50e6 / 8  # north star: 50M/s on a v5e-8
 
 # A dead accelerator tunnel can make `import jax` / device init block FOREVER
 # inside a C-level call (no Python signal delivery), which reads as a stalled
-# benchmark. Probe device init in a subprocess first — a subprocess timeout
-# kills reliably — and fail fast and loud if it never comes up.
-DEVICE_INIT_TIMEOUT_S = int(os.environ.get("SPLINK_TPU_BENCH_INIT_TIMEOUT", 600))
+# benchmark. Probe device init in a killable subprocess first and fail fast
+# and loud if it never comes up (shared helper, also used by the smoke tier).
+from _device_probe import probe_device_init
 
 
 def _probe_device_init():
-    import tempfile
-
-    # stderr goes to a FILE, not a pipe: if the probe child forks helpers
-    # that outlive a timeout kill, inherited pipe write-ends would block the
-    # parent's read forever; a file has no reader to block. The child runs in
-    # its own session so the whole process group can be killed.
-    with tempfile.TemporaryFile() as errf:
-        proc = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            stdout=subprocess.DEVNULL,
-            stderr=errf,
-            start_new_session=True,
-        )
-        try:
-            ok = proc.wait(timeout=DEVICE_INIT_TIMEOUT_S) == 0
-            errf.seek(0)
-            detail = errf.read().decode(errors="replace")[-300:]
-        except subprocess.TimeoutExpired:
-            import signal
-
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)  # child + any helpers
-            except (ProcessLookupError, PermissionError):
-                proc.kill()
-            proc.wait()
-            ok = False
-            detail = f"no response within {DEVICE_INIT_TIMEOUT_S}s"
+    ok, detail = probe_device_init()
     if not ok:
         print(
             json.dumps(
@@ -66,8 +40,7 @@ def _probe_device_init():
                     "value": 0,
                     "unit": "pairs/sec",
                     "vs_baseline": 0.0,
-                    "error": "device init failed (accelerator tunnel down?): "
-                    + detail.strip(),
+                    "error": detail,
                 }
             ),
             flush=True,

@@ -11,14 +11,47 @@ It must be a separate pytest invocation from tests/ — the unit tier's
 conftest pins the process to CPU before jax initialises.
 """
 
-import jax
+import os
+import sys
+
 import numpy as np
 import pytest
 
-from splink_tpu.ops.strings_pallas import TPU_BACKENDS
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _device_probe import probe_device_init  # noqa: E402
+
+if os.environ.get("SPLINK_TPU_SKIP_BACKEND_PROBE") == "1":
+    _BACKEND_OK, _PROBE_DETAIL = True, ""
+else:
+    # Probe in a killable subprocess BEFORE any jax import: a dead
+    # accelerator tunnel blocks `import jax` inside C code where pytest can
+    # neither time out nor interrupt. When the probe fails, test modules
+    # must not even be COLLECTED — their own top-level jax imports would
+    # hang the session (pytest_ignore_collect below).
+    _BACKEND_OK, _PROBE_DETAIL = probe_device_init()
+    if not _BACKEND_OK:
+        sys.stderr.write(
+            f"tests_tpu: skipping collection — {_PROBE_DETAIL}\n"
+            "(note: pytest exits 5 when nothing is collected; "
+            "`make tpu-smoke` treats that as a skip)\n"
+        )
+
+if _BACKEND_OK:
+    import jax
+
+    from splink_tpu.ops.strings_pallas import TPU_BACKENDS
+
+
+def pytest_ignore_collect(collection_path, config):
+    # an unreachable backend means no test module is safe to import
+    if not _BACKEND_OK:
+        return True
+    return None
 
 
 def pytest_collection_modifyitems(config, items):
+    if not _BACKEND_OK:
+        return
     if jax.default_backend() not in TPU_BACKENDS:
         skip = pytest.mark.skip(reason="no TPU backend present")
         for item in items:
