@@ -26,7 +26,12 @@ def probe_device_init(timeout_s: int | None = None) -> tuple[bool, str]:
     a fast failure (broken install — stderr tail included)."""
     if timeout_s is None:
         timeout_s = int(
-            os.environ.get("SPLINK_TPU_BENCH_INIT_TIMEOUT", DEFAULT_TIMEOUT_S)
+            os.environ.get(
+                "SPLINK_TPU_INIT_TIMEOUT",
+                os.environ.get(
+                    "SPLINK_TPU_BENCH_INIT_TIMEOUT", DEFAULT_TIMEOUT_S
+                ),
+            )
         )
     # stderr goes to a FILE, not a pipe: helper processes that survive a
     # timeout kill would hold a pipe's write end open forever; a file has no

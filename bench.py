@@ -14,8 +14,6 @@ publishes no numbers — BASELINE.md: "None exist").
 """
 
 import json
-import os
-import subprocess
 import sys
 import time
 

@@ -208,11 +208,19 @@ def make_adjustment_for_term_frequencies(
     present the per-token aggregation runs on device instead of a host
     groupby.
     """
-    tf_cols = [
-        c["col_name"]
-        for c in settings["comparison_columns"]
-        if c.get("term_frequency_adjustments")
-    ]
+    tf_cols = []
+    for c in settings["comparison_columns"]:
+        if not c.get("term_frequency_adjustments"):
+            continue
+        if "col_name" in c:
+            tf_cols.append(c["col_name"])
+        else:
+            # a custom (multi-column) comparison has no single token column
+            # to aggregate by — same limitation as the reference
+            warnings.warn(
+                "term_frequency_adjustments is not supported for custom "
+                f"comparison {c.get('custom_name')!r}; skipping"
+            )
     if not tf_cols:
         warnings.warn(
             "No term frequency adjustment columns are specified in your "

@@ -227,3 +227,56 @@ def test_device_path_chunked_matches_single_chunk(monkeypatch):
     np.testing.assert_allclose(adj_many, adj_one, rtol=1e-12)
     np.testing.assert_allclose(lam_many, lam_one, rtol=1e-12)
     np.testing.assert_allclose(cnt_many, cnt_one, rtol=0)
+
+
+def test_tf_with_case_sql_column_and_custom_skip():
+    """TF adjustment works on a col_name column whose comparison is a
+    compiled CASE expression; a custom multi-column comparison with the TF
+    flag warns and is skipped instead of KeyError-ing."""
+    import warnings
+
+    import numpy as np
+    import pandas as pd
+
+    from splink_tpu import Splink
+
+    rng = np.random.default_rng(0)
+    n = 120
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["ann", "bob", "cat", "dan", "eve"], n),
+            "city": rng.choice(["x", "y"], n),
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "blocking_rules": ["l.city = r.city"],
+        "comparison_columns": [
+            {
+                "col_name": "name",
+                "num_levels": 2,
+                "term_frequency_adjustments": True,
+                "case_expression": "case when name_l is null or name_r is "
+                "null then -1 when lower(name_l) = lower(name_r) then 1 "
+                "else 0 end",
+            },
+            {
+                "custom_name": "combo",
+                "custom_columns_used": ["name", "city"],
+                "num_levels": 2,
+                "term_frequency_adjustments": True,
+                "case_expression": "case when name_l = name_r and "
+                "city_l = city_r then 1 else 0 end",
+            },
+        ],
+        "max_iterations": 4,
+    }
+    linker = Splink(s, df=df)
+    df_e = linker.get_scored_comparisons()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = linker.make_term_frequency_adjustments(df_e)
+    assert "tf_adjusted_match_prob" in out.columns
+    assert np.isfinite(out.tf_adjusted_match_prob.to_numpy()).all()
+    assert any("combo" in str(w.message) for w in caught)
