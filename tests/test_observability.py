@@ -118,3 +118,46 @@ def test_get_largest_blocks():
 
     with pytest.raises(ValueError):
         get_largest_blocks("something invalid", df)
+
+
+def test_intuition_report_with_case_sql_column():
+    """The per-row intuition narrative and waterfall work when a comparison
+    is a compiled hand-written CASE expression (kind case_sql)."""
+    import numpy as np
+    import pandas as pd
+
+    from splink_tpu import Splink
+    from splink_tpu.intuition import intuition_report
+
+    rng = np.random.default_rng(2)
+    n = 120
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["ann", "bob", "cat", "dan"], n),
+            "city": rng.choice(["x", "y"], n),
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "blocking_rules": ["l.city = r.city"],
+        "comparison_columns": [
+            {
+                "col_name": "name",
+                "num_levels": 3,
+                "case_expression": """case
+                    when name_l is null or name_r is null then -1
+                    when name_l = name_r then 2
+                    when jaro_winkler_sim(name_l, name_r) > 0.7 then 1
+                    else 0 end""",
+            }
+        ],
+        "retain_intermediate_calculation_columns": True,
+        "max_iterations": 4,
+    }
+    linker = Splink(s, df=df)
+    df_e = linker.get_scored_comparisons()
+    row = df_e.iloc[0]
+    report = intuition_report(row, linker.params)
+    assert "Initial probability of match" in report
+    assert "gamma_name" in report
