@@ -566,3 +566,24 @@ def test_parser_never_crashes_on_token_soup():
             parse_sql_expression(s)
         except SqlTranslationError:
             pass
+
+
+def test_unicode_literal_and_wide_column():
+    """Non-ASCII columns encode as wide (uint32 codepoints); CASE literals
+    with non-ASCII characters must compare correctly against them."""
+    df = pd.DataFrame(
+        {
+            "unique_id": range(4),
+            "city": ["münchen", "münchen", "munchen", "köln"],
+        }
+    )
+    expr = """case
+        when city_l = 'münchen' and city_r = 'münchen' then 2
+        when city_l = city_r then 1
+        else 0 end"""
+    prog, _ = _program(
+        [{"col_name": "city", "num_levels": 3, "case_expression": expr}], df
+    )
+    G = prog.compute(*_pairs_vs_first(df))
+    # münchen/münchen -> 2; munchen differs (ü != u) -> 0; köln -> 0
+    assert G[:, 0].tolist() == [2, 0, 0]
