@@ -322,3 +322,35 @@ def test_cartesian_spill_chunks_match_resident(tmp_path, monkeypatch):
         spilled = block_using_rules(dict(s, spill_dir=str(tmp_path)), table, n_left)
         np.testing.assert_array_equal(np.asarray(spilled.idx_l), resident.idx_l)
         np.testing.assert_array_equal(np.asarray(spilled.idx_r), resident.idx_r)
+
+
+def test_link_only_spill_release_combination(tmp_path):
+    """link_only with released inputs and a spilled pair index scores like
+    the plain path (n_left survives release; spill streams the cross-join)."""
+    df = _df(n=400, seed=11)
+    df_l, df_r = df.iloc[:150].copy(), df.iloc[150:].copy()
+    base = {
+        "link_type": "link_only",
+        "blocking_rules": ["l.city = r.city"],
+        "comparison_columns": [
+            {"col_name": "first_name", "comparison": {"kind": "exact"}},
+            {"col_name": "surname", "comparison": {"kind": "exact"}},
+        ],
+        "max_iterations": 4,
+        "float64": True,
+    }
+    plain = Splink(base, df_l=df_l, df_r=df_r).get_scored_comparisons()
+
+    s = dict(base, spill_dir=str(tmp_path), max_resident_pairs=1024)
+    linker = Splink(s, df_l=df_l, df_r=df_r)
+    linker.release_input()
+    chunks = list(linker.stream_scored_comparisons())
+    assert isinstance(linker._ensure_pairs().idx_l, np.memmap)
+    streamed = pd.concat(chunks, ignore_index=True)
+    m = plain.merge(
+        streamed, on=["unique_id_l", "unique_id_r"], suffixes=("_a", "_b")
+    )
+    assert len(m) == len(plain) == len(streamed)
+    np.testing.assert_allclose(
+        m.match_probability_a, m.match_probability_b, rtol=1e-9
+    )
