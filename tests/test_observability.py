@@ -161,3 +161,37 @@ def test_intuition_report_with_case_sql_column():
     report = intuition_report(row, linker.params)
     assert "Initial probability of match" in report
     assert "gamma_name" in report
+
+
+def test_stage_timings_recorded_through_pipeline():
+    """StageTimer records encode/blocking/gammas/em wall times during a
+    linker run — the structured-profiling analogue of the reference logging
+    each stage's generated SQL."""
+    import numpy as np
+    import pandas as pd
+
+    from splink_tpu import Splink
+    from splink_tpu.utils.profiling import reset_timings, stage_timings
+
+    rng = np.random.default_rng(4)
+    n = 100
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["a", "b", "c"], n),
+            "city": rng.choice(["x", "y"], n),
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "blocking_rules": ["l.city = r.city"],
+        "comparison_columns": [
+            {"col_name": "name", "comparison": {"kind": "exact"}}
+        ],
+        "max_iterations": 3,
+    }
+    reset_timings()
+    Splink(s, df=df).get_scored_comparisons()
+    t = stage_timings()
+    for stage in ("encode", "blocking", "gammas", "em"):
+        assert stage in t and t[stage][0] >= 0, (stage, t.keys())
