@@ -164,3 +164,35 @@ def test_load_reference_saved_model_and_score(tmp_path):
     city_gamma = out.groupby(out.city_l.str.lower()).gamma_city.unique()
     assert set(city_gamma["london"]) == {1}
     assert set(city_gamma["ely"]) == {0}
+
+
+def test_reference_model_streamed_regime(tmp_path):
+    """The loaded reference-format model also drives the streamed pattern
+    pipeline (inference-only chunked scoring)."""
+    path = tmp_path / "m.json"
+    path.write_text(json.dumps(_reference_model_dict(), indent=4))
+    rng = np.random.default_rng(1)
+    n = 200
+    firsts = np.array(["amelia", "oliver", "isla", "george"])
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "first_name": firsts[rng.integers(0, 4, n)],
+            "age": rng.integers(20, 70, n).astype(float),
+            "city": rng.choice(["london", "Leeds", "ely"], n),
+        }
+    )
+    linker = load_from_json(str(path), df=df)
+    linker.settings["max_resident_pairs"] = 1024  # force streamed regime
+    linker.settings["max_iterations"] = 0  # inference-only, like manually_apply
+    resident = load_from_json(str(path), df=df)
+    a = resident.manually_apply_fellegi_sunter_weights()
+    b = pd.concat(
+        list(linker.stream_scored_comparisons()), ignore_index=True
+    )
+    cols = ["unique_id_l", "unique_id_r"]
+    m = a.merge(b, on=cols, suffixes=("_a", "_b"))
+    assert len(m) == len(a) == len(b)
+    np.testing.assert_allclose(
+        m.match_probability_a, m.match_probability_b, rtol=1e-5, atol=1e-7
+    )
