@@ -288,3 +288,35 @@ def test_pattern_compressed_em_equals_pair_level_em():
     np.testing.assert_allclose(
         np.asarray(pat.ll_history[:10]), np.asarray(full.ll_history[:10]), rtol=1e-9
     )
+
+
+def test_em_convergence_threshold_honoured():
+    """A looser em_convergence stops EM in fewer iterations; tight runs to
+    the cap (reference semantics: max abs pi delta < threshold)."""
+    import pandas as pd
+
+    from splink_tpu import Splink
+
+    rng = np.random.default_rng(6)
+    n = 300
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice([f"n{i}" for i in range(30)], n),
+            "city": rng.choice(["x", "y"], n),
+        }
+    )
+    base = {
+        "link_type": "dedupe_only",
+        "blocking_rules": ["l.city = r.city"],
+        "comparison_columns": [
+            {"col_name": "name", "comparison": {"kind": "exact"}}
+        ],
+        "max_iterations": 30,
+    }
+    loose = Splink({**base, "em_convergence": 0.01}, df=df)
+    loose.get_scored_comparisons()
+    tight = Splink({**base, "em_convergence": 1e-12}, df=df)
+    tight.get_scored_comparisons()
+    assert len(loose.params.param_history) < len(tight.params.param_history)
+    assert loose.params.is_converged()
