@@ -1,0 +1,577 @@
+"""Minimal JVM class-file interpreter — just enough to execute the
+reference jar's org.apache.commons.codec.language.DoubleMetaphone
+(commons-codec 1.5, Java 1.4 bytecode) WITHOUT a JVM in the image.
+
+Purpose: the reference ships DoubleMetaphone only as a compiled binary
+(/root/reference/jars/scala-udf-similarity-0.0.6.jar, registered at
+/root/reference/tests/test_spark.py:48). To pin splink_tpu's pure-Python
+port bit-exactly against the actual artifact users ran, this interpreter
+executes the class file's bytecode directly and generates the golden
+vector table (tests/data/dmetaphone_vectors.json). It is a DEV TOOL, not a
+runtime dependency — the framework never imports it.
+
+Scope: the opcode subset javac 1.4 emits for this class (stack ops, int
+arithmetic, branches, tableswitch/lookupswitch, field/method access,
+object creation, String[] arrays) plus shims for the handful of
+java.lang String/StringBuffer/Locale methods it calls. No exceptions, no
+threads, no floats, no wide opcodes beyond what appears.
+
+Usage:
+    python scripts/jvm_mini.py WORD [WORD...]     # print primary/alternate
+    python scripts/jvm_mini.py --selftest
+"""
+
+from __future__ import annotations
+
+import struct
+import sys
+import zipfile
+
+JAR = "/root/reference/jars/scala-udf-similarity-0.0.6.jar"
+DM = "org/apache/commons/codec/language/DoubleMetaphone"
+DMR = DM + "$DoubleMetaphoneResult"
+
+
+# --------------------------------------------------------------------------
+# Class-file parsing
+# --------------------------------------------------------------------------
+
+
+class Const:
+    __slots__ = ("tag", "val")
+
+    def __init__(self, tag, val):
+        self.tag = tag
+        self.val = val
+
+
+class ClassFile:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+        magic = self.u4()
+        assert magic == 0xCAFEBABE, hex(magic)
+        self.u2()  # minor
+        self.major = self.u2()
+        self.cp = self._parse_cp()
+        self.access = self.u2()
+        self.this_name = self.class_name(self.u2())
+        sup = self.u2()
+        self.super_name = self.class_name(sup) if sup else None
+        n_if = self.u2()
+        self.interfaces = [self.class_name(self.u2()) for _ in range(n_if)]
+        self.fields = self._parse_members()
+        self.methods = self._parse_members()
+
+    # -- primitive readers --
+    def u1(self):
+        v = self.data[self.pos]
+        self.pos += 1
+        return v
+
+    def u2(self):
+        v = struct.unpack_from(">H", self.data, self.pos)[0]
+        self.pos += 2
+        return v
+
+    def u4(self):
+        v = struct.unpack_from(">I", self.data, self.pos)[0]
+        self.pos += 4
+        return v
+
+    def _parse_cp(self):
+        count = self.u2()
+        cp = [None] * count
+        i = 1
+        while i < count:
+            tag = self.u1()
+            if tag == 1:  # Utf8
+                ln = self.u2()
+                raw = self.data[self.pos : self.pos + ln]
+                self.pos += ln
+                cp[i] = Const(1, raw.decode("utf-8", "surrogatepass"))
+            elif tag == 3:
+                cp[i] = Const(3, struct.unpack_from(">i", self.data, self.pos)[0])
+                self.pos += 4
+            elif tag == 4:
+                cp[i] = Const(4, struct.unpack_from(">f", self.data, self.pos)[0])
+                self.pos += 4
+            elif tag in (5, 6):  # long/double take two slots
+                fmt = ">q" if tag == 5 else ">d"
+                cp[i] = Const(tag, struct.unpack_from(fmt, self.data, self.pos)[0])
+                self.pos += 8
+                i += 1
+            elif tag in (7, 8):  # Class, String -> utf8 index
+                cp[i] = Const(tag, self.u2())
+            elif tag in (9, 10, 11):  # refs -> (class_idx, nat_idx)
+                cp[i] = Const(tag, (self.u2(), self.u2()))
+            elif tag == 12:  # NameAndType
+                cp[i] = Const(12, (self.u2(), self.u2()))
+            else:
+                raise ValueError(f"cp tag {tag} unsupported")
+            i += 1
+        return cp
+
+    def utf(self, idx):
+        return self.cp[idx].val
+
+    def class_name(self, idx):
+        return self.utf(self.cp[idx].val)
+
+    def nat(self, idx):
+        ni, ti = self.cp[idx].val
+        return self.utf(ni), self.utf(ti)
+
+    def ref(self, idx):
+        ci, nati = self.cp[idx].val
+        name, desc = self.nat(nati)
+        return self.class_name(ci), name, desc
+
+    def _parse_members(self):
+        out = {}
+        for _ in range(self.u2()):
+            self.u2()  # access
+            name = self.utf(self.u2())
+            desc = self.utf(self.u2())
+            attrs = {}
+            for _a in range(self.u2()):
+                aname = self.utf(self.u2())
+                alen = self.u4()
+                attrs[aname] = self.data[self.pos : self.pos + alen]
+                self.pos += alen
+            out[(name, desc)] = attrs
+        return out
+
+    def code(self, name, desc):
+        attrs = self.methods[(name, desc)]
+        raw = attrs["Code"]
+        max_stack, max_locals, code_len = struct.unpack_from(">HHI", raw, 0)
+        code = raw[8 : 8 + code_len]
+        return max_locals, code
+
+
+# --------------------------------------------------------------------------
+# Runtime model
+# --------------------------------------------------------------------------
+
+
+class JObject:
+    __slots__ = ("cls", "fields")
+
+    def __init__(self, cls):
+        self.cls = cls
+        self.fields = {}
+
+
+class JSB:
+    """StringBuffer/StringBuilder shim."""
+
+    def __init__(self, init=""):
+        self.buf = list(init)
+
+
+class Machine:
+    def __init__(self, jar_path=JAR):
+        zf = zipfile.ZipFile(jar_path)
+        self.classes: dict[str, ClassFile] = {}
+        for cn in (DM, DMR):
+            self.classes[cn] = ClassFile(zf.read(cn + ".class"))
+        self.statics: dict[tuple, object] = {}
+        for cn in (DM, DMR):
+            cf = self.classes[cn]
+            if ("<clinit>", "()V") in cf.methods:
+                self.run(cf, "<clinit>", "()V", [])
+
+    # -- helpers --
+    def new_instance(self, cls_name):
+        return JObject(cls_name)
+
+    def find_method(self, cls_name, name, desc):
+        cn = cls_name
+        while cn in self.classes:
+            cf = self.classes[cn]
+            if (name, desc) in cf.methods:
+                return cf
+            cn = cf.super_name
+        return None
+
+    @staticmethod
+    def n_args(desc):
+        """Count argument slots from a method descriptor (no long/double
+        in these classes, so every arg is one slot)."""
+        n = 0
+        i = 1
+        while desc[i] != ")":
+            c = desc[i]
+            if c in "IZBCSF":
+                n += 1
+                i += 1
+            elif c == "L":
+                n += 1
+                i = desc.index(";", i) + 1
+            elif c == "[":
+                i += 1
+                continue
+            elif c in "JD":
+                n += 2
+                i += 1
+            else:
+                raise ValueError(desc)
+        return n
+
+    # -- java.lang shims ---------------------------------------------------
+    def shim(self, cls, name, desc, args):
+        if cls in ("java/lang/String",):
+            s = args[0]
+            if name == "length":
+                return len(s)
+            if name == "charAt":
+                return ord(s[args[1]])
+            if name == "substring":
+                return s[args[1] : args[2]] if len(args) == 3 else s[args[1] :]
+            if name == "equals":
+                return 1 if s == args[1] else 0
+            if name == "indexOf":
+                t = args[1]
+                if isinstance(t, int):
+                    t = chr(t)
+                return s.find(t)
+            if name == "toUpperCase":
+                return s.upper()
+            if name == "trim":
+                # Java trim strips chars <= U+0020
+                t = s
+                while t and ord(t[0]) <= 0x20:
+                    t = t[1:]
+                while t and ord(t[-1]) <= 0x20:
+                    t = t[:-1]
+                return t
+            if name == "startsWith":
+                return 1 if s.startswith(args[1]) else 0
+            if name == "endsWith":
+                return 1 if s.endswith(args[1]) else 0
+            if name == "lastIndexOf":
+                t = args[1]
+                return s.rfind(chr(t) if isinstance(t, int) else t)
+            if name == "isEmpty":
+                return 1 if not s else 0
+            if name == "valueOf":
+                a = args[0]
+                return chr(a) if desc.startswith("(C)") else str(a)
+        if cls in ("java/lang/StringBuffer", "java/lang/StringBuilder"):
+            sb = args[0]
+            if name == "<init>":
+                sb.buf = list(args[1]) if len(args) > 1 and isinstance(args[1], str) else []
+                return None
+            if name == "append":
+                v = args[1]
+                sb.buf.append(chr(v) if isinstance(v, int) else str(v))
+                return sb
+            if name == "length":
+                return len("".join(sb.buf))
+            if name == "toString":
+                return "".join(sb.buf)
+            if name == "insert":
+                joined = "".join(sb.buf)
+                v = args[2]
+                v = chr(v) if isinstance(v, int) else str(v)
+                sb.buf = list(joined[: args[1]] + v + joined[args[1] :])
+                return sb
+        if cls == "java/lang/Object" and name == "<init>":
+            return None
+        if cls == "java/lang/Character":
+            if name == "toUpperCase":
+                return ord(chr(args[0]).upper())
+        if cls == "java/lang/Math":
+            if name == "min":
+                return min(args[0], args[1])
+            if name == "max":
+                return max(args[0], args[1])
+        raise NotImplementedError(f"shim {cls}.{name}{desc}")
+
+    def get_static_shim(self, cls, name):
+        if cls == "java/util/Locale" and name == "ENGLISH":
+            return ("locale", "en")
+        if cls == "java/lang/Character" and name == "MIN_VALUE":
+            return 0
+        raise NotImplementedError(f"getstatic {cls}.{name}")
+
+    # -- interpreter -------------------------------------------------------
+    def invoke(self, cls, name, desc, args):
+        cf = self.find_method(cls, name, desc)
+        if cf is None:
+            # inner-class receiver may be a shim type (StringBuffer)
+            return self.shim(cls, name, desc, args)
+        return self.run(cf, name, desc, args)
+
+    def run(self, cf: ClassFile, mname, mdesc, args):
+        max_locals, code = cf.code(mname, mdesc)
+        local = list(args) + [None] * (max_locals - len(args))
+        stack = []
+        pc = 0
+        cp = cf.cp
+
+        def s16(off):
+            return struct.unpack_from(">h", code, off)[0]
+
+        def u16(off):
+            return struct.unpack_from(">H", code, off)[0]
+
+        while True:
+            op = code[pc]
+            # ---- constants / loads / stores
+            if op == 0x00:  # nop
+                pc += 1
+            elif op == 0x01:  # aconst_null
+                stack.append(None)
+                pc += 1
+            elif 0x02 <= op <= 0x08:  # iconst_m1..5
+                stack.append(op - 0x03)
+                pc += 1
+            elif op == 0x10:  # bipush
+                stack.append(struct.unpack_from(">b", code, pc + 1)[0])
+                pc += 2
+            elif op == 0x11:  # sipush
+                stack.append(s16(pc + 1))
+                pc += 3
+            elif op in (0x12, 0x13):  # ldc / ldc_w
+                idx = code[pc + 1] if op == 0x12 else u16(pc + 1)
+                c = cp[idx]
+                if c.tag == 8:
+                    stack.append(cf.utf(c.val))
+                elif c.tag == 3:
+                    stack.append(c.val)
+                else:
+                    raise NotImplementedError(f"ldc tag {c.tag}")
+                pc += 2 if op == 0x12 else 3
+            elif op == 0x15 or op == 0x19:  # iload / aload
+                stack.append(local[code[pc + 1]])
+                pc += 2
+            elif 0x1A <= op <= 0x1D:  # iload_0..3
+                stack.append(local[op - 0x1A])
+                pc += 1
+            elif 0x2A <= op <= 0x2D:  # aload_0..3
+                stack.append(local[op - 0x2A])
+                pc += 1
+            elif op == 0x36 or op == 0x3A:  # istore / astore
+                local[code[pc + 1]] = stack.pop()
+                pc += 2
+            elif 0x3B <= op <= 0x3E:  # istore_0..3
+                local[op - 0x3B] = stack.pop()
+                pc += 1
+            elif 0x4B <= op <= 0x4E:  # astore_0..3
+                local[op - 0x4B] = stack.pop()
+                pc += 1
+            elif op == 0x32:  # aaload
+                i = stack.pop()
+                arr = stack.pop()
+                stack.append(arr[i])
+                pc += 1
+            elif op == 0x53:  # aastore
+                v = stack.pop()
+                i = stack.pop()
+                arr = stack.pop()
+                arr[i] = v
+                pc += 1
+            elif op == 0xBE:  # arraylength
+                stack.append(len(stack.pop()))
+                pc += 1
+            # ---- stack ops
+            elif op == 0x57:  # pop
+                stack.pop()
+                pc += 1
+            elif op == 0x59:  # dup
+                stack.append(stack[-1])
+                pc += 1
+            elif op == 0x5A:  # dup_x1
+                v1 = stack.pop()
+                v2 = stack.pop()
+                stack += [v1, v2, v1]
+                pc += 1
+            elif op == 0x5F:  # swap
+                stack[-1], stack[-2] = stack[-2], stack[-1]
+                pc += 1
+            # ---- arithmetic
+            elif op == 0x60:  # iadd
+                b = stack.pop()
+                stack.append(stack.pop() + b)
+                pc += 1
+            elif op == 0x64:  # isub
+                b = stack.pop()
+                stack.append(stack.pop() - b)
+                pc += 1
+            elif op == 0x68:  # imul
+                b = stack.pop()
+                stack.append(stack.pop() * b)
+                pc += 1
+            elif op == 0x84:  # iinc
+                local[code[pc + 1]] += struct.unpack_from(">b", code, pc + 2)[0]
+                pc += 3
+            elif op == 0x92:  # i2c
+                stack.append(stack.pop() & 0xFFFF)
+                pc += 1
+            # ---- branches
+            elif 0x99 <= op <= 0x9E:  # ifeq..ifle
+                v = stack.pop()
+                v = 0 if v is None else v
+                cond = [v == 0, v != 0, v < 0, v >= 0, v > 0, v <= 0][op - 0x99]
+                pc = pc + s16(pc + 1) if cond else pc + 3
+            elif 0x9F <= op <= 0xA4:  # if_icmpeq..le
+                b = stack.pop()
+                a = stack.pop()
+                cond = [a == b, a != b, a < b, a >= b, a > b, a <= b][op - 0x9F]
+                pc = pc + s16(pc + 1) if cond else pc + 3
+            elif op in (0xA5, 0xA6):  # if_acmpeq/ne
+                b = stack.pop()
+                a = stack.pop()
+                cond = (a is b) if op == 0xA5 else (a is not b)
+                pc = pc + s16(pc + 1) if cond else pc + 3
+            elif op == 0xA7:  # goto
+                pc = pc + s16(pc + 1)
+            elif op == 0xC6:  # ifnull
+                pc = pc + s16(pc + 1) if stack.pop() is None else pc + 3
+            elif op == 0xC7:  # ifnonnull
+                pc = pc + s16(pc + 1) if stack.pop() is not None else pc + 3
+            elif op == 0xAA:  # tableswitch
+                base = pc
+                p = (pc + 4) & ~3
+                default = struct.unpack_from(">i", code, p)[0]
+                lo = struct.unpack_from(">i", code, p + 4)[0]
+                hi = struct.unpack_from(">i", code, p + 8)[0]
+                v = stack.pop()
+                if lo <= v <= hi:
+                    off = struct.unpack_from(
+                        ">i", code, p + 12 + 4 * (v - lo)
+                    )[0]
+                else:
+                    off = default
+                pc = base + off
+            elif op == 0xAB:  # lookupswitch
+                base = pc
+                p = (pc + 4) & ~3
+                default = struct.unpack_from(">i", code, p)[0]
+                n = struct.unpack_from(">i", code, p + 4)[0]
+                v = stack.pop()
+                off = default
+                for k in range(n):
+                    match, o = struct.unpack_from(">ii", code, p + 8 + 8 * k)
+                    if match == v:
+                        off = o
+                        break
+                pc = base + off
+            # ---- returns
+            elif op in (0xAC, 0xB0):  # ireturn / areturn
+                return stack.pop()
+            elif op == 0xB1:  # return
+                return None
+            # ---- fields
+            elif op == 0xB2:  # getstatic
+                cls, name, _d = cf.ref(u16(pc + 1))
+                if cls in self.classes:
+                    stack.append(self.statics[(cls, name)])
+                else:
+                    stack.append(self.get_static_shim(cls, name))
+                pc += 3
+            elif op == 0xB3:  # putstatic
+                cls, name, _d = cf.ref(u16(pc + 1))
+                self.statics[(cls, name)] = stack.pop()
+                pc += 3
+            elif op == 0xB4:  # getfield
+                _cls, name, _d = cf.ref(u16(pc + 1))
+                obj = stack.pop()
+                stack.append(obj.fields[name])
+                pc += 3
+            elif op == 0xB5:  # putfield
+                _cls, name, _d = cf.ref(u16(pc + 1))
+                v = stack.pop()
+                obj = stack.pop()
+                obj.fields[name] = v
+                pc += 3
+            # ---- invocations
+            elif op in (0xB6, 0xB7, 0xB8):  # virtual / special / static
+                cls, name, desc = cf.ref(u16(pc + 1))
+                argc = self.n_args(desc)
+                call_args = [stack.pop() for _ in range(argc)][::-1]
+                if op != 0xB8:
+                    call_args.insert(0, stack.pop())  # receiver
+                if cls in self.classes or (
+                    op == 0xB6
+                    and call_args
+                    and isinstance(call_args[0], JObject)
+                ):
+                    tgt = (
+                        call_args[0].cls
+                        if op == 0xB6 and isinstance(call_args[0], JObject)
+                        else cls
+                    )
+                    ret = self.invoke(tgt, name, desc, call_args)
+                else:
+                    ret = self.shim(cls, name, desc, call_args)
+                if not desc.endswith(")V"):
+                    stack.append(ret)
+                pc += 3
+            # ---- allocation
+            elif op == 0xBB:  # new
+                cls = cf.class_name(u16(pc + 1))
+                if cls in self.classes:
+                    stack.append(JObject(cls))
+                elif cls in ("java/lang/StringBuffer", "java/lang/StringBuilder"):
+                    stack.append(JSB())
+                else:
+                    raise NotImplementedError(f"new {cls}")
+                pc += 3
+            elif op == 0xBD:  # anewarray
+                n = stack.pop()
+                stack.append([None] * n)
+                pc += 3
+            elif op == 0xC0:  # checkcast
+                pc += 3
+            elif op == 0xC1:  # instanceof
+                cls = cf.class_name(u16(pc + 1))
+                v = stack.pop()
+                stack.append(1 if isinstance(v, str) and cls == "java/lang/String" else 0)
+                pc += 3
+            else:
+                raise NotImplementedError(
+                    f"opcode 0x{op:02x} at pc={pc} in {cf.this_name}.{mname}"
+                )
+
+
+_MACHINE = None
+
+
+def jar_double_metaphone(word, alternate=False):
+    """Run the reference jar's DoubleMetaphone on one word."""
+    global _MACHINE
+    if _MACHINE is None:
+        _MACHINE = Machine()
+        dm = _MACHINE.new_instance(DM)
+        _MACHINE.invoke(DM, "<init>", "()V", [dm])
+        _MACHINE._dm = dm
+    return _MACHINE.invoke(
+        DM,
+        "doubleMetaphone",
+        "(Ljava/lang/String;Z)Ljava/lang/String;",
+        [_MACHINE._dm, word, 1 if alternate else 0],
+    )
+
+
+def main(argv):
+    if argv and argv[0] == "--selftest":
+        # canonical, widely published examples
+        checks = {
+            "smith": ("SM0", "XMT"),
+            "schmidt": ("XMT", "SMT"),
+            "dumb": ("TM", "TM"),
+        }
+        for w, (p, a) in checks.items():
+            gp, ga = jar_double_metaphone(w), jar_double_metaphone(w, True)
+            status = "ok" if (gp, ga) == (p, a) else f"MISMATCH expected {(p, a)}"
+            print(f"{w}: {gp} / {ga}  {status}")
+        return
+    for w in argv:
+        print(w, jar_double_metaphone(w), jar_double_metaphone(w, True))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

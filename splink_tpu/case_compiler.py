@@ -22,7 +22,11 @@ Supported surface (enough for hand-written comparison CASEs):
 * string functions: ``jaro_winkler_sim``, ``levenshtein``,
   ``jaccard_sim``, ``cosine_distance`` (q-gram q=2, or wrap the args in
   ``QNgramTokeniser(...)`` for other q), ``length``, ``lower``, ``upper``,
-  ``ifnull`` / ``coalesce``, ``dmetaphone`` (same column on both sides)
+  ``substr`` / ``substring`` (constant 1-based start/length — a static
+  slice on the padded char arrays, as used by the reference's own fixture
+  CASE /root/reference/tests/conftest.py:116), ``concat``, ``trim`` /
+  ``ltrim`` / ``rtrim``, ``ifnull`` / ``coalesce``, ``dmetaphone`` (same
+  column on both sides)
 
 The jar UDF names (/root/reference/tests/test_spark.py:44-56) resolve to the
 corresponding splink_tpu kernels.
@@ -264,6 +268,17 @@ def parse_sql_expression(expr: str):
         display = re.sub(r"\s+", " ", expr).strip()
         p = _Parser(_tokenize(expr), display)
         node = p.parse_expr()
+        # tolerate the trailing "as gamma_<col>" alias the reference's
+        # settings completion appends to every user case_expression
+        # (/root/reference/splink/settings.py:117-139)
+        if p.peek()[0] == "ident" and p.peek()[1].lower() == "as":
+            p.next()
+            if p.peek()[0] != "ident":
+                raise SqlTranslationError(
+                    f"Expected an alias name after 'as' in case_expression: "
+                    f"{display!r}"
+                )
+            p.next()
         if p.peek()[0] != "eof":
             raise SqlTranslationError(
                 f"Trailing tokens after expression in case_expression: "
@@ -281,7 +296,8 @@ _TOKENISER_Q = re.compile(r"^q([2-6])?gramtokeniser$")
 
 _STRING_FUNCS = {"jaro_winkler_sim", "levenshtein", "jaccard_sim",
                  "cosine_distance", "length", "lower", "upper", "dmetaphone",
-                 "dmetaphone_alt"}
+                 "dmetaphone_alt", "substr", "substring", "concat", "trim",
+                 "ltrim", "rtrim"}
 _NUMERIC_FUNCS = {"abs", "least", "greatest", "round", "floor", "ceil"}
 
 
@@ -372,35 +388,101 @@ def analyse_case_expression(expr: str) -> dict:
     return {"columns": cols, "phonetic": phonetic, "levels": levels}
 
 
+_NOT_CONST = object()
+
+
+def _fold_const_num(node):
+    """Constant-fold a numeric expression node. Returns the folded value
+    (float, or None for SQL NULL) or the _NOT_CONST sentinel when the node
+    depends on column data."""
+    kind = node[0]
+    if kind == "num":
+        return float(node[1])
+    if kind == "null":
+        return None
+    if kind == "neg":
+        v = _fold_const_num(node[1])
+        if v is _NOT_CONST or v is None:
+            return v
+        return -v
+    if kind == "arith":
+        a = _fold_const_num(node[2])
+        b = _fold_const_num(node[3])
+        if a is _NOT_CONST or b is _NOT_CONST:
+            return _NOT_CONST
+        if a is None or b is None:
+            return None
+        op = node[1]
+        if op == "/":
+            return None if b == 0 else a / b
+        return {"+": a + b, "-": a - b, "*": a * b}[op]
+    return _NOT_CONST
+
+
 def _collect_outcomes(case_node, out: set[int], expr: str) -> None:
     """Collect the gamma-level outcomes of the ROOT CASE: its THEN/ELSE
     leaves, recursing only into nested CASEs in *value* position (their
-    values are outcomes too; a CASE inside a condition is not)."""
+    values are outcomes too; a CASE inside a condition is not).
+
+    Every outcome must be a constant integer (after folding) or NULL, so
+    the [-1, num_levels) range check is COMPLETE: a data-dependent outcome
+    ('then col_l') could silently wrap in the int8 cast and alias pattern
+    ids in the streamed pattern regime, so it is rejected here rather than
+    trusted at run time."""
 
     def leaf(node):
         if node[0] == "case":
             _collect_outcomes(node, out, expr)
-        elif node[0] == "num":
-            if not float(node[1]).is_integer():
-                raise SqlTranslationError(
-                    f"CASE outcome {node[1]!r} is not an integer gamma "
-                    f"level: {expr!r}"
-                )
-            out.add(int(node[1]))
-        elif node[0] == "neg" and node[1][0] == "num":
-            if not float(node[1][1]).is_integer():
-                raise SqlTranslationError(
-                    f"CASE outcome -{node[1][1]!r} is not an integer gamma "
-                    f"level: {expr!r}"
-                )
-            out.add(-int(node[1][1]))
-        # non-literal outcomes (column refs, arithmetic) cannot be checked
-        # statically; they are validated by the int8 cast at run time
+            return
+        v = _fold_const_num(node)
+        if v is _NOT_CONST:
+            raise SqlTranslationError(
+                f"CASE outcome must be a constant integer gamma level or "
+                f"NULL, not a data-dependent or non-numeric expression: "
+                f"{expr!r}"
+            )
+        if v is None:
+            return  # THEN NULL -> gamma -1 at run time; always in range
+        if not float(v).is_integer():
+            raise SqlTranslationError(
+                f"CASE outcome {v!r} is not an integer gamma "
+                f"level: {expr!r}"
+            )
+        out.add(int(v))
 
     for _, val in case_node[1]:
         leaf(val)
     if case_node[2] is not None:
         leaf(case_node[2])
+
+
+def _substr_const_args(args, expr: str) -> tuple[int, int | None]:
+    """Validate substr's start/length are constant integers (the single
+    source of truth for both settings-time validation and the evaluator).
+    Returns (start, length_or_None)."""
+    if len(args) not in (2, 3):
+        raise SqlTranslationError(f"substr takes 2 or 3 arguments: {expr!r}")
+    vals = []
+    for what, arg in zip(("start", "length"), args[1:]):
+        c = _fold_const_num(arg)
+        if c is _NOT_CONST or c is None or not float(c).is_integer():
+            raise SqlTranslationError(
+                f"substr {what} must be a constant integer (dynamic or "
+                f"NULL starts/lengths are unsupported): {expr!r}"
+            )
+        vals.append(int(c))
+    start = vals[0]
+    if start <= 0:
+        raise SqlTranslationError(
+            f"substr start must be >= 1 (SQL is 1-based; negative "
+            f"from-the-end starts are unsupported): {expr!r}"
+        )
+    length = vals[1] if len(vals) > 1 else None
+    if length is not None and length < 0:
+        raise SqlTranslationError(
+            f"substr length must be >= 0: {expr!r}"
+        )
+    return start, length
 
 
 def _supported_functions() -> list[str]:
@@ -428,6 +510,11 @@ def _validate_functions(ast, expr: str) -> None:
                     f"{expr!r}. Supported functions: "
                     f"{', '.join(_supported_functions())}."
                 )
+            if name in ("substr", "substring"):
+                # start/length must be compile-time constants (the slice is
+                # static); checked here so a bad substr fails at settings
+                # completion, not at trace time inside the gamma program
+                _substr_const_args(node[2], expr)
             for a in node[2]:
                 walk(a, parent_func=name)
         elif kind == "case":
@@ -545,6 +632,14 @@ class _Evaluator:
         self.jnp = jnp
         # batch size, so constant sub-expressions can broadcast
         self.n = ctx._rows_l.shape[0]
+        # the gamma program's float dtype: float64 when the table was packed
+        # in f64 mode (settings float64=true), so equality/threshold tests on
+        # integer-like values above 2^24 don't misfire in float32
+        self.fdt = jnp.float32
+        for f in ctx._layout.values():
+            if getattr(f, "f64", False):
+                self.fdt = jnp.float64
+                break
 
     # -- helpers ----------------------------------------------------------
 
@@ -555,14 +650,14 @@ class _Evaluator:
         if isinstance(v, _Lit):
             if v.value is None:
                 return _Num(
-                    jnp.zeros((self.n,), jnp.float32), jnp.ones((self.n,), bool)
+                    jnp.zeros((self.n,), self.fdt), jnp.ones((self.n,), bool)
                 )
             if not isinstance(v.value, (int, float)) or isinstance(v.value, bool):
                 raise SqlTranslationError(
                     f"Expected a numeric operand, got {v.value!r}"
                 )
             return _Num(
-                jnp.full((self.n,), float(v.value), jnp.float32),
+                jnp.full((self.n,), float(v.value), self.fdt),
                 jnp.zeros((self.n,), bool),
             )
         raise SqlTranslationError("Expected a numeric operand, got a string")
@@ -643,9 +738,11 @@ class _Evaluator:
         _, base, side = node
         pc = self.ctx.col(base)
         if pc.num_l is not None:
+            # the PairContext already decodes at the program's float dtype
+            # (float64 when packed f64) — don't downcast to float32
             val = pc.num_l if side == "l" else pc.num_r
             null = pc.null_l if side == "l" else pc.null_r
-            return _Num(val.astype(self.jnp.float32), null)
+            return _Num(val, null)
         if side == "l":
             return _Str(pc.chars_l, pc.len_l, pc.null_l, pc.tok_l, base)
         return _Str(pc.chars_r, pc.len_r, pc.null_r, pc.tok_r, base)
@@ -933,6 +1030,8 @@ class _Evaluator:
             raise SqlTranslationError("length takes exactly one argument")
         v = self.eval(args[0])
         if isinstance(v, _Lit):
+            if v.value is None:
+                return _Lit(None)  # SQL: length(NULL) is NULL
             return _Lit(float(len(str(v.value))))
         if not isinstance(v, _Str):
             raise SqlTranslationError("length expects a string argument")
@@ -947,6 +1046,8 @@ class _Evaluator:
             raise SqlTranslationError("lower/upper take exactly one argument")
         v = self.eval(args[0])
         if isinstance(v, _Lit):
+            if v.value is None:
+                return _Lit(None)  # SQL: lower/upper(NULL) is NULL
             s = str(v.value)
             return _Lit(s.lower() if to_lower else s.upper())
         if not isinstance(v, _Str):
@@ -963,6 +1064,144 @@ class _Evaluator:
 
     def _fn_upper(self, args):
         return self._case_shift(args, False)
+
+    def _fn_substr(self, args):
+        """substr(s, start[, length]) — SQL 1-based. start/length must be
+        constants, so the result is a STATIC slice of the padded char array
+        (cheap under jit; no per-row gather). This covers the reference's
+        canonical fixture CASE ``substr(surname_l,1,3)``
+        (/root/reference/tests/conftest.py:116)."""
+        jnp = self.jnp
+        start, ln = _substr_const_args(args, "substr(...)")
+        v = self.eval(args[0])
+        if isinstance(v, _Lit):
+            if v.value is None:
+                return _Lit(None)
+            s = str(v.value)
+            return _Lit(
+                s[start - 1 : start - 1 + ln] if ln is not None
+                else s[start - 1 :]
+            )
+        if not isinstance(v, _Str):
+            raise SqlTranslationError("substr expects a string argument")
+        w = v.chars.shape[1]
+        lo = start - 1
+        if ln is None:
+            ln = max(w - lo, 0)
+        if lo >= w or ln == 0:
+            # slice entirely past the encoded width: empty string per row
+            return _Str(
+                jnp.zeros((v.chars.shape[0], 1), v.chars.dtype),
+                jnp.zeros_like(v.length),
+                v.null,
+            )
+        hi = min(lo + ln, w)
+        # source arrays are zero beyond each row's length, so the slice
+        # needs no re-masking: positions past the new length land on zeros
+        chars = v.chars[:, lo:hi]
+        length = jnp.clip(v.length - lo, 0, ln)
+        return _Str(chars, length, v.null)
+
+    _fn_substring = _fn_substr
+
+    def _concat2(self, a: _Str, b: _Str) -> _Str:
+        jnp = self.jnp
+        wa, wb = a.chars.shape[1], b.chars.shape[1]
+        w = wa + wb
+        ca, cb = a.chars, b.chars
+        if ca.dtype != cb.dtype:
+            ca = ca.astype(jnp.uint32)
+            cb = cb.astype(jnp.uint32)
+        n = ca.shape[0]
+        pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+        # clamp in case a row's true length exceeds its encoded width
+        # (host-side truncation) — positions index real lanes only
+        la = jnp.minimum(a.length, wa)[:, None]
+        ia = jnp.broadcast_to(jnp.clip(pos, 0, wa - 1), (n, w))
+        ib = jnp.clip(pos - la, 0, wb - 1)
+        ga = jnp.take_along_axis(ca, ia, axis=1)
+        gb = jnp.take_along_axis(cb, ib, axis=1)
+        in_b = (pos - la >= 0) & (pos - la < wb)
+        chars = jnp.where(
+            pos < la, ga, jnp.where(in_b, gb, jnp.zeros_like(gb))
+        )
+        return _Str(chars, a.length + b.length, a.null | b.null)
+
+    def _fn_concat(self, args):
+        jnp = self.jnp
+        if not args:
+            raise SqlTranslationError("concat takes at least 1 argument")
+        vals = [self.eval(a) for a in args]
+        anchor = next((v for v in vals if not isinstance(v, _Lit)), None)
+        if anchor is None:
+            # all-constant: fold; NULL if any argument is NULL (Spark 2.x)
+            if any(v.value is None for v in vals):
+                return _Lit(None)
+            return _Lit("".join(str(v.value) for v in vals))
+        if not isinstance(anchor, _Str):
+            raise SqlTranslationError("concat expects string arguments")
+        strs = []
+        for v in vals:
+            if isinstance(v, _Lit):
+                if v.value is None:
+                    # concat with a NULL argument is NULL for every row
+                    shape = anchor.length.shape
+                    return _Str(
+                        jnp.zeros((shape[0], 1), anchor.chars.dtype),
+                        jnp.zeros(shape, jnp.int32),
+                        jnp.ones(shape, bool),
+                    )
+                v = self._lit_as_str(v, anchor)
+            if not isinstance(v, _Str):
+                raise SqlTranslationError("concat expects string arguments")
+            strs.append(v)
+        out = strs[0]
+        for v in strs[1:]:
+            out = self._concat2(out, v)
+        return out
+
+    def _trim_like(self, args, left: bool, right: bool, fname: str):
+        jnp = self.jnp
+        if len(args) != 1:
+            raise SqlTranslationError(f"{fname} takes exactly one argument")
+        v = self.eval(args[0])
+        if isinstance(v, _Lit):
+            if v.value is None:
+                return _Lit(None)
+            s = str(v.value)
+            if left:
+                s = s.lstrip(" ")
+            if right:
+                s = s.rstrip(" ")
+            return _Lit(s)
+        if not isinstance(v, _Str):
+            raise SqlTranslationError(f"{fname} expects a string argument")
+        c = v.chars
+        n, w = c.shape
+        pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+        lnv = jnp.minimum(v.length, w).astype(jnp.int32)
+        nonspace = (pos < lnv[:, None]) & (c != 32)
+        # all-space rows: first_ns = w and last_ns = -1 -> new_len 0
+        start = (
+            jnp.min(jnp.where(nonspace, pos, w), axis=1)
+            if left
+            else jnp.zeros((n,), jnp.int32)
+        )
+        end = jnp.max(jnp.where(nonspace, pos, -1), axis=1) + 1 if right else lnv
+        new_len = jnp.maximum(end - start, 0)
+        idx = jnp.clip(pos + start[:, None], 0, w - 1)
+        g = jnp.take_along_axis(c, idx, axis=1)
+        chars = jnp.where(pos < new_len[:, None], g, jnp.zeros_like(g))
+        return _Str(chars, new_len.astype(jnp.int32), v.null)
+
+    def _fn_trim(self, args):
+        return self._trim_like(args, True, True, "trim")
+
+    def _fn_ltrim(self, args):
+        return self._trim_like(args, True, False, "ltrim")
+
+    def _fn_rtrim(self, args):
+        return self._trim_like(args, False, True, "rtrim")
 
     def _fn_abs(self, args):
         if len(args) != 1:

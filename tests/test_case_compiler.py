@@ -587,3 +587,275 @@ def test_unicode_literal_and_wide_column():
     G = prog.compute(*_pairs_vs_first(df))
     # münchen/münchen -> 2; munchen differs (ü != u) -> 0; köln -> 0
     assert G[:, 0].tolist() == [2, 0, 0]
+
+
+# --------------------------------------------------------------------------
+# substr / concat / trim (reference fixture parity: the reference's own
+# conftest CASE uses substr — /root/reference/tests/conftest.py:116)
+# --------------------------------------------------------------------------
+
+
+def _gamma_for(expr, df, num_levels=2, col="name"):
+    prog, _ = _program(
+        [{"col_name": col, "num_levels": num_levels, "case_expression": expr}],
+        df,
+    )
+    return prog.compute(*_pairs_vs_first(df))[:, 0].tolist()
+
+
+def test_substr_prefix_equality():
+    df = pd.DataFrame(
+        {
+            "unique_id": range(5),
+            "name": ["Linacre", "Linacer", "Lim", "Li", "Smith"],
+        }
+    )
+    got = _gamma_for(
+        "case when substr(name_l, 1, 3) = substr(name_r, 1, 3) "
+        "then 1 else 0 end",
+        df,
+    )
+    # vs "Linacre": "Lin"=="Lin" -> 1; "Lim" -> 0; "Li" shorter -> 0; Smith 0
+    assert got == [1, 0, 0, 0]
+
+
+def test_substr_midstring_and_to_end():
+    df = pd.DataFrame(
+        {"unique_id": range(3), "name": ["abcdef", "xbcdef", "abXdef"]}
+    )
+    # substr(s, 2, 3) -> chars 2..4 (1-based)
+    got = _gamma_for(
+        "case when substr(name_l, 2, 3) = substr(name_r, 2, 3) "
+        "then 1 else 0 end",
+        df,
+    )
+    assert got == [1, 0]
+    # 2-arg form runs to the end of the string
+    got = _gamma_for(
+        "case when substr(name_l, 3) = substr(name_r, 3) then 1 else 0 end",
+        df,
+    )
+    assert got == [1, 0]
+
+
+def test_substr_shorter_string_compares_by_length():
+    # SQL: substr('Li',1,3) = 'Li' which != 'Lin' — length matters, not just
+    # the zero-padded prefix bytes
+    df = pd.DataFrame({"unique_id": range(2), "name": ["Lin", "Li"]})
+    got = _gamma_for(
+        "case when substr(name_l, 1, 3) = substr(name_r, 1, 3) "
+        "then 1 else 0 end",
+        df,
+    )
+    assert got == [0]
+
+
+def test_substr_past_width_is_empty_string():
+    df = pd.DataFrame({"unique_id": range(3), "name": ["ab", "cd", "ef"]})
+    # start beyond every encoded width -> both sides '' -> equal
+    got = _gamma_for(
+        "case when substr(name_l, 90, 3) = substr(name_r, 90, 3) "
+        "then 1 else 0 end",
+        df,
+    )
+    assert got == [1, 1]
+
+
+def test_substr_on_literal_folds():
+    df = pd.DataFrame({"unique_id": range(2), "name": ["abc", "xbc"]})
+    # pair is (row0, row1): name_l='abc', name_r='xbc'
+    got = _gamma_for(
+        "case when substr(name_r, 1, 2) = substr('abZ', 1, 2) "
+        "then 1 else 0 end",
+        df,
+    )
+    assert got == [0]  # 'xb' != 'ab'
+    got = _gamma_for(
+        "case when substr(name_l, 2, 2) = 'bc' then 1 else 0 end", df
+    )
+    assert got == [1]
+
+
+def test_substr_dynamic_start_rejected():
+    with pytest.raises(SqlTranslationError, match="constant integer"):
+        compile_case_expression(
+            "case when substr(name_l, length(name_l), 1) = 'x' "
+            "then 1 else 0 end",
+            2,
+        )
+    with pytest.raises(SqlTranslationError, match=">= 1"):
+        compile_case_expression(
+            "case when substr(name_l, 0, 3) = 'abc' then 1 else 0 end", 2
+        )
+
+
+def test_substr_null_propagates():
+    df = pd.DataFrame({"unique_id": range(3), "name": ["abc", None, "abd"]})
+    got = _gamma_for(
+        "case when substr(name_l, 1, 2) = substr(name_r, 1, 2) "
+        "then 1 else 0 end",
+        df,
+    )
+    # NULL row: condition unknown -> falls to ELSE 0; gamma stays 0 here
+    assert got == [0, 1]
+
+
+def test_concat_columns_and_literals():
+    df = pd.DataFrame(
+        {
+            "unique_id": range(3),
+            "first": ["ann", "ann", "bob"],
+            "last": ["lee", "le", "lee"],
+        }
+    )
+    prog, _ = _program(
+        [
+            {
+                "custom_name": "full",
+                "custom_columns_used": ["first", "last"],
+                "num_levels": 2,
+                "case_expression": "case when concat(first_l, '-', last_l) "
+                "= concat(first_r, '-', last_r) then 1 else 0 end",
+            }
+        ],
+        df,
+    )
+    got = prog.compute(*_pairs_vs_first(df))[:, 0].tolist()
+    # 'ann-lee' vs 'ann-le' -> 0; 'ann-lee' vs 'bob-lee' -> 0
+    assert got == [0, 0]
+    # identical concatenations match
+    df2 = pd.DataFrame(
+        {
+            "unique_id": range(2),
+            "first": ["ann", "ann"],
+            "last": ["lee", "lee"],
+        }
+    )
+    prog2, _ = _program(
+        [
+            {
+                "custom_name": "full",
+                "custom_columns_used": ["first", "last"],
+                "num_levels": 2,
+                "case_expression": "case when concat(first_l, last_l) = "
+                "concat(first_r, last_r) then 1 else 0 end",
+            }
+        ],
+        df2,
+    )
+    assert prog2.compute(*_pairs_vs_first(df2))[:, 0].tolist() == [1]
+
+
+def test_concat_no_boundary_confusion():
+    # concat('ab','c') must NOT equal concat('a','bc')... lengths equal and
+    # chars equal -> they DO equal as strings ('abc'='abc'), per SQL
+    df = pd.DataFrame(
+        {
+            "unique_id": range(2),
+            "a": ["ab", "a"],
+            "b": ["c", "bc"],
+        }
+    )
+    prog, _ = _program(
+        [
+            {
+                "custom_name": "j",
+                "custom_columns_used": ["a", "b"],
+                "num_levels": 2,
+                "case_expression": "case when concat(a_l, b_l) = "
+                "concat(a_r, b_r) then 1 else 0 end",
+            }
+        ],
+        df,
+    )
+    assert prog.compute(*_pairs_vs_first(df))[:, 0].tolist() == [1]
+
+
+def test_concat_null_argument_yields_null():
+    df = pd.DataFrame({"unique_id": range(2), "name": ["ab", "ab"]})
+    # concat with a NULL literal is NULL for every row -> comparison unknown
+    got = _gamma_for(
+        "case when concat(name_l, null) = concat(name_r, null) "
+        "then 1 else 0 end",
+        df,
+    )
+    assert got == [0]
+    df2 = pd.DataFrame({"unique_id": range(2), "name": ["ab", None]})
+    got = _gamma_for(
+        "case when concat(name_l, 'x') = concat(name_r, 'x') "
+        "then 1 when name_l is not null then 0 else -1 end",
+        df2,
+        num_levels=2,
+    )
+    assert got == [0]  # null side -> unknown -> next branch
+
+
+def test_trim_family():
+    df = pd.DataFrame(
+        {"unique_id": range(4), "name": ["ab", "  ab ", " ab", "ab  "]}
+    )
+    assert _gamma_for(
+        "case when trim(name_l) = trim(name_r) then 1 else 0 end", df
+    ) == [1, 1, 1]
+    assert _gamma_for(
+        "case when ltrim(name_l) = ltrim(name_r) then 1 else 0 end", df
+    ) == [0, 1, 0]  # 'ab' vs 'ab ', 'ab', 'ab  '
+    assert _gamma_for(
+        "case when rtrim(name_l) = rtrim(name_r) then 1 else 0 end", df
+    ) == [0, 0, 1]  # 'ab' vs '  ab', ' ab', 'ab'
+
+
+def test_trim_all_space_and_literal_folding():
+    df = pd.DataFrame({"unique_id": range(2), "name": ["   ", ""]})
+    assert _gamma_for(
+        "case when trim(name_l) = trim(name_r) then 1 else 0 end", df
+    ) == [1]  # both trim to ''
+    df2 = pd.DataFrame({"unique_id": range(2), "name": ["ab", "ab"]})
+    assert _gamma_for(
+        "case when name_l = trim('  ab  ') then 1 else 0 end", df2
+    ) == [1]
+
+
+def test_length_of_null_literal_is_null():
+    # SQL: length(NULL) is NULL, not 4 (len('None'))
+    df = pd.DataFrame({"unique_id": range(2), "name": ["abcd", "abcd"]})
+    got = _gamma_for(
+        "case when length(null) = 4 then 1 else 0 end", df
+    )
+    assert got == [0]  # unknown condition falls through to ELSE
+    got = _gamma_for(
+        "case when lower(null) is null and upper(null) is null "
+        "then 1 else 0 end",
+        df,
+    )
+    assert got == [1]
+
+
+def test_data_dependent_case_outcome_rejected():
+    # 'then col_l' could wrap in the int8 cast and alias pattern ids in the
+    # streamed pattern regime — rejected statically now
+    with pytest.raises(SqlTranslationError, match="constant integer"):
+        compile_case_expression(
+            "case when age_l = age_r then age_l else 0 end", num_levels=2
+        )
+
+
+def test_constant_arith_case_outcome_folds_and_checks():
+    # 'then 1+1' folds to 2 and is range-checked
+    fn = compile_case_expression(
+        "case when name_l = name_r then 1 + 1 else 0 end", num_levels=3
+    )
+    assert fn is not None
+    with pytest.raises(SqlTranslationError, match="outside"):
+        compile_case_expression(
+            "case when name_l = name_r then 1 + 2 else 0 end", num_levels=3
+        )
+
+
+def test_alias_suffix_tolerated():
+    # the reference appends "as gamma_<col>" to every user case_expression
+    fn = compile_case_expression(
+        "case when name_l = name_r then 1 else 0 end as gamma_name",
+        num_levels=2,
+    )
+    assert fn is not None

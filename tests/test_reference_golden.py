@@ -15,7 +15,6 @@ import numpy as np
 import pandas as pd
 import pytest
 
-import splink_tpu
 from splink_tpu.blocking import block_using_rules
 from splink_tpu.data import encode_table
 from splink_tpu.gammas import GammaProgram
@@ -25,25 +24,26 @@ from splink_tpu.models.fellegi_sunter import (
     sufficient_stats,
     update_params,
 )
-from splink_tpu.ops.gamma import apply_null
 from splink_tpu.settings import complete_settings_dict
 
-
-def _surname_exact_or_prefix3(ctx, col_settings):
-    """The reference fixture's surname CASE: exact -> 2, first-3-chars -> 1
-    (substr semantics: shorter strings compare their zero-padded prefix)."""
-    pc = ctx.col("surname")
-    exact = pc.tok_l == pc.tok_r
-    prefix3 = jnp.all(pc.chars_l[:, :3] == pc.chars_r[:, :3], axis=1)
-    gamma = jnp.where(
-        exact, jnp.int8(2), jnp.where(prefix3, jnp.int8(1), jnp.int8(0))
-    )
-    return apply_null(gamma, pc.null)
+# The reference fixture's surname case_expression, VERBATIM — including the
+# irregular whitespace and the "as gamma_surname" alias its settings
+# completion appends (/root/reference/tests/conftest.py:111-119). It must
+# run unmodified through the general CASE compiler inside the jitted gamma
+# program (substr -> static slice on the padded char arrays).
+REFERENCE_SURNAME_CASE = """
+            case
+            when surname_l is null or surname_r is null then -1
+            when surname_l = surname_r then 2
+            when substr(surname_l,1, 3) =  substr(surname_r, 1, 3) then 1
+            else 0
+            end
+            as gamma_surname
+            """
 
 
 @pytest.fixture
 def scenario():
-    splink_tpu.register_comparison("surname_exact_or_prefix3", _surname_exact_or_prefix3)
     df = pd.DataFrame(
         {
             "unique_id": [1, 2, 3, 4, 5, 6, 7],
@@ -66,7 +66,7 @@ def scenario():
                 {
                     "col_name": "surname",
                     "num_levels": 3,
-                    "comparison": {"kind": "custom", "fn": "surname_exact_or_prefix3"},
+                    "case_expression": REFERENCE_SURNAME_CASE,
                     "m_probabilities": [0.1, 0.2, 0.7],
                     "u_probabilities": [0.5, 0.25, 0.25],
                 },
@@ -74,6 +74,7 @@ def scenario():
             "blocking_rules": ["l.mob = r.mob", "l.surname = r.surname"],
         }
     )
+    assert settings["comparison_columns"][1]["comparison"]["kind"] == "case_sql"
     table = encode_table(df, settings)
     pairs = block_using_rules(settings, table)
     order = np.lexsort((table.unique_id[pairs.idx_r], table.unique_id[pairs.idx_l]))

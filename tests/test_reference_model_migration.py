@@ -196,3 +196,130 @@ def test_reference_model_streamed_regime(tmp_path):
     np.testing.assert_allclose(
         m.match_probability_a, m.match_probability_b, rtol=1e-5, atol=1e-7
     )
+
+
+# A reference-era user model with the reference's own fixture substr CASE
+# (/root/reference/tests/conftest.py:111-119) — including the alias the
+# reference's settings completion appends.
+SUBSTR_CASE = """case
+    when surname_l is null or surname_r is null then -1
+    when surname_l = surname_r then 2
+    when substr(surname_l,1, 3) =  substr(surname_r, 1, 3) then 1
+    else 0
+    end
+    as gamma_surname"""
+
+
+def test_load_reference_model_with_substr_case(tmp_path):
+    m_sn = [0.1, 0.2, 0.7]
+    u_sn = [0.5, 0.25, 0.25]
+    settings = {
+        "link_type": "dedupe_only",
+        "proportion_of_matches": 0.4,
+        "comparison_columns": [
+            {
+                "col_name": "surname",
+                "num_levels": 3,
+                "data_type": "string",
+                "case_expression": SUBSTR_CASE,
+                "m_probabilities": m_sn,
+                "u_probabilities": u_sn,
+                "gamma_index": 0,
+            }
+        ],
+        "blocking_rules": [],
+    }
+    current = {
+        "λ": 0.4,
+        "π": {"gamma_surname": _pi_entry("surname", 3, m_sn, u_sn, 0)},
+    }
+    path = tmp_path / "substr_model.json"
+    path.write_text(
+        json.dumps(
+            {
+                "current_params": current,
+                "historical_params": [current],
+                "settings": settings,
+            }
+        )
+    )
+    df = pd.DataFrame(
+        {
+            "unique_id": range(5),
+            "surname": ["Linacre", "Linacre", "Linacer", "Smith", None],
+        }
+    )
+    linker = load_from_json(str(path), df=df)
+    assert (
+        linker.settings["comparison_columns"][0]["comparison"]["kind"]
+        == "case_sql"
+    )
+    out = linker.manually_apply_fellegi_sunter_weights()
+    by_pair = {
+        (r.unique_id_l, r.unique_id_r): r.gamma_surname
+        for r in out.itertuples()
+    }
+    assert by_pair[(0, 1)] == 2  # exact
+    assert by_pair[(0, 2)] == 1  # first-3-chars
+    assert by_pair[(0, 3)] == 0  # different
+    assert by_pair[(0, 4)] == -1  # null
+
+
+# A reference-era model keyed on the jar's DoubleMetaphone UDF
+# (/root/reference/tests/test_spark.py:48): with the commons-codec-1.5
+# bit-exact encoder, the phonetic partition matches the reference exactly.
+DMETA_CASE = """case
+    when name_l is null or name_r is null then -1
+    when name_l = name_r then 2
+    when dmetaphone(name_l) = dmetaphone(name_r) then 1
+    else 0
+    end
+    as gamma_name"""
+
+
+def test_load_reference_model_with_dmetaphone_case(tmp_path):
+    m = [0.1, 0.2, 0.7]
+    u = [0.6, 0.25, 0.15]
+    settings = {
+        "link_type": "dedupe_only",
+        "proportion_of_matches": 0.3,
+        "comparison_columns": [
+            {
+                "col_name": "name",
+                "num_levels": 3,
+                "data_type": "string",
+                "case_expression": DMETA_CASE,
+                "m_probabilities": m,
+                "u_probabilities": u,
+                "gamma_index": 0,
+            }
+        ],
+        "blocking_rules": [],
+    }
+    current = {"λ": 0.3, "π": {"gamma_name": _pi_entry("name", 3, m, u, 0)}}
+    path = tmp_path / "dmeta_model.json"
+    path.write_text(
+        json.dumps(
+            {
+                "current_params": current,
+                "historical_params": [current],
+                "settings": settings,
+            }
+        )
+    )
+    df = pd.DataFrame(
+        {
+            "unique_id": range(4),
+            # smith/smyth share a dmetaphone code (SM0/XMT both sides);
+            # jones shares neither
+            "name": ["smith", "smyth", "jones", "smith"],
+        }
+    )
+    linker = load_from_json(str(path), df=df)
+    out = linker.manually_apply_fellegi_sunter_weights()
+    by_pair = {
+        (r.unique_id_l, r.unique_id_r): r.gamma_name for r in out.itertuples()
+    }
+    assert by_pair[(0, 3)] == 2  # exact
+    assert by_pair[(0, 1)] == 1  # phonetic
+    assert by_pair[(0, 2)] == 0  # different
