@@ -63,13 +63,34 @@ class PairIndex:
         return len(self.idx_l)
 
 
+def _proc_start_time(pid: int) -> int | None:
+    """The process's kernel start time (clock ticks since boot) from
+    /proc/<pid>/stat, or None where /proc is unavailable. Distinguishes a
+    live owner from an unrelated process that recycled its pid."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as fh:
+            data = fh.read().decode("ascii", "replace")
+        # field 22 (starttime); the comm field can contain spaces/parens so
+        # split after the LAST ')'
+        return int(data.rsplit(")", 1)[1].split()[19])
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _owner_token(pid: int) -> str:
+    start = _proc_start_time(pid)
+    return f"{pid} {start}" if start is not None else str(pid)
+
+
 def _sweep_stale_spill_dirs(spill_dir: str) -> None:
     """Reclaim splink_pairs_* dirs whose owning process is gone.
 
     The weakref finalizer on a spilled PairIndex never runs on
     SIGKILL/OOM-kill — the most likely death for a job big enough to spill —
-    so each spill dir records its owner pid and the next spilling run sweeps
-    dirs whose pid is dead, BEFORE it starts writing its own pair set. Dirs
+    so each spill dir records its owner pid (plus the pid's kernel start
+    time, so a recycled pid belonging to an unrelated live process doesn't
+    pin a multi-GB orphan forever) and the next spilling run sweeps dirs
+    whose owner is gone, BEFORE it starts writing its own pair set. Dirs
     without a pid file (mid-creation, or foreign) are left alone.
     """
     import os
@@ -86,8 +107,10 @@ def _sweep_stale_spill_dirs(spill_dir: str) -> None:
         pid_file = os.path.join(path, "owner.pid")
         try:
             with open(pid_file) as fh:
-                pid = int(fh.read().strip())
-        except (OSError, ValueError):
+                fields = fh.read().split()
+            pid = int(fields[0])
+            recorded_start = int(fields[1]) if len(fields) > 1 else None
+        except (OSError, IndexError, ValueError):
             continue
         if pid == os.getpid():
             continue
@@ -96,8 +119,23 @@ def _sweep_stale_spill_dirs(spill_dir: str) -> None:
         except ProcessLookupError:
             logger.info("reclaiming stale spill dir %s (pid %d dead)", path, pid)
             shutil.rmtree(path, ignore_errors=True)
+            continue
         except OSError:
-            continue  # e.g. EPERM: pid exists under another user
+            pass  # e.g. EPERM: pid exists under another user — but
+            # /proc/<pid>/stat is world-readable, so the start-time
+            # comparison below still detects a recycled pid
+        # pid is alive — but is it the same process that wrote the dir?
+        current_start = _proc_start_time(pid)
+        if (
+            recorded_start is not None
+            and current_start is not None
+            and current_start != recorded_start
+        ):
+            logger.info(
+                "reclaiming stale spill dir %s (pid %d recycled: start %d "
+                "!= recorded %d)", path, pid, current_start, recorded_start,
+            )
+            shutil.rmtree(path, ignore_errors=True)
 
 
 class _PairSink:
@@ -120,7 +158,7 @@ class _PairSink:
                 prefix="splink_pairs_", dir=spill_dir
             )
             with open(os.path.join(self.spill_tmp, "owner.pid"), "w") as fh:
-                fh.write(str(os.getpid()))
+                fh.write(_owner_token(os.getpid()))
             self._files = [
                 open(os.path.join(self.spill_tmp, f"{name}.bin"), "wb")
                 for name in ("idx_l", "idx_r")

@@ -527,18 +527,17 @@ class Splink:
         When df_e still corresponds row-for-row to this linker's pair index,
         the per-token aggregation runs on device over the encoded table's
         factorised token ids (segment_sum) instead of a host groupby."""
-        from .term_frequencies import make_adjustment_for_term_frequencies
+        from .term_frequencies import (
+            make_adjustment_for_term_frequencies,
+            term_frequency_columns,
+        )
 
         pair_token_ids = None
         if self._pairs is not None and self._df_e_aligned_with_pairs(df_e):
             table = self._ensure_encoded()
             pair_token_ids = {}
-            for c in self.settings["comparison_columns"]:
-                name = c.get("col_name")
-                if (
-                    c.get("term_frequency_adjustments")
-                    and name in table.strings
-                ):
+            for name in term_frequency_columns(self.settings):
+                if name in table.strings:
                     tid = table.strings[name].token_ids
                     pair_token_ids[name] = (
                         tid[self._pairs.idx_l],
@@ -671,7 +670,10 @@ class Splink:
                 if settings["retain_matching_columns"] or col["term_frequency_adjustments"]:
                     add_lr(name, table.column_values(name))
             else:
-                if settings["retain_matching_columns"]:
+                if (
+                    settings["retain_matching_columns"]
+                    or col["term_frequency_adjustments"]
+                ):
                     for used in col["custom_columns_used"]:
                         add_lr(used, table.column_values(used))
             cols[f"gamma_{name}"] = G[:, c].astype(np.int64)

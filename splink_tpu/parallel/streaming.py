@@ -47,6 +47,7 @@ def run_em_streamed(
     mesh=None,
     compute_ll: bool = False,
     on_iteration=None,
+    stats_reduce=None,
 ):
     """EM over a re-iterable stream of gamma batches.
 
@@ -57,6 +58,12 @@ def run_em_streamed(
             reference re-scans the persisted df_gammas).
         init: starting parameters.
         mesh: optional Mesh; batches are padded + sharded over the pair axis.
+        stats_reduce: optional callable applied to the pass's accumulated
+            SufficientStats before the parameter update. Multi-controller
+            runs pass ``parallel.distributed.all_sum_stats`` here so every
+            process updates from the GLOBAL aggregate while streaming only
+            its own ``global_pair_slice`` (the reference gets this from
+            Spark's global shuffle, maximisation_step.py:54-57).
         on_iteration: optional callback(iteration_index, FSParams, ll) run
             after each update — the save_state_fn hook's internal analogue.
 
@@ -99,6 +106,15 @@ def run_em_streamed(
                 ll_parts.append(ll)
         ll_total = float(jnp.sum(jnp.stack(ll_parts))) if ll_parts else 0.0
 
+        if stats_reduce is not None:
+            # reduce the log-likelihood with the SAME collective as the
+            # stats (one pytree, one allgather): each process streams only
+            # its slice, so the local ll is partial too
+            if compute_ll:
+                acc, ll_red = stats_reduce((acc, jnp.asarray(ll_total)))
+                ll_total = float(ll_red)
+            else:
+                acc = stats_reduce(acc)
         new = update_params(acc)
         delta = max(
             float(jnp.max(jnp.abs(new.m - params.m))),

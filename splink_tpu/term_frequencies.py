@@ -78,6 +78,27 @@ def compute_token_adjustment(values_l, values_r, match_probability, base_lambda)
     return adj, lookup
 
 
+def term_frequency_columns(settings: dict):
+    """Ordered, deduplicated raw columns to TF-adjust: the col_name of every
+    flagged comparison, and for a flagged custom/case_sql multi-column
+    comparison each of its custom_columns_used — the token aggregation only
+    needs raw values, not kernel knowledge, so any flagged comparison
+    participates (the reference's selection at
+    /root/reference/splink/term_frequencies.py:130-134 keys on col_name and
+    would KeyError on a custom comparison; per-used-column adjustment is the
+    natural extension of its per-column formula)."""
+    out: dict[str, None] = {}
+    for c in settings["comparison_columns"]:
+        if not c.get("term_frequency_adjustments"):
+            continue
+        if "col_name" in c:
+            out.setdefault(c["col_name"])
+        else:
+            for used in c.get("custom_columns_used", ()):
+                out.setdefault(used)
+    return out.keys()
+
+
 def _next_pow2(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length()
 
@@ -208,19 +229,7 @@ def make_adjustment_for_term_frequencies(
     present the per-token aggregation runs on device instead of a host
     groupby.
     """
-    tf_cols = []
-    for c in settings["comparison_columns"]:
-        if not c.get("term_frequency_adjustments"):
-            continue
-        if "col_name" in c:
-            tf_cols.append(c["col_name"])
-        else:
-            # a custom (multi-column) comparison has no single token column
-            # to aggregate by — same limitation as the reference
-            warnings.warn(
-                "term_frequency_adjustments is not supported for custom "
-                f"comparison {c.get('custom_name')!r}; skipping"
-            )
+    tf_cols = list(term_frequency_columns(settings))
     if not tf_cols:
         warnings.warn(
             "No term frequency adjustment columns are specified in your "

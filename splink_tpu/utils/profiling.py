@@ -24,7 +24,7 @@ class StageTimer(contextlib.AbstractContextManager):
         self._trace = None
 
     def __enter__(self):
-        if self.trace_dir:  # pragma: no cover - needs a profiler consumer
+        if self.trace_dir:
             import jax
 
             self._trace = jax.profiler.trace(self.trace_dir)
@@ -35,7 +35,7 @@ class StageTimer(contextlib.AbstractContextManager):
     def __exit__(self, *exc):
         self.elapsed = time.perf_counter() - self._t0
         _TIMINGS[self.stage].append(self.elapsed)
-        if self._trace is not None:  # pragma: no cover
+        if self._trace is not None:
             self._trace.__exit__(*exc)
         return False
 

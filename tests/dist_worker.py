@@ -1,0 +1,100 @@
+"""Worker for the two-process multi-controller EM test (not a test module).
+
+Launched twice by tests/test_multiprocess_em.py: each process joins the
+jax.distributed cluster over local TCP (CPU backend, Gloo collectives),
+streams ONLY its global_pair_slice of a deterministic gamma table through
+run_em_streamed, and relies on all_sum_stats to recover the global
+aggregate — the exact code path a physical multi-host pod runs.
+
+argv: <process_id> <num_processes> <port> <out_json>
+"""
+
+import json
+import sys
+
+
+def main():
+    pid, n_procs, port, out = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from splink_tpu.parallel.distributed import (
+        all_sum_stats,
+        global_pair_slice,
+        initialize_multihost,
+    )
+
+    initialize_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=n_procs,
+        process_id=pid,
+    )
+    assert jax.process_count() == n_procs, jax.process_count()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from splink_tpu.models.fellegi_sunter import FSParams
+    from splink_tpu.parallel.streaming import run_em_streamed
+
+    # identical on every process (same seed): the data-plane contract is
+    # that hosts see the same GLOBAL pair set and feed disjoint slices
+    rng = np.random.default_rng(42)
+    N = 5000
+    G = np.stack(
+        [
+            rng.integers(-1, 3, size=N),
+            rng.integers(-1, 2, size=N),
+        ],
+        axis=1,
+    ).astype(np.int8)
+
+    init = FSParams(
+        lam=jnp.float64(0.3),
+        m=jnp.asarray([[0.1, 0.2, 0.7], [0.2, 0.8, 0.0]], jnp.float64),
+        u=jnp.asarray([[0.7, 0.2, 0.1], [0.75, 0.25, 0.0]], jnp.float64),
+    )
+
+    sl = global_pair_slice(N)
+
+    def batches():
+        for s in range(sl.start, sl.stop, 1024):
+            yield G[s : min(s + 1024, sl.stop)]
+
+    params, hist, n_it, converged = run_em_streamed(
+        batches,
+        init,
+        max_iterations=6,
+        max_levels=3,
+        em_convergence=0.0,
+        compute_ll=True,  # the ll must ALSO be globally reduced
+        stats_reduce=all_sum_stats,
+    )
+
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "process_id": pid,
+                "process_count": jax.process_count(),
+                "slice": [sl.start, sl.stop],
+                "lam": float(params.lam),
+                "m": np.asarray(params.m).tolist(),
+                "u": np.asarray(params.u).tolist(),
+                "lam_hist": np.asarray(hist["lam"]).tolist(),
+                "ll_hist": np.asarray(hist["ll"]).tolist(),
+                "n_iterations": n_it,
+            },
+            f,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -229,16 +229,22 @@ def test_device_path_chunked_matches_single_chunk(monkeypatch):
     np.testing.assert_allclose(cnt_many, cnt_one, rtol=0)
 
 
-def test_tf_with_case_sql_column_and_custom_skip():
+def test_tf_with_case_sql_and_custom_multicolumn():
     """TF adjustment works on a col_name column whose comparison is a
-    compiled CASE expression; a custom multi-column comparison with the TF
-    flag warns and is skipped instead of KeyError-ing."""
-    import warnings
-
+    compiled CASE expression, AND on a custom multi-column comparison: each
+    of its custom_columns_used gets the per-column adjustment (the
+    reference's per-column formula extended to the multi-column case —
+    its own selection would KeyError there,
+    /root/reference/splink/term_frequencies.py:130-134)."""
     import numpy as np
     import pandas as pd
 
     from splink_tpu import Splink
+    from splink_tpu.term_frequencies import (
+        bayes_combine,
+        compute_token_adjustment,
+        term_frequency_columns,
+    )
 
     rng = np.random.default_rng(0)
     n = 120
@@ -272,11 +278,38 @@ def test_tf_with_case_sql_column_and_custom_skip():
         ],
         "max_iterations": 4,
     }
+    # flagged columns: "name" (col_name, deduped with combo's use) + "city"
+    assert list(term_frequency_columns(Splink(s, df=df).settings)) == [
+        "name",
+        "city",
+    ]
     linker = Splink(s, df=df)
     df_e = linker.get_scored_comparisons()
-    with warnings.catch_warnings(record=True) as caught:
-        warnings.simplefilter("always")
-        out = linker.make_term_frequency_adjustments(df_e)
+    out = linker.make_term_frequency_adjustments(df_e)
     assert "tf_adjusted_match_prob" in out.columns
     assert np.isfinite(out.tf_adjusted_match_prob.to_numpy()).all()
-    assert any("combo" in str(w.message) for w in caught)
+    # the custom comparison forced retention of its used columns even
+    # without retain_matching_columns
+    assert "city_l" in df_e.columns and "city_r" in df_e.columns
+    # adjustment columns for BOTH flagged raw columns (linker retains them)
+    assert "name_adj" in out.columns and "city_adj" in out.columns
+
+    # oracle: reference formulas computed on the host over raw values
+    base_lambda = linker.params.params["λ"]
+    p = df_e["match_probability"].to_numpy()
+    want = {}
+    for col in ("name", "city"):
+        want[col], _ = compute_token_adjustment(
+            df_e[f"{col}_l"].to_numpy(object),
+            df_e[f"{col}_r"].to_numpy(object),
+            p,
+            base_lambda,
+        )
+        np.testing.assert_allclose(
+            out[f"{col}_adj"].to_numpy(), want[col], rtol=1e-9
+        )
+    np.testing.assert_allclose(
+        out["tf_adjusted_match_prob"].to_numpy(),
+        bayes_combine([p, want["name"], want["city"]]),
+        rtol=1e-9,
+    )
