@@ -242,7 +242,24 @@ def _ranges(counts: np.ndarray) -> np.ndarray:
 
 
 def _key_codes(table: EncodedTable, cols: list[str]) -> np.ndarray:
-    """Combined int64 key codes for a list of columns; -1 where any is null."""
+    """Combined int64 key codes for a list of columns; -1 where any is null.
+
+    Cached per column tuple on the table instance (the `_uid_ranks`
+    pattern): the overlap regime estimator and the blocking joins use the
+    same keys, and refactorising billion-row columns twice would put
+    minutes of duplicate work on the critical path."""
+    cache = getattr(table, "_key_code_cache", None)
+    if cache is None:
+        cache = table._key_code_cache = {}
+    key = tuple(cols)
+    if key in cache:
+        return cache[key]
+    out = _key_codes_uncached(table, cols)
+    cache[key] = out
+    return out
+
+
+def _key_codes_uncached(table: EncodedTable, cols: list[str]) -> np.ndarray:
     combined: np.ndarray | None = None
     for col in cols:
         codes = _single_col_codes(table, col)
@@ -448,8 +465,53 @@ def _eval_residual(table: EncodedTable, residual: str, i: np.ndarray, j: np.ndar
 
 
 @check_types
-def block_using_rules(
+def estimate_pair_upper_bound(
     settings: dict, table: EncodedTable, n_left: int | None = None
+) -> int:
+    """Cheap O(n) upper bound on the candidate-pair count: per-rule join
+    sizes from key-group histograms, ignoring sequential-rule dedup and
+    residual filters (both only remove pairs). The linker uses it to pick
+    the overlap consumer BEFORE blocking runs — resident-size jobs stream
+    the gamma matrix (keeping it device-resident for EM), larger ones
+    stream 3-byte pattern ids."""
+    link_type = settings["link_type"]
+    rules = settings.get("blocking_rules") or []
+    n = table.n_rows
+    if not rules:
+        if link_type == "link_only":
+            assert n_left is not None
+            return n_left * (n - n_left)
+        return n * (n - 1) // 2
+    total = 0
+    for rule in rules:
+        eq_pairs, residual = parse_blocking_rule(rule)
+        join_cols, residual = _split_join_keys(eq_pairs, residual)
+        if not join_cols:
+            total += n * n
+            continue
+        codes = _key_codes(table, join_cols)
+        if link_type == "link_only":
+            assert n_left is not None
+            cl, cr = codes[:n_left], codes[n_left:]
+            m = int(codes.max()) + 1 if len(codes) else 1
+            if m <= 0:
+                continue
+            hl = np.bincount(cl[cl >= 0], minlength=m).astype(np.int64)
+            hr = np.bincount(cr[cr >= 0], minlength=m).astype(np.int64)
+            total += int(hl @ hr)
+        else:
+            valid = codes[codes >= 0]
+            if len(valid):
+                cnt = np.bincount(valid).astype(np.int64)
+                total += int((cnt * (cnt - 1) // 2).sum())
+    return total
+
+
+def block_using_rules(
+    settings: dict,
+    table: EncodedTable,
+    n_left: int | None = None,
+    pair_consumer=None,
 ) -> PairIndex:
     """Generate candidate pairs for the given settings.
 
@@ -459,11 +521,19 @@ def block_using_rules(
             is the vertical concatenation of both inputs (rows [0, n_left)
             from the left input).
         n_left: number of left-input rows (link types only).
+        pair_consumer: optional callable(i, j) invoked with every pair chunk
+            in emission order, right after it is sunk. The linker passes a
+            device-scoring stream here so gamma/pattern computation OVERLAPS
+            blocking (jax dispatch is async: the accelerator crunches rule
+            k's pairs while the host joins rule k+1) instead of a second
+            sweep over the finished — possibly disk-spilled — pair index.
+            Spark got this overlap for free from lazy evaluation
+            (/root/reference/splink/blocking.py:210).
     """
     link_type = settings["link_type"]
     rules = settings.get("blocking_rules") or []
     if not rules:
-        return cartesian_block(settings, table, n_left)
+        return cartesian_block(settings, table, n_left, pair_consumer)
 
     # Pair indices are stored int32 when the table allows (they always do —
     # int32 row indices cover 2^31 rows); at billions of candidate pairs this
@@ -483,7 +553,8 @@ def block_using_rules(
     sink = _PairSink(settings.get("spill_dir"), idx_dtype)
     try:
         return _block_rules_into(
-            sink, rules, settings, table, link_type, all_rows, n_left, prior_rules
+            sink, rules, settings, table, link_type, all_rows, n_left,
+            prior_rules, pair_consumer,
         )
     except BaseException:
         sink.abort()
@@ -491,7 +562,8 @@ def block_using_rules(
 
 
 def _block_rules_into(
-    sink, rules, settings, table, link_type, all_rows, n_left, prior_rules
+    sink, rules, settings, table, link_type, all_rows, n_left, prior_rules,
+    pair_consumer=None,
 ) -> PairIndex:
     if link_type == "link_only":
         assert n_left is not None
@@ -532,6 +604,11 @@ def _block_rules_into(
         prior_rules.append((codes, residual))
         n_new = len(i)
         sink.append(i, j)
+        if pair_consumer is not None:
+            pair_consumer(
+                i.astype(sink.idx_dtype, copy=False),
+                j.astype(sink.idx_dtype, copy=False),
+            )
         del i, j
         logger.debug("blocking rule %r -> %d new pairs", rule, n_new)
 
@@ -625,7 +702,10 @@ def _iter_all_pairs_chunks(table: EncodedTable, link_type: str, n_left, chunk):
 
 
 def cartesian_block(
-    settings: dict, table: EncodedTable, n_left: int | None = None
+    settings: dict,
+    table: EncodedTable,
+    n_left: int | None = None,
+    pair_consumer=None,
 ) -> PairIndex:
     """All pairwise comparisons (the fallback when no rules are given,
     /root/reference/splink/blocking.py:183-184, 219-318). With spill_dir the
@@ -636,9 +716,11 @@ def cartesian_block(
     if not spill_dir:
         i, j = _all_pairs(table, link_type, n_left)
         i, j = _orient_pairs(table, link_type, i, j)
-        return PairIndex(
-            i.astype(idx_dtype, copy=False), j.astype(idx_dtype, copy=False)
-        )
+        i = i.astype(idx_dtype, copy=False)
+        j = j.astype(idx_dtype, copy=False)
+        if pair_consumer is not None:
+            pair_consumer(i, j)
+        return PairIndex(i, j)
     sink = _PairSink(spill_dir, idx_dtype)
     try:
         for i, j in _iter_all_pairs_chunks(
@@ -646,6 +728,11 @@ def cartesian_block(
         ):
             i, j = _orient_pairs(table, link_type, i, j)
             sink.append(i, j)
+            if pair_consumer is not None:
+                pair_consumer(
+                    i.astype(idx_dtype, copy=False),
+                    j.astype(idx_dtype, copy=False),
+                )
         return sink.finish()
     except BaseException:
         sink.abort()

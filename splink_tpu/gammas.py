@@ -605,6 +605,188 @@ class GammaProgram:
         return out, dev
 
 
+class _StreamBatcher:
+    """Re-batches arbitrary-size (idx_l, idx_r) chunks into fixed
+    ``batch_size`` device batches (same boundaries as a single pass over the
+    concatenated pair order, so results are bitwise identical to the
+    non-streamed paths). Subclasses implement _emit(bl, br, valid)."""
+
+    def __init__(self, batch_size: int):
+        self.batch_size = batch_size
+        self.total = 0
+        self._buf_l: np.ndarray | None = None
+        self._buf_r: np.ndarray | None = None
+        self._fill = 0
+
+    def feed(self, i: np.ndarray, j: np.ndarray) -> None:
+        b = self.batch_size
+        self.total += len(i)
+        pos = 0
+        if self._fill:
+            take = min(b - self._fill, len(i))
+            self._buf_l[self._fill : self._fill + take] = i[:take]
+            self._buf_r[self._fill : self._fill + take] = j[:take]
+            self._fill += take
+            pos = take
+            if self._fill == b:
+                self._emit(self._buf_l.copy(), self._buf_r.copy(), b)
+                self._fill = 0
+        # full batches straight from the chunk (no buffering copy)
+        while len(i) - pos >= b:
+            self._emit(i[pos : pos + b], j[pos : pos + b], b)
+            pos += b
+        rest = len(i) - pos
+        if rest:
+            if self._buf_l is None:
+                self._buf_l = np.empty(b, i.dtype)
+                self._buf_r = np.empty(b, j.dtype)
+            self._buf_l[self._fill : self._fill + rest] = i[pos:]
+            self._buf_r[self._fill : self._fill + rest] = j[pos:]
+            self._fill += rest
+
+    def _flush_tail(self) -> None:
+        if self._fill:
+            bl = self._buf_l.copy()
+            br = self._buf_r.copy()
+            bl[self._fill :] = 0  # padded rows, masked by valid
+            br[self._fill :] = 0
+            self._emit(bl, br, self._fill)
+            self._fill = 0
+
+
+class GammaStream(_StreamBatcher):
+    """Incremental gamma computation: feed pair chunks as blocking emits
+    them; device batches dispatch asynchronously so scoring overlaps the
+    host's next join. finish() returns (host G, device G | None) exactly as
+    GammaProgram.compute_with_device would for the concatenated pairs.
+
+    ``keep_device_limit`` bounds the HBM held by kept batches: once total
+    fed pairs exceed it the device copies are dropped (the run is headed
+    for a streamed/pattern regime that re-uploads anyway).
+    """
+
+    def __init__(self, program: "GammaProgram", batch_size: int,
+                 keep_device_limit: int = 0):
+        super().__init__(batch_size)
+        self.program = program
+        self.keep_limit = keep_device_limit
+        self._pending: tuple[int, jnp.ndarray] | None = None
+        self._out_parts: list[np.ndarray] = []
+        self._device_batches: list[jnp.ndarray] | None = (
+            [] if keep_device_limit > 0 else None
+        )
+
+    def _emit(self, bl, br, valid):
+        G = self.program._gamma_batch(jnp.asarray(bl), jnp.asarray(br))[:valid]
+        if self._device_batches is not None:
+            if self.total <= self.keep_limit:
+                self._device_batches.append(G)
+            else:
+                self._device_batches = None  # too big: free HBM
+        # double buffer: read back the PREVIOUS batch (it has finished by
+        # the time the next one is dispatched), keeping dispatch async
+        if self._pending is not None:
+            v, prev = self._pending
+            self._out_parts.append(np.asarray(prev)[:v])
+        self._pending = (valid, G)
+
+    def finish(self):
+        self._flush_tail()
+        if self._pending is not None:
+            v, prev = self._pending
+            self._out_parts.append(np.asarray(prev)[:v])
+            self._pending = None
+        n_cols = self.program.n_cols
+        if not self._out_parts:
+            host = np.zeros((0, n_cols), np.int8)
+            return host, None
+        # fill a preallocated matrix, releasing parts as they are copied —
+        # peak host RAM is matrix + one batch, not 2x matrix (concatenate)
+        host = np.empty((self.total, n_cols), np.int8)
+        pos = 0
+        parts = self._out_parts
+        self._out_parts = []
+        parts.reverse()
+        while parts:
+            part = parts.pop()
+            host[pos : pos + len(part)] = part
+            pos += len(part)
+        assert pos == self.total
+        dev = None
+        if self._device_batches is not None and self.total <= self.keep_limit:
+            dev = (
+                self._device_batches[0]
+                if len(self._device_batches) == 1
+                else jnp.concatenate(self._device_batches)
+            )
+        return host, dev
+
+
+class PatternStream(_StreamBatcher):
+    """Incremental pattern-id pipeline: feed pair chunks, finish() returns
+    (pattern_ids, counts) exactly as compute_pattern_ids would — the gamma
+    matrix never materialises, and the device pass happens WHILE blocking
+    still runs instead of as a second sweep over the (possibly spilled)
+    pair index."""
+
+    def __init__(self, program: "GammaProgram", batch_size: int):
+        super().__init__(batch_size)
+        if program._pattern_batch is None:
+            raise ValueError(
+                f"pattern space {program.n_patterns} exceeds MAX_PATTERNS "
+                f"({MAX_PATTERNS}); use GammaStream"
+            )
+        self.program = program
+        self.id_dtype = (
+            np.uint16 if program.n_patterns <= (1 << 16) else np.int32
+        )
+        self._parts: list[np.ndarray] = []
+        self._pending: tuple[int, jnp.ndarray] | None = None
+        self._acc = jnp.zeros(program.n_patterns + 1, jnp.int32)
+        self._in_acc = 0
+        self._flush_every = max(
+            min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1
+        )
+        self._total_counts = np.zeros(program.n_patterns, np.int64)
+
+    def _emit(self, bl, br, valid):
+        pid, self._acc = self.program._pattern_batch(
+            jnp.asarray(bl), jnp.asarray(br), valid, self._acc
+        )
+        if self._pending is not None:
+            v, prev = self._pending
+            self._parts.append(np.asarray(prev)[:v].astype(self.id_dtype))
+        self._pending = (valid, pid)
+        self._in_acc += 1
+        if self._in_acc >= self._flush_every:
+            self._total_counts += np.asarray(self._acc[:-1], np.int64)
+            self._acc = jnp.zeros(self.program.n_patterns + 1, jnp.int32)
+            self._in_acc = 0
+
+    def finish(self):
+        self._flush_tail()
+        if self._pending is not None:
+            v, prev = self._pending
+            self._parts.append(np.asarray(prev)[:v].astype(self.id_dtype))
+            self._pending = None
+        if self._in_acc:
+            self._total_counts += np.asarray(self._acc[:-1], np.int64)
+            self._in_acc = 0
+        # preallocate-and-fill (see GammaStream.finish): peak = ids + one
+        # batch instead of 2x ids
+        pids = np.empty(self.total, self.id_dtype)
+        pos = 0
+        parts = self._parts
+        self._parts = []
+        parts.reverse()
+        while parts:
+            part = parts.pop()
+            pids[pos : pos + len(part)] = part
+            pos += len(part)
+        assert pos == self.total
+        return pids, self._total_counts
+
+
 def pattern_strides_for(level_counts: list[int]) -> tuple[list[int], int]:
     """Mixed-radix strides and total pattern count for gamma vectors with
     the given per-column level counts (digit c = gamma_c + 1)."""

