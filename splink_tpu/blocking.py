@@ -259,6 +259,14 @@ def _key_codes(table: EncodedTable, cols: list[str]) -> np.ndarray:
     return out
 
 
+def clear_key_code_cache(table: EncodedTable) -> None:
+    """Drop the per-table key-code cache once its consumers (estimator,
+    plan build, blocking joins) are done — at billions of rows each cached
+    tuple is an 8-bytes-per-row array that must not outlive blocking."""
+    if getattr(table, "_key_code_cache", None):
+        table._key_code_cache = {}
+
+
 def _key_codes_uncached(table: EncodedTable, cols: list[str]) -> np.ndarray:
     combined: np.ndarray | None = None
     for col in cols:

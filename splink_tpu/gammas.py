@@ -447,6 +447,10 @@ class GammaProgram:
             return jnp.stack(gammas, axis=1)
 
         self._gamma_batch = lambda il, ir: _gamma_batch_p(self._packed, il, ir)
+        # the pure (packed-explicit) jitted fn, for composition into larger
+        # jitted programs (pairgen's virtual pair kernels) without turning
+        # the packed table into a jaxpr constant
+        self._gamma_batch_fn = _gamma_batch_p
 
         # The compiled-artifact analogue of the reference logging its
         # generated SQL at debug level (/root/reference/splink/gammas.py:120).
@@ -461,6 +465,7 @@ class GammaProgram:
         # gather with no further device traffic.
         self.level_counts = [int(c["num_levels"]) for c in cols]
         strides, self.n_patterns = pattern_strides_for(self.level_counts)
+        self._pattern_strides = strides
         if self.n_patterns <= MAX_PATTERNS:
             strides_dev = jnp.asarray(strides, jnp.int32)
 
@@ -653,6 +658,19 @@ class _StreamBatcher:
             self._emit(bl, br, self._fill)
             self._fill = 0
 
+    @staticmethod
+    def _drain_parts(parts: list[np.ndarray], out: np.ndarray) -> None:
+        """Fill a preallocated output from the buffered parts, releasing
+        each as it is copied — peak host RAM is output + one batch, not 2x
+        output (np.concatenate)."""
+        pos = 0
+        parts.reverse()
+        while parts:
+            part = parts.pop()
+            out[pos : pos + len(part)] = part
+            pos += len(part)
+        assert pos == len(out)
+
 
 class GammaStream(_StreamBatcher):
     """Incremental gamma computation: feed pair chunks as blocking emits
@@ -700,18 +718,10 @@ class GammaStream(_StreamBatcher):
         if not self._out_parts:
             host = np.zeros((0, n_cols), np.int8)
             return host, None
-        # fill a preallocated matrix, releasing parts as they are copied —
-        # peak host RAM is matrix + one batch, not 2x matrix (concatenate)
         host = np.empty((self.total, n_cols), np.int8)
-        pos = 0
         parts = self._out_parts
         self._out_parts = []
-        parts.reverse()
-        while parts:
-            part = parts.pop()
-            host[pos : pos + len(part)] = part
-            pos += len(part)
-        assert pos == self.total
+        self._drain_parts(parts, host)
         dev = None
         if self._device_batches is not None and self.total <= self.keep_limit:
             dev = (
@@ -772,18 +782,10 @@ class PatternStream(_StreamBatcher):
         if self._in_acc:
             self._total_counts += np.asarray(self._acc[:-1], np.int64)
             self._in_acc = 0
-        # preallocate-and-fill (see GammaStream.finish): peak = ids + one
-        # batch instead of 2x ids
         pids = np.empty(self.total, self.id_dtype)
-        pos = 0
         parts = self._parts
         self._parts = []
-        parts.reverse()
-        while parts:
-            part = parts.pop()
-            pids[pos : pos + len(part)] = part
-            pos += len(part)
-        assert pos == self.total
+        self._drain_parts(parts, pids)
         return pids, self._total_counts
 
 

@@ -86,6 +86,9 @@ class Splink:
         self._P: np.ndarray | None = None  # per-pair pattern ids (streamed)
         self._pattern_counts: np.ndarray | None = None
         self._pattern_program = None
+        self._virtual = None  # pairgen.VirtualPlan (device pair generation)
+        self._virtual_checked = False
+        self._pair_bound: int | None = None  # estimate_pair_upper_bound memo
 
     # ------------------------------------------------------------------
 
@@ -194,6 +197,9 @@ class Splink:
             self._maybe_spill_pairs()
             if stream is not None:
                 self._finish_overlap(stream)
+            from .blocking import clear_key_code_cache
+
+            clear_key_code_cache(table)
         return self._pairs
 
     def _overlap_stream(self, table: EncodedTable):
@@ -215,7 +221,6 @@ class Splink:
         take GammaStream."""
         if not self.settings.get("overlap_blocking", True):
             return None
-        from .blocking import estimate_pair_upper_bound
         from .gammas import GammaStream, PatternStream
 
         program = GammaProgram(
@@ -223,23 +228,16 @@ class Splink:
         )
         mesh = mesh_from_settings(self.settings)
         max_resident = int(self.settings["max_resident_pairs"])
-        bound = estimate_pair_upper_bound(self.settings, table, self._n_left)
+        bound = self._estimate_pair_bound(table)
         # clamp the device batch to the job bound (like the sequential
         # paths clamp to n) so a small job doesn't pad its single batch up
         # to pair_batch_size
         batch = int(self.settings["pair_batch_size"])
         batch = max(min(batch, -(-max(bound, 1) // 8) * 8), 1024)
-        has_custom = any(
-            (c.get("comparison") or {}).get("kind") == "custom"
-            for c in self.settings["comparison_columns"]
-        )
-        if (
-            bound > max_resident
-            and not has_custom
-            and mesh is None  # mesh runs shard host G; pattern ids would
-            # only be decoded back to a full host matrix
-            and program._pattern_batch is not None
-        ):
+        # _pattern_capable covers the custom-kernel, mesh, and
+        # pattern-space conditions — the same eligibility the
+        # post-blocking pipeline choice uses
+        if bound > max_resident and self._pattern_capable():
             self._pattern_program = program
             return PatternStream(program, batch)
         keep_limit = max_resident if mesh is None else 0
@@ -300,16 +298,13 @@ class Splink:
                 )
         return self._G
 
-    def _use_pattern_pipeline(self) -> bool:
-        """Whether the streamed pattern-id pipeline applies: large pair set,
-        bounded pattern space, no mesh (the mesh path shards gamma batches),
-        and no custom comparison kernels — a registered kernel could emit
-        gammas outside [-1, num_levels-1], which would alias pattern ids."""
+    def _pattern_capable(self) -> bool:
+        """Static part of the pattern-pipeline test: bounded pattern space,
+        no mesh (the mesh path shards gamma batches), and no custom
+        comparison kernels — a registered kernel could emit gammas outside
+        [-1, num_levels-1], which would alias pattern ids."""
         from .gammas import MAX_PATTERNS, pattern_strides_for
 
-        pairs = self._ensure_pairs()
-        if pairs.n_pairs <= int(self.settings["max_resident_pairs"]):
-            return False
         if mesh_from_settings(self.settings) is not None:
             return False
         for c in self.settings["comparison_columns"]:
@@ -321,6 +316,63 @@ class Splink:
         _, n_patterns = pattern_strides_for(level_counts)
         return n_patterns <= MAX_PATTERNS
 
+    def _estimate_pair_bound(self, table: EncodedTable) -> int:
+        if self._pair_bound is None:
+            from .blocking import estimate_pair_upper_bound
+
+            self._pair_bound = estimate_pair_upper_bound(
+                self.settings, table, self._n_left
+            )
+        return self._pair_bound
+
+    def _virtual_plan(self):
+        """The device-pair-generation plan, or None (pairgen module
+        docstring has the full story). Checked once: the plan build does
+        the per-rule key/sort work host blocking would do anyway, so a
+        rejected plan costs nothing extra overall."""
+        if self._virtual_checked:
+            return self._virtual
+        self._virtual_checked = True
+        mode = self.settings.get("device_pair_generation", "auto")
+        if mode == "off" or not self._pattern_capable():
+            return None
+        from .pairgen import build_virtual_plan
+
+        table = self._ensure_encoded()
+        if mode == "auto":
+            # small jobs: the resident/overlap paths are already optimal
+            bound = self._estimate_pair_bound(table)
+            if bound <= int(self.settings["max_resident_pairs"]):
+                return None
+        with StageTimer("pairgen_plan"):
+            self._virtual = build_virtual_plan(
+                self.settings, table, self._n_left
+            )
+        if self._virtual is not None:
+            # the int64 key-code cache fed the estimator and the plan;
+            # the plan keeps its own int32 copies — don't retain both
+            from .blocking import clear_key_code_cache
+
+            clear_key_code_cache(table)
+        if self._virtual is not None:
+            logger.info(
+                "device pair generation: %d candidate positions, %d rules",
+                self._virtual.n_candidates,
+                len(self._virtual.rules),
+            )
+        return self._virtual
+
+    def _use_pattern_pipeline(self) -> bool:
+        """Whether the streamed pattern-id pipeline applies: device pair
+        generation active, or a large materialised pair set with
+        pattern-capable settings."""
+        if self._virtual_plan() is not None:
+            return True
+        if not self._pattern_capable():
+            return False
+        pairs = self._ensure_pairs()
+        return pairs.n_pairs > int(self.settings["max_resident_pairs"])
+
     def _ensure_pattern_ids(self):
         """(pattern_ids, counts, program): ONE device pass over the pair
         index computing gammas, pattern ids and their histogram. The gamma
@@ -330,6 +382,28 @@ class Splink:
         host<->device traffic to a single pass over the pairs."""
         if self._P is None:
             table = self._ensure_encoded()
+            if self._virtual_plan() is not None:
+                # device pair generation: pairs decode on device from the
+                # plan's unit structure; nothing is materialised or
+                # transferred per pair
+                from .pairgen import compute_virtual_pattern_ids
+
+                with StageTimer("gammas_patterns"):
+                    self._pattern_program = GammaProgram(
+                        self.settings, table, float_dtype=self._float_dtype
+                    )
+                    self._P, self._pattern_counts, n_real = (
+                        compute_virtual_pattern_ids(
+                            self._pattern_program,
+                            self._virtual,
+                            int(self.settings["pair_batch_size"]),
+                        )
+                    )
+                logger.info(
+                    "device pair generation scored %d pairs (%d candidate "
+                    "positions)", n_real, self._virtual.n_candidates,
+                )
+                return self._P, self._pattern_counts, self._pattern_program
             pairs = self._ensure_pairs()
             with StageTimer("gammas_patterns"):
                 self._pattern_program = GammaProgram(
@@ -362,6 +436,9 @@ class Splink:
         """Yield scored chunks from the pattern-id pipeline: pure numpy LUT
         gathers per chunk, no device round-trips."""
         P, _, _ = self._ensure_pattern_ids()
+        if self._virtual is not None:
+            yield from self._stream_virtual_chunks(P)
+            return
         pairs = self._ensure_pairs()
         PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
         batch = int(self.settings["pair_batch_size"])
@@ -376,6 +453,45 @@ class Splink:
                     p_lut[Pc],
                     pm_lut[Pc] if pm_lut is not None else None,
                     pu_lut[Pc] if pu_lut is not None else None,
+                )
+
+    def _stream_virtual_chunks(self, P):
+        """Scored chunks under device pair generation: per chunk, filter the
+        masked sentinel positions, decode (idx_l, idx_r) host-side from the
+        plan's unit structure (f64 is exact on the host), and LUT-score."""
+        from .pairgen import decode_positions
+
+        plan = self._virtual
+        PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
+        sentinel = self._pattern_program.n_patterns
+        offsets = plan.rule_offsets()
+        batch = int(self.settings["pair_batch_size"])
+        with StageTimer("score_patterns"):
+            for s in range(0, len(P), batch):
+                e = min(s + batch, len(P))
+                Pc = P[s:e].astype(np.int32, copy=False)
+                keep = Pc != sentinel
+                if not keep.any():
+                    continue
+                qs = np.arange(s, e, dtype=np.int64)[keep]
+                il = np.empty(len(qs), np.int64)
+                ir = np.empty(len(qs), np.int64)
+                rule_idx = np.searchsorted(offsets, qs, side="right") - 1
+                for r in np.unique(rule_idx):
+                    m = rule_idx == r
+                    i, j, _ = decode_positions(
+                        plan, int(r), qs[m] - offsets[r]
+                    )
+                    il[m] = i
+                    ir[m] = j
+                Pk = Pc[keep]
+                yield self._assemble_df_e(
+                    PM[Pk],
+                    il,
+                    ir,
+                    p_lut[Pk],
+                    pm_lut[Pk] if pm_lut is not None else None,
+                    pu_lut[Pk] if pu_lut is not None else None,
                 )
 
     def _run_em_patterns(self, compute_ll: bool) -> None:

@@ -1,0 +1,473 @@
+"""Device-side candidate-pair generation: the virtual pair index.
+
+The measured bottleneck at the 10M-row configs is HOST pair
+materialisation — the joins emit 8.2M pairs/s single-threaded while the
+chip scores 28M+/s (BENCHMARKS.md), and every pair costs 8 bytes of
+host->device index traffic plus (spilled) 8 bytes of disk write and
+re-read. This module removes the pairs from the host entirely for
+equality-rule blocking: pairs are DECODED ON DEVICE from per-rule group
+structure, the sequential-rule dedup becomes an on-device mask, and the
+gamma/pattern program consumes them in the same kernel — per batch the
+host ships only a few KB of unit metadata. The reference leaned on Spark
+to materialise the same join (/root/reference/splink/blocking.py:145-158);
+a TPU has no shuffle engine, but it doesn't need one: a blocked self-join
+is group arithmetic, and arithmetic is what the chip does.
+
+Decomposition. Each rule's non-null key groups (rows sorted by uid rank
+then grouped by key code — exactly `_self_join`'s layout, so orientation
+is free) split into UNITS of bounded extent:
+
+  * triangle  — all unordered pairs within one chunk of <= CHUNK rows;
+  * rectangle — all cross pairs between two chunks of <= CHUNK rows
+    (two chunks of one group, or a left x right chunk pair in link_only).
+
+Bounded extent is what makes the device decode exact WITHOUT int64/f64
+(TPU has neither by default): within a unit the pair offset t fits int32,
+the triangle discriminant (2s-1)^2 - 8t stays below 2^24 so the f32 sqrt
+is exact (one +-1 integer correction), and a rectangle decode is an int32
+div/mod. Positions across units are int64 ONLY on the host: each device
+batch receives the batch-relative int32 slice of the unit cumulative-pair
+table plus a scalar unit offset.
+
+Masking replaces dropping (XLA wants static shapes): a pair whose uid
+keys collide (duplicate-uid inputs) or for which an EARLIER rule's
+predicate holds (the reference's ``AND NOT ifnull(prev, false)``,
+/root/reference/splink/blocking.py:59-68) gets the sentinel pattern id
+``n_patterns`` and falls out of the histogram's overflow bucket; the
+output stream filters the sentinel when decoding chunks host-side.
+
+Supported: dedupe_only and link_only with pure-equality rules (no
+residual predicates) on a single device. Everything else falls back to
+the host blocking pipeline unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .blocking import (
+    _key_codes,
+    _sort_groups,
+    _split_join_keys,
+    _uid_ranks,
+    parse_blocking_rule,
+)
+from .data import EncodedTable
+
+# Unit extent bound. 2048 keeps the triangle discriminant (2s-1)^2 < 2^24
+# (f32-exact) and a rectangle's pair count at 2048^2 ~ 4.2M (int32-safe);
+# tests shrink it to force multi-chunk group splitting on tiny data.
+CHUNK = 2048
+
+
+@dataclass
+class RulePlan:
+    """One rule's device-decodable join structure."""
+
+    order: np.ndarray  # (n_valid,) int32 rows sorted by (key code, uid rank)
+    ua: np.ndarray  # (U,) int32 unit a-side start into `order`
+    la: np.ndarray  # (U,) int32 a-side extent (<= CHUNK)
+    ub: np.ndarray  # (U,) int32 b-side start (== ua for triangles)
+    lb: np.ndarray  # (U,) int32 b-side extent
+    pc: np.ndarray  # (U+1,) int64 cumulative pair counts over units
+
+    @property
+    def total(self) -> int:
+        return int(self.pc[-1]) if len(self.pc) else 0
+
+
+@dataclass
+class VirtualPlan:
+    rules: list[RulePlan]
+    codes: np.ndarray  # (R, n) int32 per-rule key codes (device dedup mask)
+    uid_codes: np.ndarray | None  # (n,) int32 when duplicate uids exist
+    n_candidates: int  # sum of rule totals (mask not yet applied)
+
+    def rule_offsets(self) -> np.ndarray:
+        """(R+1,) int64 global position offset of each rule's segment."""
+        return np.concatenate(
+            [[0], np.cumsum([rp.total for rp in self.rules])]
+        ).astype(np.int64)
+
+
+def _split_extents(n: int, chunk: int) -> np.ndarray:
+    """[chunk, chunk, ..., remainder] covering n."""
+    k = -(-n // chunk)
+    out = np.full(k, chunk, np.int64)
+    if n % chunk:
+        out[-1] = n % chunk
+    return out
+
+
+def _units_for_self_join(starts, sizes, chunk):
+    """Triangle + rectangle units for within-group pairs, group by group."""
+    ua, la, ub, lb = [], [], [], []
+    big = sizes > chunk
+    # fast path: single-chunk groups (one triangle each)
+    small = (~big) & (sizes >= 2)
+    ua.append(starts[small])
+    la.append(sizes[small])
+    ub.append(starts[small])
+    lb.append(sizes[small])
+    key = [np.flatnonzero(small).astype(np.int64) * (1 << 20)]
+    for gi in np.flatnonzero(big):
+        s0, s = int(starts[gi]), int(sizes[gi])
+        exts = _split_extents(s, chunk)
+        offs = np.concatenate([[0], np.cumsum(exts)])[:-1] + s0
+        k = len(exts)
+        gua, gla, gub, glb = [], [], [], []
+        for c in range(k):
+            gua.append(offs[c])
+            gla.append(exts[c])
+            gub.append(offs[c])
+            glb.append(exts[c])
+            for c2 in range(c + 1, k):
+                gua.append(offs[c])
+                gla.append(exts[c])
+                gub.append(offs[c2])
+                glb.append(exts[c2])
+        ua.append(np.asarray(gua, np.int64))
+        la.append(np.asarray(gla, np.int64))
+        ub.append(np.asarray(gub, np.int64))
+        lb.append(np.asarray(glb, np.int64))
+        key.append(
+            gi * (1 << 20) + 1 + np.arange(len(gua), dtype=np.int64)
+        )
+    ua = np.concatenate(ua)
+    la = np.concatenate(la)
+    ub = np.concatenate(ub)
+    lb = np.concatenate(lb)
+    key = np.concatenate(key)
+    # deterministic unit order: by (group, within-group unit sequence)
+    o = np.argsort(key, kind="stable")
+    return ua[o], la[o], ub[o], lb[o]
+
+
+def _units_for_cross_join(ls, lz, rs, rz, chunk):
+    """Rectangle units for left x right group pairs (link_only)."""
+    ua, la, ub, lb = [], [], [], []
+    both_small = (lz <= chunk) & (rz <= chunk)
+    ua.append(ls[both_small])
+    la.append(lz[both_small])
+    ub.append(rs[both_small])
+    lb.append(rz[both_small])
+    key = [np.flatnonzero(both_small).astype(np.int64) * (1 << 20)]
+    for gi in np.flatnonzero(~both_small):
+        lex = _split_extents(int(lz[gi]), chunk)
+        loff = np.concatenate([[0], np.cumsum(lex)])[:-1] + int(ls[gi])
+        rex = _split_extents(int(rz[gi]), chunk)
+        roff = np.concatenate([[0], np.cumsum(rex)])[:-1] + int(rs[gi])
+        gua, gla, gub, glb = [], [], [], []
+        for a in range(len(lex)):
+            for b in range(len(rex)):
+                gua.append(loff[a])
+                gla.append(lex[a])
+                gub.append(roff[b])
+                glb.append(rex[b])
+        ua.append(np.asarray(gua, np.int64))
+        la.append(np.asarray(gla, np.int64))
+        ub.append(np.asarray(gub, np.int64))
+        lb.append(np.asarray(glb, np.int64))
+        key.append(gi * (1 << 20) + 1 + np.arange(len(gua), dtype=np.int64))
+    ua = np.concatenate(ua)
+    la = np.concatenate(la)
+    ub = np.concatenate(ub)
+    lb = np.concatenate(lb)
+    key = np.concatenate(key)
+    o = np.argsort(key, kind="stable")
+    return ua[o], la[o], ub[o], lb[o]
+
+
+def _pair_counts(ua, la, ub, lb) -> np.ndarray:
+    tri = ua == ub
+    cnt = np.where(tri, la * (la - 1) // 2, la * lb).astype(np.int64)
+    return np.concatenate([[0], np.cumsum(cnt)])
+
+
+def build_virtual_plan(
+    settings: dict, table: EncodedTable, n_left: int | None = None,
+    chunk: int | None = None,
+) -> VirtualPlan | None:
+    """Build the device-decodable plan, or None when unsupported
+    (link_and_dedupe, cartesian fallback, residual predicates, or a
+    rule with no equality conjunction)."""
+    chunk = chunk or CHUNK
+    link_type = settings["link_type"]
+    if link_type not in ("dedupe_only", "link_only"):
+        return None
+    rules = settings.get("blocking_rules") or []
+    if not rules:
+        return None
+    parsed_cols = []
+    for rule in rules:
+        eq_pairs, residual = parse_blocking_rule(rule)
+        join_cols, residual = _split_join_keys(eq_pairs, residual)
+        if residual is not None or not join_cols:
+            return None
+        parsed_cols.append(join_cols)
+
+    n = table.n_rows
+    uid_codes = None
+    if link_type == "dedupe_only":
+        ranks, keys_unique = _uid_ranks(table, link_type)
+        if not keys_unique:
+            # duplicate uids: the strict l.uid < r.uid ordering drops
+            # equal-uid pairs — dense uid codes feed the device mask
+            uid = np.asarray(table.unique_id)
+            _, uid_codes = np.unique(uid, return_inverse=True)
+            uid_codes = uid_codes.astype(np.int32)
+
+    plans: list[RulePlan] = []
+    codes_all = np.empty((len(rules), n), np.int32)
+    for r, join_cols in enumerate(parsed_cols):
+        codes = _key_codes(table, join_cols)
+        codes_all[r] = codes.astype(np.int32)  # codes < n <= 2^31
+        if link_type == "dedupe_only":
+            rows = np.flatnonzero(codes >= 0).astype(np.int32)
+            rows = rows[np.argsort(ranks[rows], kind="stable")]
+            rows_sorted, _, starts, sizes = _sort_groups(codes, rows)
+            ua, la, ub, lb = _units_for_self_join(starts, sizes, chunk)
+        else:
+            assert n_left is not None
+            all_rows = np.arange(n, dtype=np.int32)
+            lrows_in = all_rows[:n_left]
+            rrows_in = all_rows[n_left:]
+            lrows, lcodes, lstarts, lsizes = _sort_groups(
+                codes, lrows_in[codes[lrows_in] >= 0]
+            )
+            rrows, rcodes, rstarts, rsizes = _sort_groups(
+                codes, rrows_in[codes[rrows_in] >= 0]
+            )
+            common, li, ri = np.intersect1d(
+                lcodes, rcodes, return_indices=True
+            )
+            # one order array: [left-sorted | right-sorted]; right unit
+            # starts shift by len(lrows)
+            rows_sorted = np.concatenate([lrows, rrows]).astype(np.int32)
+            if len(common):
+                ua, la, ub, lb = _units_for_cross_join(
+                    lstarts[li],
+                    lsizes[li],
+                    rstarts[ri] + len(lrows),
+                    rsizes[ri],
+                    chunk,
+                )
+            else:
+                ua = la = ub = lb = np.zeros(0, np.int64)
+        pc = _pair_counts(ua, la, ub, lb)
+        plans.append(
+            RulePlan(
+                order=np.ascontiguousarray(rows_sorted, dtype=np.int32),
+                ua=ua.astype(np.int32),
+                la=la.astype(np.int32),
+                ub=ub.astype(np.int32),
+                lb=lb.astype(np.int32),
+                pc=pc,
+            )
+        )
+    return VirtualPlan(
+        rules=plans,
+        codes=codes_all,
+        uid_codes=uid_codes,
+        n_candidates=sum(rp.total for rp in plans),
+    )
+
+
+# --------------------------------------------------------------------------
+# Host-side decode (output streaming + test oracle)
+# --------------------------------------------------------------------------
+
+
+def decode_positions(plan: VirtualPlan, rule: int, q: np.ndarray):
+    """(i, j, masked) for rule-relative pair positions q (int64, numpy).
+
+    The host mirror of the device kernel — used to rebuild (idx_l, idx_r)
+    for output chunks (f64 sqrt is exact here) and as the oracle the
+    device kernel is tested against.
+    """
+    rp = plan.rules[rule]
+    u = np.searchsorted(rp.pc, q, side="right") - 1
+    t = q - rp.pc[u]
+    A, LA = rp.ua[u].astype(np.int64), rp.la[u].astype(np.int64)
+    Bs, LB = rp.ub[u].astype(np.int64), rp.lb[u].astype(np.int64)
+    tri = A == Bs
+    with np.errstate(invalid="ignore"):
+        disc = (2 * LA - 1).astype(np.float64) ** 2 - 8 * t.astype(np.float64)
+        a_t = np.floor(
+            ((2 * LA - 1) - np.sqrt(np.maximum(disc, 0.0))) / 2
+        ).astype(np.int64)
+    off = lambda a: a * LA - (a * (a + 1)) // 2  # noqa: E731
+    a_t = np.where(off(a_t + 1) <= t, a_t + 1, a_t)
+    a_t = np.where(off(a_t) > t, a_t - 1, a_t)
+    b_t = t - off(a_t) + a_t + 1
+    lb_safe = np.maximum(LB, 1)
+    a_r = t // lb_safe
+    b_r = t - a_r * lb_safe
+    a = np.where(tri, a_t, a_r)
+    b = np.where(tri, b_t, b_r)
+    i = rp.order[(A + a).astype(np.int64)]
+    j = rp.order[(Bs + b).astype(np.int64)]
+    masked = np.zeros(len(q), bool)
+    if plan.uid_codes is not None:
+        masked |= plan.uid_codes[i] == plan.uid_codes[j]
+    for prev in range(rule):
+        cp = plan.codes[prev]
+        masked |= (cp[i] == cp[j]) & (cp[i] >= 0)
+    return i, j, masked
+
+
+# --------------------------------------------------------------------------
+# Device kernel
+# --------------------------------------------------------------------------
+
+
+def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
+                            has_uid_mask: bool):
+    """Jitted (pid, acc) kernel decoding + scoring one batch of virtual
+    pair positions. Shapes of the plan arrays vary per rule, so XLA
+    compiles one executable per (rule shape, kpad bucket) — a handful per
+    run."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    n_patterns = program.n_patterns
+    strides_dev = jnp.asarray(program._pattern_strides, jnp.int32)
+    gamma_fn = program._gamma_batch_fn
+
+    @jax.jit
+    def fn(packed, order, ua, la, ub, lb, prev_codes, uid_codes,
+           pc_slice, u0, valid, acc):
+        pos = jnp.arange(batch_size, dtype=jnp.int32)
+        ui = jnp.searchsorted(pc_slice, pos, side="right").astype(jnp.int32) - 1
+        t = pos - pc_slice[ui]
+        u = u0 + ui
+        A = ua[u]
+        LA = la[u]
+        Bs = ub[u]
+        LB = lb[u]
+        tri = A == Bs
+        # triangle decode: f32 sqrt is exact for LA <= CHUNK (disc < 2^24),
+        # then a +-1 integer correction absorbs the floor rounding
+        lf = LA.astype(jnp.float32)
+        tf = t.astype(jnp.float32)
+        disc = (2.0 * lf - 1.0) ** 2 - 8.0 * tf
+        a_t = jnp.floor(
+            ((2.0 * lf - 1.0) - jnp.sqrt(jnp.maximum(disc, 0.0))) / 2.0
+        ).astype(jnp.int32)
+
+        def off(a):
+            return a * LA - (a * (a + 1)) // 2
+
+        a_t = jnp.where(off(a_t + 1) <= t, a_t + 1, a_t)
+        a_t = jnp.where(off(a_t) > t, a_t - 1, a_t)
+        b_t = t - off(a_t) + a_t + 1
+        lb_safe = jnp.maximum(LB, 1)
+        a_r = t // lb_safe
+        b_r = t - a_r * lb_safe
+        a = jnp.where(tri, a_t, a_r)
+        b = jnp.where(tri, b_t, b_r)
+        i = order[A + a]
+        j = order[Bs + b]
+
+        masked = pos >= valid
+        if has_uid_mask:
+            masked = masked | (uid_codes[i] == uid_codes[j])
+        for p in range(n_prev):
+            cp = prev_codes[p]
+            masked = masked | ((cp[i] == cp[j]) & (cp[i] >= 0))
+
+        G = gamma_fn(packed, i, j).astype(jnp.int32)
+        pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
+        pid = jnp.where(masked, n_patterns, pid)
+        acc = acc + jnp.bincount(pid, length=n_patterns + 1)
+        return pid, acc
+
+    return fn
+
+
+def compute_virtual_pattern_ids(program, plan: VirtualPlan,
+                                batch_size: int):
+    """One device pass over the VIRTUAL pair stream: (pids, counts,
+    n_real). pids carries the sentinel value ``n_patterns`` for masked
+    (deduped) positions; counts excludes them; n_real = counts.sum().
+
+    Host work per batch is O(units-in-batch): a searchsorted plus an int32
+    slice of the unit cumulative table. No pair indices cross the link.
+    """
+    import jax.numpy as jnp
+
+    from .gammas import _HIST_FLUSH_BATCHES
+
+    n_patterns = program.n_patterns
+    # sentinel must be representable
+    id_dtype = np.uint16 if n_patterns + 1 <= (1 << 16) else np.int32
+    total = plan.n_candidates
+    pids = np.empty(total, id_dtype)
+    counts = np.zeros(n_patterns, np.int64)
+    if total == 0:
+        return pids, counts, 0
+    batch_size = min(batch_size, max(total, 1))
+    flush_every = max(min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1)
+    acc = jnp.zeros(n_patterns + 1, jnp.int32)
+    in_acc = 0
+    pending = None
+    packed = program._packed
+    uid_dev = (
+        jnp.asarray(plan.uid_codes) if plan.uid_codes is not None
+        else jnp.zeros(1, jnp.int32)
+    )
+    # per-rule device arrays + kernel (shapes differ per rule, so each
+    # rule gets its own jit specialisation)
+    out_pos = 0
+    for r, rp in enumerate(plan.rules):
+        if rp.total == 0:
+            continue
+        dev = (
+            jnp.asarray(rp.order),
+            jnp.asarray(rp.ua),
+            jnp.asarray(rp.la),
+            jnp.asarray(rp.ub),
+            jnp.asarray(rp.lb),
+            jnp.asarray(plan.codes[:r]) if r else jnp.zeros((0, 1), jnp.int32),
+        )
+        fn = make_virtual_pattern_fn(
+            program, batch_size, n_prev=r,
+            has_uid_mask=plan.uid_codes is not None,
+        )
+        for p0 in range(0, rp.total, batch_size):
+            p1 = min(p0 + batch_size, rp.total)
+            u0 = int(np.searchsorted(rp.pc, p0, side="right")) - 1
+            u1 = int(np.searchsorted(rp.pc, p1 - 1, side="right")) - 1
+            k = u1 - u0 + 1
+            pc_rel = (rp.pc[u0 : u1 + 2] - p0).astype(np.int64)
+            # pad to a power of two so kpad buckets bound recompiles
+            kpad = 1 << int(max(k + 1, 2) - 1).bit_length()
+            padded = np.full(kpad, np.iinfo(np.int32).max, np.int64)
+            padded[: k + 1] = np.clip(pc_rel, -(1 << 31) + 1, (1 << 31) - 1)
+            pid, acc = fn(
+                packed, *dev[:5], dev[5], uid_dev,
+                jnp.asarray(padded.astype(np.int32)),
+                jnp.int32(u0), jnp.int32(p1 - p0), acc,
+            )
+            if pending is not None:
+                ps, n_valid, prev = pending
+                pids[ps : ps + n_valid] = (
+                    np.asarray(prev)[:n_valid].astype(id_dtype)
+                )
+            pending = (out_pos, p1 - p0, pid)
+            out_pos += p1 - p0
+            in_acc += 1
+            if in_acc >= flush_every:
+                counts += np.asarray(acc[:-1], np.int64)
+                acc = jnp.zeros(n_patterns + 1, jnp.int32)
+                in_acc = 0
+    if pending is not None:
+        ps, n_valid, prev = pending
+        pids[ps : ps + n_valid] = np.asarray(prev)[:n_valid].astype(id_dtype)
+    if in_acc:
+        counts += np.asarray(acc[:-1], np.int64)
+    return pids, counts, int(counts.sum())

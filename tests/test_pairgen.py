@@ -1,0 +1,245 @@
+"""Device-side pair generation (the virtual pair index): the decoded pair
+stream must contain EXACTLY the pairs host blocking materialises — same
+(i, j) multiset after masking, same orientation, same sequential-rule dedup
+— across group sizes that force unit splitting, duplicate uids, nulls, and
+both supported link types; and the linker's virtual pattern pipeline must
+score identically to the materialised pipelines."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import splink_tpu.pairgen as pairgen
+from splink_tpu import Splink
+from splink_tpu.blocking import block_using_rules
+from splink_tpu.data import concat_tables, encode_table
+from splink_tpu.gammas import GammaProgram
+from splink_tpu.pairgen import (
+    build_virtual_plan,
+    compute_virtual_pattern_ids,
+    decode_positions,
+)
+from splink_tpu.settings import complete_settings_dict
+
+
+def _pairs_from_plan(plan):
+    """Decode the ENTIRE virtual stream host-side, drop masked."""
+    out = []
+    for r, rp in enumerate(plan.rules):
+        if rp.total == 0:
+            continue
+        q = np.arange(rp.total, dtype=np.int64)
+        i, j, masked = decode_positions(plan, r, q)
+        out.append((i[~masked], j[~masked]))
+    if not out:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    return (
+        np.concatenate([a for a, _ in out]),
+        np.concatenate([b for _, b in out]),
+    )
+
+
+def _pair_set(i, j):
+    return set(zip(np.asarray(i).tolist(), np.asarray(j).tolist()))
+
+
+def _settings(rules, link_type="dedupe_only", cols=None):
+    return complete_settings_dict(
+        {
+            "link_type": link_type,
+            "comparison_columns": cols
+            or [{"col_name": "name", "num_levels": 2}],
+            "blocking_rules": rules,
+        }
+    )
+
+
+def _df(n, seed, uid=None):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "unique_id": uid if uid is not None else np.arange(n),
+            "name": rng.choice(["ann", "bob", "cat", None], n),
+            "city": rng.choice([f"c{k}" for k in range(max(n // 30, 2))], n),
+            "dob": rng.choice([f"d{k}" for k in range(max(n // 8, 2))], n),
+        }
+    )
+
+
+@pytest.mark.parametrize("chunk", [4, 16, 2048])
+@pytest.mark.parametrize(
+    "rules",
+    [
+        ["l.city = r.city"],
+        ["l.dob = r.dob", "l.city = r.city"],
+        ["l.city = r.city", "l.dob = r.dob", "l.name = r.name"],
+    ],
+)
+def test_virtual_pairs_equal_host_blocking_dedupe(chunk, rules):
+    df = _df(240, seed=7)
+    s = _settings(rules)
+    table = encode_table(df, s)
+    want = block_using_rules(s, table)
+    plan = build_virtual_plan(s, table, chunk=chunk)
+    assert plan is not None
+    i, j = _pairs_from_plan(plan)
+    assert len(i) == want.n_pairs
+    assert _pair_set(i, j) == _pair_set(want.idx_l, want.idx_r)
+    # orientation: every decoded pair has rank_i < rank_j == i < j here
+    assert (i < j).all()
+
+
+def test_virtual_pairs_with_duplicate_uids(monkeypatch):
+    # duplicate uids: the strict l.uid < r.uid ordering drops equal-uid
+    # pairs — the device mask must reproduce that
+    uid = np.array([0, 1, 1, 2, 3, 3, 3, 4, 5, 6] * 8)
+    df = _df(80, seed=9, uid=uid)
+    s = _settings(["l.city = r.city", "l.dob = r.dob"])
+    table = encode_table(df, s)
+    want = block_using_rules(s, table)
+    plan = build_virtual_plan(s, table, chunk=8)
+    assert plan is not None and plan.uid_codes is not None
+    i, j = _pairs_from_plan(plan)
+    uidv = df["unique_id"].to_numpy()
+
+    def keyed(ii, jj):
+        return set(zip(uidv[np.asarray(ii)], uidv[np.asarray(jj)]))
+
+    assert len(i) == want.n_pairs
+    assert _pair_set(i, j) == _pair_set(want.idx_l, want.idx_r)
+
+
+@pytest.mark.parametrize("chunk", [4, 2048])
+def test_virtual_pairs_equal_host_blocking_link_only(chunk):
+    df = _df(200, seed=11)
+    df_l, df_r = df.iloc[:120].copy(), df.iloc[120:].copy()
+    s = _settings(
+        ["l.city = r.city", "l.dob = r.dob"], link_type="link_only"
+    )
+    table = concat_tables(df_l, df_r, s)
+    want = block_using_rules(s, table, n_left=len(df_l))
+    plan = build_virtual_plan(s, table, n_left=len(df_l), chunk=chunk)
+    assert plan is not None
+    i, j = _pairs_from_plan(plan)
+    assert len(i) == want.n_pairs
+    assert _pair_set(i, j) == _pair_set(want.idx_l, want.idx_r)
+    assert (i < 120).all() and (j >= 120).all()  # left rows on the l side
+
+
+def test_unsupported_shapes_fall_back():
+    df = _df(40, seed=1)
+    # residual predicate
+    s = _settings(["l.city = r.city and l.dob != r.dob"])
+    assert build_virtual_plan(s, encode_table(df, s)) is None
+    # cartesian
+    s = _settings([])
+    assert build_virtual_plan(s, encode_table(df, s)) is None
+    # link_and_dedupe
+    df_l, df_r = df.iloc[:20].copy(), df.iloc[20:].copy()
+    s = _settings(["l.city = r.city"], link_type="link_and_dedupe")
+    t = concat_tables(df_l, df_r, s)
+    assert build_virtual_plan(s, t, n_left=20) is None
+
+
+def test_device_kernel_matches_host_decode():
+    """The jitted int32/f32 decode must agree with the f64 host oracle at
+    every position, including multi-chunk groups and batch boundaries that
+    split units."""
+    df = _df(300, seed=13)
+    s = _settings(["l.dob = r.dob", "l.city = r.city"])
+    table = encode_table(df, s)
+    plan = build_virtual_plan(s, table, chunk=8)  # force many units
+    program = GammaProgram(s, table)
+    pids, counts, n_real = compute_virtual_pattern_ids(
+        program, plan, batch_size=128
+    )
+    # oracle: decode on host, score the unmasked pairs through the
+    # materialised pattern pipeline
+    i, j = _pairs_from_plan(plan)
+    want_p, want_c = program.compute_pattern_ids(i, j, batch_size=128)
+    np.testing.assert_array_equal(counts, want_c)
+    assert n_real == len(i)
+    # pids: positions that aren't masked must carry the same pattern id,
+    # in the same relative order
+    sentinel = program.n_patterns
+    got_real = pids[pids != sentinel]
+    np.testing.assert_array_equal(
+        got_real.astype(np.int32), want_p.astype(np.int32)
+    )
+
+
+def _linker_settings(**over):
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "name", "num_levels": 2},
+            {"col_name": "dob", "num_levels": 2},
+        ],
+        "blocking_rules": ["l.city = r.city", "l.dob = r.dob"],
+        "max_iterations": 4,
+    }
+    s.update(over)
+    return s
+
+
+def test_linker_virtual_pipeline_matches_materialised():
+    # max_resident_pairs forces BOTH sides into the pattern regime, so the
+    # only difference is virtual vs materialised pairs — must be bitwise
+    df = _df(260, seed=17)
+    on = Splink(
+        _linker_settings(
+            device_pair_generation="on", max_resident_pairs=1024
+        ),
+        df=df,
+    ).get_scored_comparisons()
+    off = Splink(
+        _linker_settings(
+            device_pair_generation="off", max_resident_pairs=1024
+        ),
+        df=df,
+    ).get_scored_comparisons()
+    key = ["unique_id_l", "unique_id_r"]
+    on = on.sort_values(key).reset_index(drop=True)
+    off = off.sort_values(key).reset_index(drop=True)
+    assert len(on) == len(off)
+    np.testing.assert_array_equal(on[key].to_numpy(), off[key].to_numpy())
+    np.testing.assert_allclose(
+        on["match_probability"], off["match_probability"], rtol=1e-12
+    )
+    np.testing.assert_array_equal(on["gamma_name"], off["gamma_name"])
+
+
+def test_linker_virtual_stream_and_inference():
+    df = _df(200, seed=19)
+    s = _linker_settings(device_pair_generation="on", max_iterations=0)
+    a = Splink(s, df=df).manually_apply_fellegi_sunter_weights()
+    b = Splink(
+        _linker_settings(device_pair_generation="off", max_iterations=0),
+        df=df,
+    ).manually_apply_fellegi_sunter_weights()
+    key = ["unique_id_l", "unique_id_r"]
+    a = a.sort_values(key).reset_index(drop=True)
+    b = b.sort_values(key).reset_index(drop=True)
+    np.testing.assert_allclose(
+        a["match_probability"], b["match_probability"], rtol=1e-12
+    )
+    # streamed chunks concatenate to the same frame
+    lk = Splink(s, df=df)
+    chunks = list(lk.stream_scored_comparisons())
+    c = pd.concat(chunks, ignore_index=True).sort_values(key)
+    np.testing.assert_allclose(
+        c["match_probability"].to_numpy(),
+        a["match_probability"].to_numpy(),
+        rtol=1e-12,
+    )
+
+
+def test_linker_virtual_auto_gate():
+    """auto mode only engages above max_resident_pairs."""
+    df = _df(200, seed=23)
+    small = Splink(_linker_settings(), df=df)
+    small.get_scored_comparisons()
+    assert small._virtual is None  # tiny job: resident regime
+    big = Splink(_linker_settings(max_resident_pairs=1024), df=df)
+    big.get_scored_comparisons()
+    assert big._virtual is not None
