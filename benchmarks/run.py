@@ -170,8 +170,14 @@ def config_4(scale):
     del df
 
     t1 = time.perf_counter()
-    linker._ensure_pairs()
-    t_block = time.perf_counter() - t1
+    if linker._virtual_plan() is not None:
+        # device pair generation: "blocking" is just the unit-plan build —
+        # no pair materialisation, no spill; pairs decode inside the
+        # device scoring pass timed below
+        t_block = time.perf_counter() - t1
+    else:
+        linker._ensure_pairs()
+        t_block = time.perf_counter() - t1
 
     t1 = time.perf_counter()
     if linker._use_pattern_pipeline():

@@ -354,7 +354,6 @@ class Splink:
             from .blocking import clear_key_code_cache
 
             clear_key_code_cache(table)
-        if self._virtual is not None:
             logger.info(
                 "device pair generation: %d candidate positions, %d rules",
                 self._virtual.n_candidates,
@@ -496,6 +495,12 @@ class Splink:
 
     def _run_em_patterns(self, compute_ll: bool) -> None:
         _, counts, program = self._ensure_pattern_ids()
+        if int(counts.sum()) == 0:
+            warnings.warn(
+                "No candidate pairs to estimate from (blocking produced "
+                "nothing); parameters are unchanged."
+            )
+            return
         patterns = program.patterns_matrix()
         seen = counts > 0
         logger.info(
@@ -509,13 +514,32 @@ class Splink:
     # Public API (reference parity)
     # ------------------------------------------------------------------
 
+    def _concat_chunks(self, chunks) -> "pd.DataFrame":
+        """Concatenate streamed chunks; zero chunks (no candidates, or every
+        position masked) is a valid empty result, not a pandas error."""
+        chunks = list(chunks)
+        if not chunks:
+            return self._empty_df_e()
+        return pd.concat(chunks, ignore_index=True)
+
+    def _empty_df_e(self) -> "pd.DataFrame":
+        n_cols = len(self.settings["comparison_columns"])
+        zero = np.zeros(0)
+        zero_cols = np.zeros((0, n_cols))
+        return self._assemble_df_e(
+            np.zeros((0, n_cols), np.int8),
+            np.zeros(0, np.int64),
+            np.zeros(0, np.int64),
+            zero,
+            zero_cols,
+            zero_cols,
+        )
+
     def manually_apply_fellegi_sunter_weights(self):
         """Score using the m/u values in the settings, without running EM
         (/root/reference/splink/__init__.py:111-119)."""
         if self._use_pattern_pipeline():
-            return pd.concat(
-                list(self._stream_pattern_chunks()), ignore_index=True
-            )
+            return self._concat_chunks(self._stream_pattern_chunks())
         G = self._ensure_gammas()
         df_e = self._build_df_e(G)
         self._G_dev = None  # release the HBM copy once scoring is done
@@ -533,9 +557,7 @@ class Splink:
         """
         if self._use_pattern_pipeline():
             self._run_em_patterns(compute_ll)
-            return pd.concat(
-                list(self._stream_pattern_chunks()), ignore_index=True
-            )
+            return self._concat_chunks(self._stream_pattern_chunks())
         G = self._ensure_gammas()
         self._run_em(G, compute_ll)
         df_e = self._build_df_e(G)

@@ -420,8 +420,10 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
         jnp.asarray(plan.uid_codes) if plan.uid_codes is not None
         else jnp.zeros(1, jnp.int32)
     )
-    # per-rule device arrays + kernel (shapes differ per rule, so each
-    # rule gets its own jit specialisation)
+    # all rules' codes upload ONCE (the kernel's static n_prev bounds how
+    # many rows it reads); per-rule plan arrays + kernel are built per rule
+    # (shapes differ, so each rule is its own jit specialisation)
+    codes_dev = jnp.asarray(plan.codes)
     out_pos = 0
     for r, rp in enumerate(plan.rules):
         if rp.total == 0:
@@ -432,7 +434,7 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
             jnp.asarray(rp.la),
             jnp.asarray(rp.ub),
             jnp.asarray(rp.lb),
-            jnp.asarray(plan.codes[:r]) if r else jnp.zeros((0, 1), jnp.int32),
+            codes_dev,
         )
         fn = make_virtual_pattern_fn(
             program, batch_size, n_prev=r,

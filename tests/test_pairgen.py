@@ -243,3 +243,40 @@ def test_linker_virtual_auto_gate():
     big = Splink(_linker_settings(max_resident_pairs=1024), df=df)
     big.get_scored_comparisons()
     assert big._virtual is not None
+
+
+def test_virtual_zero_pairs_returns_empty_frame():
+    """Unique keys -> zero candidates: a valid empty result, not a crash
+    (and the materialised path agrees)."""
+    df = pd.DataFrame(
+        {
+            "unique_id": range(8),
+            "name": [f"u{k}" for k in range(8)],
+            "key": [f"k{k}" for k in range(8)],  # unique: no pairs
+        }
+    )
+    base = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+        "blocking_rules": ["l.key = r.key"],
+        "max_iterations": 3,
+    }
+    import warnings as w
+
+    with w.catch_warnings():
+        w.simplefilter("ignore")
+        on = Splink(
+            dict(base, device_pair_generation="on"), df=df
+        ).get_scored_comparisons()
+        off = Splink(
+            dict(base, device_pair_generation="off"), df=df
+        ).get_scored_comparisons()
+    assert len(on) == 0 and len(off) == 0
+    assert "match_probability" in on.columns
+    # inference path too
+    with w.catch_warnings():
+        w.simplefilter("ignore")
+        inf = Splink(
+            dict(base, device_pair_generation="on", max_iterations=0), df=df
+        ).manually_apply_fellegi_sunter_weights()
+    assert len(inf) == 0
