@@ -36,9 +36,11 @@ predicate holds (the reference's ``AND NOT ifnull(prev, false)``,
 ``n_patterns`` and falls out of the histogram's overflow bucket; the
 output stream filters the sentinel when decoding chunks host-side.
 
-Supported: dedupe_only and link_only with pure-equality rules (no
-residual predicates) on a single device. Everything else falls back to
-the host blocking pipeline unchanged.
+Supported: all three link types with pure-equality rules (no residual
+predicates) on a single device — link_and_dedupe self-joins the
+concatenated table ordered by (source, uid), link_only tiles left x right
+group rectangles. Everything else falls back to the host blocking
+pipeline unchanged.
 """
 
 from __future__ import annotations
@@ -60,6 +62,13 @@ from .data import EncodedTable
 # (f32-exact) and a rectangle's pair count at 2048^2 ~ 4.2M (int32-safe);
 # tests shrink it to force multi-chunk group splitting on tiny data.
 CHUNK = 2048
+
+# A single group may contribute at most this many units (the unit-order
+# sort key packs (group, unit-seq) as group*2^20 + seq). k chunks give
+# k(k+1)/2 units, so this caps a group at ~1448 chunks ~ 2.9M rows SHARING
+# ONE KEY — effectively a constant blocking column, where a plan this
+# shape is the wrong tool anyway; such inputs fall back to host blocking.
+MAX_UNITS_PER_GROUP = (1 << 20) - 1
 
 
 @dataclass
@@ -102,7 +111,12 @@ def _split_extents(n: int, chunk: int) -> np.ndarray:
 
 
 def _units_for_self_join(starts, sizes, chunk):
-    """Triangle + rectangle units for within-group pairs, group by group."""
+    """Triangle + rectangle units for within-group pairs, group by group.
+    Returns None when a group would exceed MAX_UNITS_PER_GROUP."""
+    if len(sizes):
+        k_max = -(-int(sizes.max()) // chunk)
+        if k_max * (k_max + 1) // 2 > MAX_UNITS_PER_GROUP:
+            return None
     ua, la, ub, lb = [], [], [], []
     big = sizes > chunk
     # fast path: single-chunk groups (one triangle each)
@@ -146,7 +160,12 @@ def _units_for_self_join(starts, sizes, chunk):
 
 
 def _units_for_cross_join(ls, lz, rs, rz, chunk):
-    """Rectangle units for left x right group pairs (link_only)."""
+    """Rectangle units for left x right group pairs (link types).
+    Returns None when a group would exceed MAX_UNITS_PER_GROUP."""
+    if len(lz):
+        per_group = (-(-lz // chunk)) * (-(-rz // chunk))
+        if int(per_group.max()) > MAX_UNITS_PER_GROUP:
+            return None
     ua, la, ub, lb = [], [], [], []
     both_small = (lz <= chunk) & (rz <= chunk)
     ua.append(ls[both_small])
@@ -191,12 +210,11 @@ def build_virtual_plan(
     chunk: int | None = None,
 ) -> VirtualPlan | None:
     """Build the device-decodable plan, or None when unsupported
-    (link_and_dedupe, cartesian fallback, residual predicates, or a
-    rule with no equality conjunction)."""
+    (cartesian fallback, residual predicates, a rule with no equality
+    conjunction, or a degenerate near-constant blocking key — see
+    MAX_UNITS_PER_GROUP)."""
     chunk = chunk or CHUNK
     link_type = settings["link_type"]
-    if link_type not in ("dedupe_only", "link_only"):
-        return None
     rules = settings.get("blocking_rules") or []
     if not rules:
         return None
@@ -210,13 +228,22 @@ def build_virtual_plan(
 
     n = table.n_rows
     uid_codes = None
-    if link_type == "dedupe_only":
+    if link_type in ("dedupe_only", "link_and_dedupe"):
+        # link_and_dedupe is a self-join over the concatenated table with
+        # (source, uid) as the ordering key — the reference's
+        # `_source_table` tie-break (/root/reference/splink/blocking.py:139)
         ranks, keys_unique = _uid_ranks(table, link_type)
         if not keys_unique:
-            # duplicate uids: the strict l.uid < r.uid ordering drops
-            # equal-uid pairs — dense uid codes feed the device mask
+            # duplicate ordering keys: the strict l.key < r.key ordering
+            # drops equal-key pairs — dense codes feed the device mask
             uid = np.asarray(table.unique_id)
             _, uid_codes = np.unique(uid, return_inverse=True)
+            uid_codes = uid_codes.astype(np.int64)
+            if link_type == "link_and_dedupe":
+                uid_codes = uid_codes * 2 + np.asarray(
+                    table.source_table, np.int64
+                )
+                _, uid_codes = np.unique(uid_codes, return_inverse=True)
             uid_codes = uid_codes.astype(np.int32)
 
     plans: list[RulePlan] = []
@@ -224,11 +251,14 @@ def build_virtual_plan(
     for r, join_cols in enumerate(parsed_cols):
         codes = _key_codes(table, join_cols)
         codes_all[r] = codes.astype(np.int32)  # codes < n <= 2^31
-        if link_type == "dedupe_only":
+        if link_type in ("dedupe_only", "link_and_dedupe"):
             rows = np.flatnonzero(codes >= 0).astype(np.int32)
             rows = rows[np.argsort(ranks[rows], kind="stable")]
             rows_sorted, _, starts, sizes = _sort_groups(codes, rows)
-            ua, la, ub, lb = _units_for_self_join(starts, sizes, chunk)
+            units = _units_for_self_join(starts, sizes, chunk)
+            if units is None:
+                return None
+            ua, la, ub, lb = units
         else:
             assert n_left is not None
             all_rows = np.arange(n, dtype=np.int32)
@@ -247,13 +277,16 @@ def build_virtual_plan(
             # starts shift by len(lrows)
             rows_sorted = np.concatenate([lrows, rrows]).astype(np.int32)
             if len(common):
-                ua, la, ub, lb = _units_for_cross_join(
+                units = _units_for_cross_join(
                     lstarts[li],
                     lsizes[li],
                     rstarts[ri] + len(lrows),
                     rsizes[ri],
                     chunk,
                 )
+                if units is None:
+                    return None
+                ua, la, ub, lb = units
             else:
                 ua = la = ub = lb = np.zeros(0, np.int64)
         pc = _pair_counts(ua, la, ub, lb)

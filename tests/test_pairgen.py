@@ -134,11 +134,6 @@ def test_unsupported_shapes_fall_back():
     # cartesian
     s = _settings([])
     assert build_virtual_plan(s, encode_table(df, s)) is None
-    # link_and_dedupe
-    df_l, df_r = df.iloc[:20].copy(), df.iloc[20:].copy()
-    s = _settings(["l.city = r.city"], link_type="link_and_dedupe")
-    t = concat_tables(df_l, df_r, s)
-    assert build_virtual_plan(s, t, n_left=20) is None
 
 
 def test_device_kernel_matches_host_decode():
@@ -280,3 +275,117 @@ def test_virtual_zero_pairs_returns_empty_frame():
             dict(base, device_pair_generation="on", max_iterations=0), df=df
         ).manually_apply_fellegi_sunter_weights()
     assert len(inf) == 0
+
+
+@pytest.mark.parametrize("chunk", [4, 2048])
+def test_virtual_pairs_equal_host_blocking_link_and_dedupe(chunk):
+    df = _df(180, seed=29)
+    df_l, df_r = df.iloc[:100].copy(), df.iloc[100:].copy()
+    # overlapping uid spaces: the (source, uid) ordering and equal-key drop
+    # must both reproduce
+    df_r = df_r.assign(unique_id=df_r["unique_id"] - 80)
+    s = _settings(
+        ["l.city = r.city", "l.dob = r.dob"], link_type="link_and_dedupe"
+    )
+    table = concat_tables(df_l, df_r, s)
+    want = block_using_rules(s, table, n_left=len(df_l))
+    plan = build_virtual_plan(s, table, n_left=len(df_l), chunk=chunk)
+    assert plan is not None
+    i, j = _pairs_from_plan(plan)
+    assert len(i) == want.n_pairs
+    assert _pair_set(i, j) == _pair_set(want.idx_l, want.idx_r)
+
+
+def test_linker_virtual_link_and_dedupe_matches_materialised():
+    df = _df(160, seed=31)
+    df_l, df_r = df.iloc[:90].copy(), df.iloc[90:].copy()
+    base = {
+        "link_type": "link_and_dedupe",
+        "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+        "blocking_rules": ["l.city = r.city"],
+        "max_iterations": 3,
+        "max_resident_pairs": 1024,
+    }
+    a = Splink(
+        dict(base, device_pair_generation="on"), df_l=df_l, df_r=df_r
+    ).get_scored_comparisons()
+    b = Splink(
+        dict(base, device_pair_generation="off"), df_l=df_l, df_r=df_r
+    ).get_scored_comparisons()
+    key = ["unique_id_l", "unique_id_r", "_source_table_l", "_source_table_r"]
+    a = a.sort_values(key).reset_index(drop=True)
+    b = b.sort_values(key).reset_index(drop=True)
+    assert len(a) == len(b)
+    np.testing.assert_allclose(
+        a["match_probability"], b["match_probability"], rtol=1e-12
+    )
+    np.testing.assert_array_equal(
+        a["_source_table_l"].to_numpy(), b["_source_table_l"].to_numpy()
+    )
+
+
+def test_monster_group_falls_back(monkeypatch):
+    # a group exceeding MAX_UNITS_PER_GROUP (here: tiny synthetic caps)
+    # must reject the plan rather than corrupt the unit ordering key
+    monkeypatch.setattr(pairgen, "MAX_UNITS_PER_GROUP", 3)
+    df = pd.DataFrame(
+        {
+            "unique_id": range(40),
+            "name": ["x"] * 40,
+            "key": ["same"] * 40,  # one 40-row group
+        }
+    )
+    s = _settings(["l.key = r.key"])
+    table = encode_table(df, s)
+    assert build_virtual_plan(s, table, chunk=4) is None
+    # and the linker quietly uses host blocking instead
+    base = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+        "blocking_rules": ["l.key = r.key"],
+        "max_iterations": 2,
+        "max_resident_pairs": 1024,
+        "device_pair_generation": "on",
+    }
+    out = Splink(base, df=df).get_scored_comparisons()
+    assert len(out) == 40 * 39 // 2
+
+
+@pytest.mark.parametrize("chunk", [4, 2048])
+def test_virtual_link_and_dedupe_duplicate_source_uid_keys(chunk):
+    """DUPLICATE (source, uid) combos: the equal-key drop must key on the
+    (source, uid) pair — plain uid codes would wrongly drop legitimate
+    cross-source same-uid pairs."""
+    # left has uid 5 twice; right has uid 5 twice too — within-source
+    # duplicate keys AND cross-source same-uid pairs both present
+    df_l = pd.DataFrame(
+        {
+            "unique_id": [1, 5, 5, 7, 9],
+            "name": ["a", "b", "c", "d", "e"],
+            "city": ["x"] * 5,
+        }
+    )
+    df_r = pd.DataFrame(
+        {
+            "unique_id": [5, 5, 7, 11],
+            "name": ["f", "g", "h", "i"],
+            "city": ["x"] * 4,
+        }
+    )
+    s = _settings(["l.city = r.city"], link_type="link_and_dedupe")
+    table = concat_tables(df_l, df_r, s)
+    want = block_using_rules(s, table, n_left=len(df_l))
+    plan = build_virtual_plan(s, table, n_left=len(df_l), chunk=chunk)
+    assert plan is not None and plan.uid_codes is not None
+    i, j = _pairs_from_plan(plan)
+    assert len(i) == want.n_pairs
+    assert _pair_set(i, j) == _pair_set(want.idx_l, want.idx_r)
+    # cross-source same-uid pairs survive (uid 5 left vs uid 5 right)
+    uidv = table.unique_id
+    src = table.source_table
+    cross_same = [
+        (a, b)
+        for a, b in zip(i, j)
+        if uidv[a] == uidv[b] and src[a] != src[b]
+    ]
+    assert cross_same, "cross-source same-uid pairs must not be dropped"
