@@ -36,16 +36,26 @@ predicate holds (the reference's ``AND NOT ifnull(prev, false)``,
 ``n_patterns`` and falls out of the histogram's overflow bucket; the
 output stream filters the sentinel when decoding chunks host-side.
 
-Supported: all three link types with pure-equality rules (no residual
-predicates) on a single device — link_and_dedupe self-joins the
-concatenated table ordered by (source, uid), link_only tiles left x right
-group rectangles. Everything else falls back to the host blocking
-pipeline unchanged.
+Supported: all three link types on a single device — link_and_dedupe
+self-joins the concatenated table ordered by (source, uid), link_only
+tiles left x right group rectangles. Residual (non-equality) predicates
+compile to DEVICE masks mirroring residual_eval's SQL three-valued
+semantics: any column (encoded string, numeric, raw passthrough)
+compares via scaled int32 lexicographic ranks (null = -2; literals bind
+to 2*pos or the odd insertion rank; cross-column compares re-rank over
+the union vocabulary), numeric contexts use NaN-null float arrays with
+the host's pd.to_numeric coercion applied once at plan build. Predicates
+the device can't honour (unsortable mixed-type columns, literal/column
+type mismatches) reject the plan and fall back to host blocking. Note:
+on TPU numeric residual thresholds evaluate in f32 (the chip has no
+f64), so a pair exactly on a threshold may land differently than the
+f64 host path — the CPU tier (x64) is bit-identical.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import ast
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -81,6 +91,8 @@ class RulePlan:
     ub: np.ndarray  # (U,) int32 b-side start (== ua for triangles)
     lb: np.ndarray  # (U,) int32 b-side extent
     pc: np.ndarray  # (U+1,) int64 cumulative pair counts over units
+    residual: str | None = None  # translated residual predicate source
+    residual_fn: object = None  # compiled device closure (see _ResCompiler)
 
     @property
     def total(self) -> int:
@@ -93,12 +105,425 @@ class VirtualPlan:
     codes: np.ndarray  # (R, n) int32 per-rule key codes (device dedup mask)
     uid_codes: np.ndarray | None  # (n,) int32 when duplicate uids exist
     n_candidates: int  # sum of rule totals (mask not yet applied)
+    res_ops: list[np.ndarray] = field(default_factory=list)  # residual operand arrays
+    table: EncodedTable | None = None  # for host-side residual oracle
 
     def rule_offsets(self) -> np.ndarray:
         """(R+1,) int64 global position offset of each rule's segment."""
         return np.concatenate(
             [[0], np.cumsum([rp.total for rp in self.rules])]
         ).astype(np.int64)
+
+
+# --------------------------------------------------------------------------
+# Residual predicates -> device closures
+# --------------------------------------------------------------------------
+
+
+class _ResUnsupported(Exception):
+    """The residual needs something the device can't honour (object
+    columns, cross-vocabulary string compares, string-to-number coercion);
+    the plan falls back to host blocking."""
+
+
+class _ResCompiler:
+    """Compile a translated residual predicate (the same python-expression
+    surface residual_eval interprets) into a jax-traceable closure
+    fn(i, j, ops) -> (val, unk) with SQL three-valued semantics.
+
+    Per-row operand arrays register once per column and upload once per
+    run: string columns as scaled int32 ranks (2*rank; null -2 — literals
+    bind to 2*pos, or the odd 2*pos-1 insertion rank so an absent literal
+    orders correctly and equals nothing), numerics as NaN-null floats.
+    """
+
+    _CMPS = {
+        ast.Eq: "eq", ast.NotEq: "ne", ast.Lt: "lt", ast.LtE: "le",
+        ast.Gt: "gt", ast.GtE: "ge",
+    }
+    _ARITH = {
+        ast.Add: "add", ast.Sub: "sub", ast.Mult: "mul", ast.Div: "div",
+        ast.Mod: "mod", ast.Pow: "pow",
+    }
+
+    def __init__(self, table: EncodedTable, ops: list[np.ndarray],
+                 op_index: dict, aux: dict):
+        self.table = table
+        self.ops = ops  # shared across rules; uploaded once
+        self.op_index = op_index  # key -> position in ops
+        self.aux = aux  # vocab arrays for literal binding (host-only)
+
+    def _register(self, key, build) -> int:
+        if key not in self.op_index:
+            self.op_index[key] = len(self.ops)
+            self.ops.append(build())
+        return self.op_index[key]
+
+    def _col_values_null(self, col: str):
+        vals = np.asarray(self.table.column_values(col), dtype=object)
+        null = self.table.is_null(col)
+        return vals, null
+
+    def _vocab(self, col: str) -> np.ndarray:
+        key = ("vocab", col)
+        if key not in self.aux:
+            vals, null = self._col_values_null(col)
+            try:
+                self.aux[key] = np.unique(vals[~null])
+            except TypeError as e:  # mixed incomparable types
+                raise _ResUnsupported(f"unsortable column {col!r}") from e
+        return self.aux[key]
+
+    def _str_ranks_scaled(self, col: str) -> int:
+        """Scaled lexicographic ranks (2*rank; null -2) for ANY column the
+        table carries — encoded string, numeric, or raw passthrough —
+        order-isomorphic to the host's object comparison."""
+        vocab = self._vocab(col)  # also validates sortability
+
+        def build():
+            vals, null = self._col_values_null(col)
+            out = np.full(len(vals), -2, np.int64)
+            nn = ~null
+            out[nn] = 2 * np.searchsorted(vocab, vals[nn])
+            return out.astype(np.int32)
+
+        return self._register(("str", col), build)
+
+    def _joint_ranks_scaled(self, cola: str, colb: str) -> tuple[int, int]:
+        """Two scaled-rank arrays over the UNION vocabulary, so columns
+        with different vocabularies compare exactly as the host's
+        elementwise object comparison does."""
+        va, vb = self._vocab(cola), self._vocab(colb)
+        try:
+            union = np.unique(np.concatenate([va, vb]))
+        except TypeError as e:
+            raise _ResUnsupported(
+                f"unsortable column pair {cola!r}/{colb!r}"
+            ) from e
+
+        def build_for(col):
+            def build():
+                vals, null = self._col_values_null(col)
+                out = np.full(len(vals), -2, np.int64)
+                nn = ~null
+                out[nn] = 2 * np.searchsorted(union, vals[nn])
+                return out.astype(np.int32)
+
+            return build
+
+        return (
+            self._register(("joint", cola, colb, "a"), build_for(cola)),
+            self._register(("joint", cola, colb, "b"), build_for(colb)),
+        )
+
+    def _numeric_vals(self, col: str) -> int:
+        def build():
+            nc = self.table.numerics[col]
+            vals = nc.values_f64.copy()
+            vals[nc.null_mask] = np.nan
+            return vals
+
+        return self._register(("num", col), build)
+
+    def _coerced_vals(self, col: str) -> int:
+        """SQL numeric-context coercion of a string/raw column (the host's
+        pd.to_numeric path) — computed host-side once, NaN for null or
+        unparseable."""
+
+        def build():
+            import pandas as pd
+
+            vals, null = self._col_values_null(col)
+            out = pd.to_numeric(pd.Series(vals), errors="coerce").to_numpy(
+                dtype=np.float64, copy=True
+            )
+            out[null] = np.nan
+            return out
+
+        return self._register(("coerce", col), build)
+
+    def _literal_rank(self, col: str, lit) -> int:
+        vocab = self._vocab(col)
+        if len(vocab) and not isinstance(lit, type(vocab[0])):
+            # comparing e.g. a number literal against a string column would
+            # TypeError on the host too — reject rather than guess
+            raise _ResUnsupported(
+                f"literal {lit!r} vs column {col!r} type mismatch"
+            )
+        pos = int(np.searchsorted(vocab, lit))
+        if pos < len(vocab) and vocab[pos] == lit:
+            return 2 * pos
+        return 2 * pos - 1  # odd: orders correctly, equals nothing
+
+    # -- value level: returns ("str", col, op_idx, side) |
+    #    ("num", fn(i,j,ops)->float array) | ("lit_s", s) | ("lit_n", x)
+    def value(self, node):
+        if isinstance(node, ast.Subscript):
+            if not (
+                isinstance(node.value, ast.Name)
+                and node.value.id in ("l", "r")
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+            ):
+                raise _ResUnsupported("subscript shape")
+            col = node.slice.value
+            side = node.value.id
+            if col in self.table.numerics:
+                idx = self._numeric_vals(col)
+                return ("num", self._gather_num(idx, side))
+            if col in self.table.strings or col in self.table.raw:
+                # encoded strings and raw passthrough columns both compare
+                # via lexicographic ranks of their object values
+                return ("str", col, self._str_ranks_scaled(col), side)
+            raise _ResUnsupported(f"unknown column {col!r}")
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, str):
+                return ("lit_s", node.value)
+            if isinstance(node.value, (int, float)) and not isinstance(
+                node.value, bool
+            ):
+                return ("lit_n", float(node.value))
+            raise _ResUnsupported(f"literal {node.value!r}")
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            inner = self.value(node.operand)
+            if inner[0] == "lit_n":
+                return ("lit_n", -inner[1])
+            if inner[0] == "num":
+                f = inner[1]
+                return ("num", lambda i, j, ops: -f(i, j, ops))
+            raise _ResUnsupported("unary minus on non-numeric")
+        if isinstance(node, ast.BinOp) and type(node.op) in self._ARITH:
+            a = self._as_num(self.value(node.left))
+            b = self._as_num(self.value(node.right))
+            opname = self._ARITH[type(node.op)]
+
+            def arith(i, j, ops, a=a, b=b, opname=opname):
+                import jax.numpy as jnp
+
+                x, y = a(i, j, ops), b(i, j, ops)
+                return {
+                    "add": lambda: x + y,
+                    "sub": lambda: x - y,
+                    "mul": lambda: x * y,
+                    "div": lambda: x / y,
+                    "mod": lambda: jnp.mod(x, y),
+                    "pow": lambda: x**y,
+                }[opname]()
+
+            return ("num", arith)
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id == "abs":
+                (arg,) = node.args
+                f = self._as_num(self.value(arg))
+
+                def absf(i, j, ops, f=f):
+                    import jax.numpy as jnp
+
+                    return jnp.abs(f(i, j, ops))
+
+                return ("num", absf)
+            raise _ResUnsupported("call in value position")
+        raise _ResUnsupported(f"value node {type(node).__name__}")
+
+    @staticmethod
+    def _gather_num(idx: int, side: str):
+        def g(i, j, ops):
+            rows = i if side == "l" else j
+            return ops[idx][rows]
+
+        return g
+
+    def _as_num(self, v):
+        """Numeric closure from a value. String/raw columns coerce through
+        the host's pd.to_numeric ONCE at plan build (the array uploads like
+        any other operand), matching SQL's implicit CAST semantics."""
+        if v[0] == "num":
+            return v[1]
+        if v[0] == "lit_n":
+            x = v[1]
+
+            def const(i, j, ops, x=x):
+                import jax
+                import jax.numpy as jnp
+
+                # session float dtype: f64 under x64 keeps literal
+                # thresholds bit-identical to the host path
+                dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+                return jnp.full(i.shape, x, dt)
+
+            return const
+        if v[0] == "str":
+            return self._gather_num(self._coerced_vals(v[1]), v[3])
+        raise _ResUnsupported("non-numeric operand in numeric context")
+
+    # -- comparisons -> (val, unk) closures
+    def _cmp_apply(self, opname, x, y):
+        import jax.numpy as jnp
+
+        return {
+            "eq": lambda: x == y,
+            "ne": lambda: x != y,
+            "lt": lambda: x < y,
+            "le": lambda: x <= y,
+            "gt": lambda: x > y,
+            "ge": lambda: x >= y,
+        }[opname]()
+
+    def compare_pair(self, opname, lv, rv):
+        if lv[0] == "str" and rv[0] == "str":
+            if lv[1] == rv[1]:
+                li, ri = lv[2], rv[2]
+            else:
+                # different vocabularies: re-rank both over the union
+                li, ri = self._joint_ranks_scaled(lv[1], rv[1])
+            ls, rs = lv[3], rv[3]
+
+            def f(i, j, ops, li=li, ls=ls, ri=ri, rs=rs, opname=opname):
+                a = ops[li][i if ls == "l" else j]
+                b = ops[ri][i if rs == "l" else j]
+                unk = (a < 0) | (b < 0)
+                return self._cmp_apply(opname, a, b) & ~unk, unk
+
+            return f
+        if lv[0] == "str" and rv[0] == "lit_s":
+            k = self._literal_rank(lv[1], rv[1])
+            li, ls = lv[2], lv[3]
+
+            def f(i, j, ops, li=li, ls=ls, k=k, opname=opname):
+                a = ops[li][i if ls == "l" else j]
+                unk = a < 0
+                return self._cmp_apply(opname, a, k) & ~unk, unk
+
+            return f
+        if rv[0] == "str" and lv[0] == "lit_s":
+            k = self._literal_rank(rv[1], lv[1])
+            ri, rs = rv[2], rv[3]
+
+            def f(i, j, ops, ri=ri, rs=rs, k=k, opname=opname):
+                b = ops[ri][i if rs == "l" else j]
+                unk = b < 0
+                return self._cmp_apply(opname, k, b) & ~unk, unk
+
+            return f
+        # numeric comparison — a BARE string column here is a type
+        # mismatch on the host (evaluate_residual raises; coercion only
+        # happens inside arithmetic/abs contexts), so reject for parity
+        if lv[0] == "str" or rv[0] == "str":
+            raise _ResUnsupported(
+                "string column in a numeric comparison (host type mismatch)"
+            )
+        a = self._as_num(lv)
+        b = self._as_num(rv)
+
+        def f(i, j, ops, a=a, b=b, opname=opname):
+            import jax.numpy as jnp
+
+            x, y = a(i, j, ops), b(i, j, ops)
+            unk = jnp.isnan(x) | jnp.isnan(y)
+            return self._cmp_apply(opname, x, y) & ~unk, unk
+
+        return f
+
+    # -- boolean level (Kleene from residual_eval works on jax arrays too:
+    # its operators are pure &, |, ~ algebra — ONE implementation of the
+    # null logic shared between host and device)
+    def boolean(self, node):
+        import jax.numpy as jnp
+
+        from .residual_eval import Kleene
+
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitAnd, ast.BitOr)
+        ):
+            a = self.boolean(node.left)
+            b = self.boolean(node.right)
+            is_and = isinstance(node.op, ast.BitAnd)
+
+            def f(i, j, ops, a=a, b=b, is_and=is_and):
+                ka = Kleene(*a(i, j, ops))
+                kb = Kleene(*b(i, j, ops))
+                out = (ka & kb) if is_and else (ka | kb)
+                return out.val, out.unk
+
+            return f
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Invert):
+            a = self.boolean(node.operand)
+
+            def f(i, j, ops, a=a):
+                out = ~Kleene(*a(i, j, ops))
+                return out.val, out.unk
+
+            return f
+        if isinstance(node, ast.Compare):
+            operands = [node.left, *node.comparators]
+            parts = []
+            for op, ln, rn in zip(node.ops, operands, operands[1:]):
+                if type(op) not in self._CMPS:
+                    raise _ResUnsupported("comparison operator")
+                parts.append(
+                    self.compare_pair(
+                        self._CMPS[type(op)], self.value(ln), self.value(rn)
+                    )
+                )
+
+            def f(i, j, ops, parts=parts):
+                out = Kleene(*parts[0](i, j, ops))
+                for p in parts[1:]:
+                    out = out & Kleene(*p(i, j, ops))
+                return out.val, out.unk
+
+            return f
+        if isinstance(node, ast.Call):
+            if not (
+                isinstance(node.func, ast.Name) and node.func.id == "_isna"
+            ):
+                raise _ResUnsupported("boolean call")
+            (arg,) = node.args
+            v = self.value(arg)
+            if v[0] == "str":
+                oi, side = v[2], v[3]
+
+                def f(i, j, ops, oi=oi, side=side):
+                    a = ops[oi][i if side == "l" else j]
+                    return a < 0, jnp.zeros(a.shape, bool)
+
+                return f
+            if v[0] == "num":
+                g = v[1]
+
+                def f(i, j, ops, g=g):
+                    import jax.numpy as jnp
+
+                    x = g(i, j, ops)
+                    return jnp.isnan(x), jnp.zeros(x.shape, bool)
+
+                return f
+            raise _ResUnsupported("_isna of a literal")
+        if isinstance(node, ast.Constant) and isinstance(node.value, bool):
+            b = bool(node.value)
+
+            def f(i, j, ops, b=b):
+                import jax.numpy as jnp
+
+                return jnp.full(i.shape, b), jnp.zeros(i.shape, bool)
+
+            return f
+        raise _ResUnsupported(f"boolean node {type(node).__name__}")
+
+
+def compile_residual_device(table, residual_src: str,
+                            ops: list[np.ndarray], op_index: dict,
+                            aux: dict):
+    """-> fn(i, j, ops) -> (val, unk), or None when the predicate needs
+    host-only machinery (the caller then rejects the whole plan)."""
+    try:
+        tree = ast.parse(residual_src, mode="eval")
+    except SyntaxError:
+        return None
+    try:
+        return _ResCompiler(table, ops, op_index, aux).boolean(tree.body)
+    except _ResUnsupported:
+        return None
 
 
 def _split_extents(n: int, chunk: int) -> np.ndarray:
@@ -219,12 +644,24 @@ def build_virtual_plan(
     if not rules:
         return None
     parsed_cols = []
+    residuals: list[tuple[str | None, object]] = []
+    res_ops: list[np.ndarray] = []
+    res_idx: dict = {}
+    res_aux: dict = {}
     for rule in rules:
         eq_pairs, residual = parse_blocking_rule(rule)
         join_cols, residual = _split_join_keys(eq_pairs, residual)
-        if residual is not None or not join_cols:
+        if not join_cols:
             return None
+        res_fn = None
+        if residual is not None:
+            res_fn = compile_residual_device(
+                table, residual, res_ops, res_idx, res_aux
+            )
+            if res_fn is None:
+                return None
         parsed_cols.append(join_cols)
+        residuals.append((residual, res_fn))
 
     n = table.n_rows
     uid_codes = None
@@ -298,6 +735,8 @@ def build_virtual_plan(
                 ub=ub.astype(np.int32),
                 lb=lb.astype(np.int32),
                 pc=pc,
+                residual=residuals[r][0],
+                residual_fn=residuals[r][1],
             )
         )
     return VirtualPlan(
@@ -305,6 +744,8 @@ def build_virtual_plan(
         codes=codes_all,
         uid_codes=uid_codes,
         n_candidates=sum(rp.total for rp in plans),
+        res_ops=res_ops,
+        table=table,
     )
 
 
@@ -345,9 +786,22 @@ def decode_positions(plan: VirtualPlan, rule: int, q: np.ndarray):
     masked = np.zeros(len(q), bool)
     if plan.uid_codes is not None:
         masked |= plan.uid_codes[i] == plan.uid_codes[j]
+    if rp.residual is not None:
+        from .residual_eval import evaluate_residual
+
+        masked |= ~evaluate_residual(plan.table, rp.residual, i, j)
     for prev in range(rule):
         cp = plan.codes[prev]
-        masked |= (cp[i] == cp[j]) & (cp[i] >= 0)
+        holds = (cp[i] == cp[j]) & (cp[i] >= 0)
+        prev_res = plan.rules[prev].residual
+        if prev_res is not None and holds.any():
+            from .residual_eval import evaluate_residual
+
+            sub = np.flatnonzero(holds)
+            keep = evaluate_residual(plan.table, prev_res, i[sub], j[sub])
+            holds = holds.copy()
+            holds[sub] = keep
+        masked |= holds
     return i, j, masked
 
 
@@ -357,13 +811,13 @@ def decode_positions(plan: VirtualPlan, rule: int, q: np.ndarray):
 
 
 def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
-                            has_uid_mask: bool):
+                            has_uid_mask: bool, own_res=None,
+                            prev_res=()):
     """Jitted (pid, acc) kernel decoding + scoring one batch of virtual
     pair positions. Shapes of the plan arrays vary per rule, so XLA
     compiles one executable per (rule shape, kpad bucket) — a handful per
-    run."""
-    import functools
-
+    run. own_res / prev_res are compiled residual closures (traced into
+    this jit; the ops arrays arrive as the res_ops argument)."""
     import jax
     import jax.numpy as jnp
 
@@ -373,7 +827,7 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
 
     @jax.jit
     def fn(packed, order, ua, la, ub, lb, prev_codes, uid_codes,
-           pc_slice, u0, valid, acc):
+           res_ops, pc_slice, u0, valid, acc):
         pos = jnp.arange(batch_size, dtype=jnp.int32)
         ui = jnp.searchsorted(pc_slice, pos, side="right").astype(jnp.int32) - 1
         t = pos - pc_slice[ui]
@@ -409,9 +863,16 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
         masked = pos >= valid
         if has_uid_mask:
             masked = masked | (uid_codes[i] == uid_codes[j])
+        if own_res is not None:
+            v, unk = own_res(i, j, res_ops)
+            masked = masked | ~(v & ~unk)
         for p in range(n_prev):
             cp = prev_codes[p]
-            masked = masked | ((cp[i] == cp[j]) & (cp[i] >= 0))
+            holds = (cp[i] == cp[j]) & (cp[i] >= 0)
+            if prev_res and prev_res[p] is not None:
+                v, unk = prev_res[p](i, j, res_ops)
+                holds = holds & v & ~unk
+            masked = masked | holds
 
         G = gamma_fn(packed, i, j).astype(jnp.int32)
         pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
@@ -453,10 +914,12 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
         jnp.asarray(plan.uid_codes) if plan.uid_codes is not None
         else jnp.zeros(1, jnp.int32)
     )
-    # all rules' codes upload ONCE (the kernel's static n_prev bounds how
-    # many rows it reads); per-rule plan arrays + kernel are built per rule
-    # (shapes differ, so each rule is its own jit specialisation)
+    # all rules' codes and residual operand arrays upload ONCE (the
+    # kernel's static n_prev bounds how many code rows it reads); per-rule
+    # plan arrays + kernel are built per rule (shapes differ, so each rule
+    # is its own jit specialisation)
     codes_dev = jnp.asarray(plan.codes)
+    res_ops_dev = tuple(jnp.asarray(a) for a in plan.res_ops)
     out_pos = 0
     for r, rp in enumerate(plan.rules):
         if rp.total == 0:
@@ -472,6 +935,8 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
         fn = make_virtual_pattern_fn(
             program, batch_size, n_prev=r,
             has_uid_mask=plan.uid_codes is not None,
+            own_res=rp.residual_fn,
+            prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
         )
         for p0 in range(0, rp.total, batch_size):
             p1 = min(p0 + batch_size, rp.total)
@@ -484,7 +949,7 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
             padded = np.full(kpad, np.iinfo(np.int32).max, np.int64)
             padded[: k + 1] = np.clip(pc_rel, -(1 << 31) + 1, (1 << 31) - 1)
             pid, acc = fn(
-                packed, *dev[:5], dev[5], uid_dev,
+                packed, *dev[:5], dev[5], uid_dev, res_ops_dev,
                 jnp.asarray(padded.astype(np.int32)),
                 jnp.int32(u0), jnp.int32(p1 - p0), acc,
             )
