@@ -478,8 +478,11 @@ class Splink:
                 rule_idx = np.searchsorted(offsets, qs, side="right") - 1
                 for r in np.unique(rule_idx):
                     m = rule_idx == r
+                    # the kernel's sentinel already filtered masked pairs —
+                    # don't re-run residual predicates on the host
                     i, j, _ = decode_positions(
-                        plan, int(r), qs[m] - offsets[r]
+                        plan, int(r), qs[m] - offsets[r],
+                        compute_masked=False,
                     )
                     il[m] = i
                     ir[m] = j

@@ -165,22 +165,35 @@ class _ResCompiler:
         return vals, null
 
     def _vocab(self, col: str) -> np.ndarray:
+        """Same-column / literal-binding vocabulary: ENCODED string columns
+        use the table's string_ranks vocabulary (which str()-coerces,
+        exactly what the host's StrOperand compares through); raw
+        passthrough columns sort their raw object values (the host's
+        RawOperand compares those elementwise)."""
         key = ("vocab", col)
         if key not in self.aux:
-            vals, null = self._col_values_null(col)
-            try:
-                self.aux[key] = np.unique(vals[~null])
-            except TypeError as e:  # mixed incomparable types
-                raise _ResUnsupported(f"unsortable column {col!r}") from e
+            if col in self.table.strings:
+                self.aux[key] = self.table.string_ranks(col)[1]
+            else:
+                vals, null = self._col_values_null(col)
+                try:
+                    self.aux[key] = np.unique(vals[~null])
+                except TypeError as e:  # mixed incomparable types
+                    raise _ResUnsupported(f"unsortable column {col!r}") from e
         return self.aux[key]
 
     def _str_ranks_scaled(self, col: str) -> int:
-        """Scaled lexicographic ranks (2*rank; null -2) for ANY column the
-        table carries — encoded string, numeric, or raw passthrough —
-        order-isomorphic to the host's object comparison."""
-        vocab = self._vocab(col)  # also validates sortability
+        """Scaled rank array (2*rank; null -2), order-isomorphic to the
+        host's same-column comparison for this column kind."""
+        self._vocab(col)  # validate sortability before registering
 
         def build():
+            if col in self.table.strings:
+                ranks, _ = self.table.string_ranks(col)
+                return np.where(
+                    np.isnan(ranks), -2, 2 * np.nan_to_num(ranks)
+                ).astype(np.int32)
+            vocab = self._vocab(col)
             vals, null = self._col_values_null(col)
             out = np.full(len(vals), -2, np.int64)
             nn = ~null
@@ -190,16 +203,31 @@ class _ResCompiler:
         return self._register(("str", col), build)
 
     def _joint_ranks_scaled(self, cola: str, colb: str) -> tuple[int, int]:
-        """Two scaled-rank arrays over the UNION vocabulary, so columns
-        with different vocabularies compare exactly as the host's
-        elementwise object comparison does."""
-        va, vb = self._vocab(cola), self._vocab(colb)
-        try:
-            union = np.unique(np.concatenate([va, vb]))
-        except TypeError as e:
-            raise _ResUnsupported(
-                f"unsortable column pair {cola!r}/{colb!r}"
-            ) from e
+        """Two scaled-rank arrays over the UNION of raw-value
+        vocabularies — the host compares cross-column operands by their
+        raw object VALUES (StrOperand.values), so both sides rank over raw
+        values here regardless of encoding. Keys are canonicalised so
+        (a, b) and (b, a) share one array pair."""
+
+        def raw_vocab(col):
+            vals, null = self._col_values_null(col)
+            try:
+                return np.unique(vals[~null])
+            except TypeError as e:
+                raise _ResUnsupported(f"unsortable column {col!r}") from e
+
+        c1, c2 = sorted((cola, colb))
+        union_key = ("joint_vocab", c1, c2)
+        if union_key not in self.aux:
+            try:
+                self.aux[union_key] = np.unique(
+                    np.concatenate([raw_vocab(c1), raw_vocab(c2)])
+                )
+            except TypeError as e:
+                raise _ResUnsupported(
+                    f"unsortable column pair {cola!r}/{colb!r}"
+                ) from e
+        union = self.aux[union_key]
 
         def build_for(col):
             def build():
@@ -211,10 +239,9 @@ class _ResCompiler:
 
             return build
 
-        return (
-            self._register(("joint", cola, colb, "a"), build_for(cola)),
-            self._register(("joint", cola, colb, "b"), build_for(colb)),
-        )
+        ia = self._register(("joint", c1, c2, c1), build_for(c1))
+        ib = self._register(("joint", c1, c2, c2), build_for(c2))
+        return (ia, ib) if cola == c1 else (ib, ia)
 
     def _numeric_vals(self, col: str) -> int:
         def build():
@@ -273,8 +300,10 @@ class _ResCompiler:
                 return ("num", self._gather_num(idx, side))
             if col in self.table.strings or col in self.table.raw:
                 # encoded strings and raw passthrough columns both compare
-                # via lexicographic ranks of their object values
-                return ("str", col, self._str_ranks_scaled(col), side)
+                # via lexicographic ranks; the rank array registers LAZILY
+                # at the use site (a column used only in cross-column
+                # compares needs the joint arrays, not its own)
+                return ("str", col, None, side)
             raise _ResUnsupported(f"unknown column {col!r}")
         if isinstance(node, ast.Constant):
             if isinstance(node.value, str):
@@ -372,7 +401,7 @@ class _ResCompiler:
     def compare_pair(self, opname, lv, rv):
         if lv[0] == "str" and rv[0] == "str":
             if lv[1] == rv[1]:
-                li, ri = lv[2], rv[2]
+                li = ri = self._str_ranks_scaled(lv[1])
             else:
                 # different vocabularies: re-rank both over the union
                 li, ri = self._joint_ranks_scaled(lv[1], rv[1])
@@ -387,7 +416,7 @@ class _ResCompiler:
             return f
         if lv[0] == "str" and rv[0] == "lit_s":
             k = self._literal_rank(lv[1], rv[1])
-            li, ls = lv[2], lv[3]
+            li, ls = self._str_ranks_scaled(lv[1]), lv[3]
 
             def f(i, j, ops, li=li, ls=ls, k=k, opname=opname):
                 a = ops[li][i if ls == "l" else j]
@@ -397,7 +426,7 @@ class _ResCompiler:
             return f
         if rv[0] == "str" and lv[0] == "lit_s":
             k = self._literal_rank(rv[1], lv[1])
-            ri, rs = rv[2], rv[3]
+            ri, rs = self._str_ranks_scaled(rv[1]), rv[3]
 
             def f(i, j, ops, ri=ri, rs=rs, k=k, opname=opname):
                 b = ops[ri][i if rs == "l" else j]
@@ -481,7 +510,7 @@ class _ResCompiler:
             (arg,) = node.args
             v = self.value(arg)
             if v[0] == "str":
-                oi, side = v[2], v[3]
+                oi, side = self._str_ranks_scaled(v[1]), v[3]
 
                 def f(i, j, ops, oi=oi, side=side):
                     a = ops[oi][i if side == "l" else j]
@@ -635,9 +664,9 @@ def build_virtual_plan(
     chunk: int | None = None,
 ) -> VirtualPlan | None:
     """Build the device-decodable plan, or None when unsupported
-    (cartesian fallback, residual predicates, a rule with no equality
-    conjunction, or a degenerate near-constant blocking key — see
-    MAX_UNITS_PER_GROUP)."""
+    (cartesian fallback, a rule with no equality conjunction, a residual
+    predicate the device compiler can't honour, or a degenerate
+    near-constant blocking key — see MAX_UNITS_PER_GROUP)."""
     chunk = chunk or CHUNK
     link_type = settings["link_type"]
     rules = settings.get("blocking_rules") or []
@@ -754,12 +783,16 @@ def build_virtual_plan(
 # --------------------------------------------------------------------------
 
 
-def decode_positions(plan: VirtualPlan, rule: int, q: np.ndarray):
+def decode_positions(plan: VirtualPlan, rule: int, q: np.ndarray,
+                     compute_masked: bool = True):
     """(i, j, masked) for rule-relative pair positions q (int64, numpy).
 
     The host mirror of the device kernel — used to rebuild (idx_l, idx_r)
     for output chunks (f64 sqrt is exact here) and as the oracle the
-    device kernel is tested against.
+    device kernel is tested against. The streaming caller already filtered
+    masked positions by the kernel's sentinel pattern id and passes
+    ``compute_masked=False`` (masked comes back None) — re-running the
+    residual predicates on the host per chunk would be pure waste.
     """
     rp = plan.rules[rule]
     u = np.searchsorted(rp.pc, q, side="right") - 1
@@ -783,6 +816,8 @@ def decode_positions(plan: VirtualPlan, rule: int, q: np.ndarray):
     b = np.where(tri, b_t, b_r)
     i = rp.order[(A + a).astype(np.int64)]
     j = rp.order[(Bs + b).astype(np.int64)]
+    if not compute_masked:
+        return i, j, None
     masked = np.zeros(len(q), bool)
     if plan.uid_codes is not None:
         masked |= plan.uid_codes[i] == plan.uid_codes[j]

@@ -530,3 +530,37 @@ def test_virtual_residual_str_numeric_mismatch_falls_back():
     ):
         s = _settings([rule])
         assert build_virtual_plan(s, encode_table(df, s)) is None, rule
+
+
+def test_virtual_residual_string_typed_numeric_values():
+    """A string-typed column holding numeric values: the host orders it
+    through str()-coerced ranks ('10' < '2'); the device must match."""
+    rng = np.random.default_rng(61)
+    n = 90
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["a", "b"], n),
+            "city": rng.choice(["x", "y", "z"], n),
+            # ints in a string-typed compared column: 2 vs 10 order as
+            # strings, not numbers
+            "code": rng.integers(1, 30, n),
+        }
+    )
+    s = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {"col_name": "name", "num_levels": 2},
+                {"col_name": "code", "num_levels": 2},  # string by default
+            ],
+            "blocking_rules": ["l.city = r.city and l.code < r.code"],
+        }
+    )
+    table = encode_table(df, s)
+    want = block_using_rules(s, table)
+    plan = build_virtual_plan(s, table, chunk=8)
+    assert plan is not None
+    i, j = _pairs_from_plan(plan)
+    assert len(i) == want.n_pairs
+    assert _pair_set(i, j) == _pair_set(want.idx_l, want.idx_r)
