@@ -70,7 +70,9 @@ SETTINGS = {
             "comparison": {"kind": "numeric_abs", "thresholds": [1.0]},
         },
     ],
-    "blocking_rules": [],
+    # referenced so the encode includes blk; the primary phase streams
+    # random pair batches and never runs blocking itself
+    "blocking_rules": ["l.blk = r.blk"],
 }
 
 
@@ -93,8 +95,46 @@ def _make_df(rng, n_rows):
             "surname": lasts[rng.integers(0, len(lasts), n_rows)],
             "city": cities[rng.integers(0, len(cities), n_rows)],
             "dob": rng.integers(1940, 2000, n_rows).astype(np.float64),
+            # blocking key sized for ~16M within-group pairs at N_ROWS
+            # (the virtual-pipeline phase blocks on this)
+            "blk": rng.integers(0, max(n_rows // 32, 1), n_rows),
         }
     )
+
+
+def _bench_virtual_pipeline(settings, table, prog):
+    """Device pair generation end to end: unit-plan build + one device
+    pass computing pattern ids/histogram with pairs decoded IN KERNEL.
+    Returns a dict of extras (never raises — a failure here must not lose
+    the primary metric)."""
+    try:
+        from splink_tpu.pairgen import (
+            build_virtual_plan,
+            compute_virtual_pattern_ids,
+        )
+
+        t0 = time.perf_counter()
+        plan = build_virtual_plan(settings, table)  # l.blk = r.blk
+        plan_time = time.perf_counter() - t0
+        if plan is None:
+            return {"virtual_error": "plan rejected"}
+        # full warmup pass compiles the per-rule kernels (cached on the
+        # plan), so the timed pass measures steady-state throughput
+        compute_virtual_pattern_ids(prog, plan, BATCH)
+        t0 = time.perf_counter()
+        _, counts, n_real = compute_virtual_pattern_ids(prog, plan, BATCH)
+        virt_time = time.perf_counter() - t0
+        return {
+            "virtual_pattern_pairs_per_sec": round(
+                plan.n_candidates / virt_time
+            ),
+            "virtual_candidates": plan.n_candidates,
+            "virtual_real_pairs": n_real,
+            "virtual_plan_seconds": round(plan_time, 3),
+            "virtual_pass_seconds": round(virt_time, 3),
+        }
+    except Exception as e:  # noqa: BLE001 - report, don't die
+        return {"virtual_error": f"{type(e).__name__}: {e}"[:200]}
 
 
 def main():
@@ -171,6 +211,8 @@ def main():
     res.params.lam.block_until_ready()
     em_time = time.perf_counter() - t1
 
+    extras = _bench_virtual_pipeline(settings, table, prog)
+
     print(json.dumps({
         "metric": "scored_record_pairs_per_sec_per_chip",
         "value": round(pairs_per_sec),
@@ -182,6 +224,7 @@ def main():
         "em_updates": int(res.n_updates),
         "encode_seconds": round(encode_time, 3),
         "device": str(jax.devices()[0]),
+        **extras,
     }))
 
 

@@ -107,7 +107,7 @@ def main():
         strong += int((chunk["match_probability"] >= 0.9).sum())
     wall = time.perf_counter() - t0
 
-    virtual = linker._virtual is not None
+    virtual = linker.device_pair_generation_active
     print(f"rows:              {len(df):,}")
     print(f"scored pairs:      {n_pairs:,}")
     print(f"p>=0.9 pairs:      {strong:,}")

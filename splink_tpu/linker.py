@@ -316,6 +316,13 @@ class Splink:
         _, n_patterns = pattern_strides_for(level_counts)
         return n_patterns <= MAX_PATTERNS
 
+    @property
+    def device_pair_generation_active(self) -> bool:
+        """Whether this run used (or will use) the virtual pair index —
+        pairs decoded on device with no host materialisation. Public
+        accessor for diagnostics/examples; the plan itself is internal."""
+        return self._virtual_plan() is not None
+
     def _estimate_pair_bound(self, table: EncodedTable) -> int:
         if self._pair_bound is None:
             from .blocking import estimate_pair_upper_bound

@@ -93,6 +93,10 @@ class RulePlan:
     pc: np.ndarray  # (U+1,) int64 cumulative pair counts over units
     residual: str | None = None  # translated residual predicate source
     residual_fn: object = None  # compiled device closure (see _ResCompiler)
+    # jitted kernels keyed by (id(program), batch_size): jax.jit caches on
+    # function identity, so rebuilding the closure per pass would recompile
+    # — reusing it makes a warmup pass actually warm the timed pass
+    kernel_cache: dict = field(default_factory=dict)
 
     @property
     def total(self) -> int:
@@ -967,12 +971,15 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
             jnp.asarray(rp.lb),
             codes_dev,
         )
-        fn = make_virtual_pattern_fn(
-            program, batch_size, n_prev=r,
-            has_uid_mask=plan.uid_codes is not None,
-            own_res=rp.residual_fn,
-            prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
-        )
+        kkey = (id(program), batch_size)
+        fn = rp.kernel_cache.get(kkey)
+        if fn is None:
+            fn = rp.kernel_cache[kkey] = make_virtual_pattern_fn(
+                program, batch_size, n_prev=r,
+                has_uid_mask=plan.uid_codes is not None,
+                own_res=rp.residual_fn,
+                prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
+            )
         for p0 in range(0, rp.total, batch_size):
             p1 = min(p0 + batch_size, rp.total)
             u0 = int(np.searchsorted(rp.pc, p0, side="right")) - 1
