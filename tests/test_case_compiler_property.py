@@ -108,6 +108,9 @@ class Gen:
             opts += [
                 (1, lambda: self.str_case_shift(depth)),
                 (1, lambda: self.str_ifnull(depth)),
+                (1, lambda: self.str_substr(depth)),
+                (1, lambda: self.str_concat(depth)),
+                (1, lambda: self.str_trim(depth)),
             ]
         return self.pick(opts)
 
@@ -137,6 +140,41 @@ class Gen:
             return fb(l, r) if a is None else a
 
         return f"ifnull({sa}, {sb})", fn
+
+    def str_substr(self, depth):
+        s, f = self.str_expr(depth - 1)
+        start = int(self.rng.integers(1, 5))
+        if self.rng.random() < 0.5:
+            ln = int(self.rng.integers(0, 5))
+            return f"substr({s}, {start}, {ln})", lambda l, r: (
+                None if f(l, r) is None
+                else f(l, r)[start - 1 : start - 1 + ln]
+            )
+        return f"substr({s}, {start})", lambda l, r: (
+            None if f(l, r) is None else f(l, r)[start - 1 :]
+        )
+
+    def str_concat(self, depth):
+        (sa, fa), (sb, fb) = self.str_expr(depth - 1), self.str_expr(depth - 1)
+
+        def fn(l, r):
+            a, b = fa(l, r), fb(l, r)
+            # Spark 2.x concat: NULL if any argument is NULL
+            return None if a is None or b is None else a + b
+
+        return f"concat({sa}, {sb})", fn
+
+    def str_trim(self, depth):
+        s, f = self.str_expr(depth - 1)
+        name = self.rng.choice(["trim", "ltrim", "rtrim"])
+        py = {
+            "trim": lambda x: x.strip(" "),
+            "ltrim": lambda x: x.lstrip(" "),
+            "rtrim": lambda x: x.rstrip(" "),
+        }[name]
+        return f"{name}({s})", lambda l, r: (
+            None if f(l, r) is None else py(f(l, r))
+        )
 
     # ---- boolean expressions: fn -> True | False | None (unknown) ----
 
@@ -255,7 +293,7 @@ class Gen:
 
 
 def _rows(rng, n):
-    strs = ["ann", "Bob", "new  york", "", "zz", None, "x'y"]
+    strs = ["ann", "Bob", "new  york", "", "zz", None, "x'y", " ab ", "  "]
     nums = [0.0, 1.0, -2.5, 3.75, None]
     return [
         {
