@@ -28,8 +28,10 @@ WORKER = os.path.join(os.path.dirname(__file__), "dist_worker.py")
 
 
 def test_two_process_streamed_em_matches_single_process(tmp_path):
-    # hang protection comes from the communicate(timeout=240) below —
-    # no pytest-timeout plugin dependency
+    # the worker subprocesses — the part that can deadlock on a
+    # misbehaving coordinator — are bounded by communicate(timeout=240);
+    # the in-process oracle phase is ordinary CPU jax like every other
+    # test (pytest-timeout is not available in this environment)
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

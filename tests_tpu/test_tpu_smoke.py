@@ -217,7 +217,12 @@ class TestVirtualPairsOnHardware:
             "comparison_columns": [
                 {"col_name": "name", "num_levels": 3},
             ],
-            "blocking_rules": ["l.dob = r.dob", "l.postcode = r.postcode"],
+            # second rule carries a residual predicate: it lowers to an
+            # on-device mask inside the virtual kernel
+            "blocking_rules": [
+                "l.dob = r.dob",
+                "l.postcode = r.postcode and l.name != r.name",
+            ],
             "max_resident_pairs": 2048,  # force the streamed regime
             "max_iterations": 4,
         }
