@@ -18,10 +18,13 @@ for c in 1 2 3; do
   python benchmarks/run.py --config "$c" || exit 1
 done
 
-echo "== 4/4 BASELINE configs 4-5 (large; streamed regime) =="
+echo "== 4/5 BASELINE configs 4-5 (large; streamed regime) =="
 for c in 4 5; do
   echo "-- config $c"
   python benchmarks/run.py --config "$c" || exit 1
 done
+
+echo "== 5/5 device-native example (virtual pair index on chip) =="
+python examples/large_scale_dedupe.py --rows 500000 || exit 1
 
 echo "ALL GREEN"
