@@ -1,0 +1,109 @@
+"""Kernel-only throughput: Pallas vs vmapped-JAX string similarity.
+
+Round 2's kernel numbers (BENCHMARKS.md) were taken with chained-execution
+timing because ``block_until_ready`` was unreliable through the tunnel;
+this script is the PROPER re-measurement harness: every timed repetition
+synchronises on the result, the first (compile) call is excluded, and the
+median of ``--reps`` runs is reported.
+
+    python benchmarks/kernel_bench.py [--pairs 1048576] [--width 24] [--reps 5]
+
+Prints one JSON line per (kernel, implementation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _random_strings(rng, n, width):
+    # realistic name-like lengths in [3, width]
+    lengths = rng.integers(3, width + 1, n).astype(np.int32)
+    chars = rng.integers(97, 123, (n, width)).astype(np.uint8)
+    mask = np.arange(width)[None, :] < lengths[:, None]
+    return (chars * mask).astype(np.uint8), lengths
+
+
+def _time_median(fn, reps):
+    fn()  # compile + warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        out.block_until_ready()  # REAL synchronisation, per repetition
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pairs", type=int, default=1 << 20)
+    ap.add_argument("--width", type=int, default=24)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax
+    import jax.numpy as jnp
+
+    from splink_tpu.ops import strings as so
+    from splink_tpu.ops.strings_pallas import (
+        jaro_winkler_pallas,
+        levenshtein_pallas,
+        pallas_supported,
+    )
+
+    rng = np.random.default_rng(0)
+    a_chars, a_len = _random_strings(rng, args.pairs, args.width)
+    b_chars, b_len = _random_strings(rng, args.pairs, args.width)
+    s1 = jnp.asarray(a_chars)
+    s2 = jnp.asarray(b_chars)
+    l1 = jnp.asarray(a_len)
+    l2 = jnp.asarray(b_len)
+
+    jw_vmap = jax.jit(lambda: so.jaro_winkler_batch(s1, s2, l1, l2))
+    lev_vmap = jax.jit(
+        lambda: jax.vmap(so.levenshtein_single)(s1, s2, l1, l2)
+    )
+    cases = [("jaro_winkler", "vmapped", jw_vmap),
+             ("levenshtein", "vmapped", lev_vmap)]
+    if pallas_supported(s1):
+        cases += [
+            ("jaro_winkler", "pallas",
+             jax.jit(lambda: jaro_winkler_pallas(s1, s2, l1, l2, 0.1, 0.0))),
+            ("levenshtein", "pallas",
+             jax.jit(lambda: levenshtein_pallas(s1, s2, l1, l2))),
+        ]
+    else:
+        print(json.dumps({"note": "pallas unsupported on this backend; "
+                          "vmapped only"}))
+
+    for kernel, impl, fn in cases:
+        sec = _time_median(fn, args.reps)
+        print(json.dumps({
+            "kernel": kernel,
+            "impl": impl,
+            "pairs": args.pairs,
+            "width": args.width,
+            "seconds_median": round(sec, 4),
+            "pairs_per_sec": round(args.pairs / sec),
+            "device": str(jax.devices()[0]),
+            "sync": "block_until_ready per rep",
+        }))
+
+
+if __name__ == "__main__":
+    main()

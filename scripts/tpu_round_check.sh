@@ -12,6 +12,9 @@ python -m pytest tests_tpu/ -q || exit 1
 echo "== 2/4 headline bench (bench.py) =="
 python bench.py || exit 1
 
+echo "== 2b kernel-only bench (proper per-rep sync) =="
+python benchmarks/kernel_bench.py || exit 1
+
 echo "== 3/4 BASELINE configs 1-3 =="
 for c in 1 2 3; do
   echo "-- config $c"
