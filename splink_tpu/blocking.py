@@ -12,6 +12,12 @@ Pair-set semantics are preserved exactly:
   * equality-conjunction rules (``l.a = r.a AND l.b = r.b``) become hash
     joins on combined key codes; rows with a null key never match (SQL
     equality semantics),
+  * function-of-column equalities (``substr(l.surname,1,3) =
+    substr(r.surname,1,3)``, a dmetaphone key) hash-join on host-derived
+    key columns (splink_tpu/derived_keys.py), and cross-column /
+    cross-expression equalities (``l.a = r.b``) hash-join through per-side
+    code arrays over a shared vocabulary — the reference ran all of these
+    as ordinary Spark joins (/root/reference/splink/blocking.py:141-158),
   * each rule's pairs exclude pairs produced by ANY earlier rule. The
     reference expresses this as ``AND NOT ifnull(previous_rule, false)``
     (/root/reference/splink/blocking.py:59-68) and that is literally what
@@ -244,6 +250,10 @@ def _ranges(counts: np.ndarray) -> np.ndarray:
 def _key_codes(table: EncodedTable, cols: list[str]) -> np.ndarray:
     """Combined int64 key codes for a list of columns; -1 where any is null.
 
+    Each entry is either a plain column name or a side-stripped derived-key
+    expression (``substr(surname,1,3)``) evaluated host-side by
+    splink_tpu/derived_keys.py — from here on a derived key is just codes.
+
     Cached per column tuple on the table instance (the `_uid_ranks`
     pattern): the overlap regime estimator and the blocking joins use the
     same keys, and refactorising billion-row columns twice would put
@@ -260,29 +270,37 @@ def _key_codes(table: EncodedTable, cols: list[str]) -> np.ndarray:
 
 
 def clear_key_code_cache(table: EncodedTable) -> None:
-    """Drop the per-table key-code cache once its consumers (estimator,
+    """Drop the per-table key-code caches once their consumers (estimator,
     plan build, blocking joins) are done — at billions of rows each cached
     tuple is an 8-bytes-per-row array that must not outlive blocking."""
     if getattr(table, "_key_code_cache", None):
         table._key_code_cache = {}
+    if getattr(table, "_asym_code_cache", None):
+        table._asym_code_cache = {}
+    from .derived_keys import clear_derived_key_cache
+
+    clear_derived_key_cache(table)
+
+
+def _pack_codes(combined: np.ndarray | None, codes: np.ndarray) -> np.ndarray:
+    """Fold one more key's codes into the running combination, refactorising
+    to keep codes < n_rows; -1 (null) anywhere makes the whole key null."""
+    if combined is None:
+        return codes.astype(np.int64)
+    card = int(codes.max()) + 1 if len(codes) else 1
+    null = (combined < 0) | (codes < 0)
+    packed = combined * card + codes
+    packed[null] = -1
+    uniq, inv = np.unique(packed[~null], return_inverse=True)
+    out = np.full(len(packed), -1, np.int64)
+    out[~null] = inv
+    return out
 
 
 def _key_codes_uncached(table: EncodedTable, cols: list[str]) -> np.ndarray:
     combined: np.ndarray | None = None
     for col in cols:
-        codes = _single_col_codes(table, col)
-        if combined is None:
-            combined = codes.astype(np.int64)
-            continue
-        # refactorise the running combination to keep codes < n_rows
-        card = int(codes.max()) + 1 if len(codes) else 1
-        null = (combined < 0) | (codes < 0)
-        packed = combined * card + codes
-        packed[null] = -1
-        uniq, inv = np.unique(packed[~null], return_inverse=True)
-        out = np.full(len(packed), -1, np.int64)
-        out[~null] = inv
-        combined = out
+        combined = _pack_codes(combined, _single_col_codes(table, col))
     assert combined is not None
     return combined
 
@@ -296,11 +314,100 @@ def _single_col_codes(table: EncodedTable, col: str) -> np.ndarray:
         out = np.full(table.n_rows, -1, np.int64)
         out[~nc.null_mask] = inv
         return out
+    if col in table.raw:
+        import pandas as pd
+
+        codes, _ = pd.factorize(pd.Series(table.raw[col]))
+        return codes.astype(np.int64)
+    from .derived_keys import is_plain_column, key_values_object
+
+    if is_plain_column(col):
+        # a bare column name that is in no column family: unknown column
+        raise KeyError(col)
+    # derived-key expression: evaluate host-side, factorise
     import pandas as pd
 
-    series = pd.Series(table.raw[col] if col in table.raw else table.column_values(col))
-    codes, _ = pd.factorize(series)
-    return codes.astype(np.int64)
+    vals, null = key_values_object(table, col)
+    codes, _ = pd.factorize(pd.Series(vals))
+    codes = codes.astype(np.int64)
+    codes[null] = -1
+    return codes
+
+
+def _key_codes_asym(
+    table: EncodedTable,
+    sym_cols: list[str],
+    asym_pairs: list[tuple[str, str]],
+) -> tuple[np.ndarray, np.ndarray]:
+    """(codes_l, codes_r) for a rule whose equality terms include
+    cross-column / cross-expression keys (``l.a = r.b``): each asymmetric
+    key pair factorises BOTH sides over one shared vocabulary so equal
+    values share a code across sides; symmetric keys contribute the same
+    code array to both sides. Cached per (sym, asym) signature."""
+    cache = getattr(table, "_asym_code_cache", None)
+    if cache is None:
+        cache = table._asym_code_cache = {}
+    key = (tuple(sym_cols), tuple(asym_pairs))
+    if key in cache:
+        return cache[key]
+
+    import pandas as pd
+
+    from .derived_keys import key_values_object
+
+    n = table.n_rows
+    combined_l: np.ndarray | None = None
+    combined_r: np.ndarray | None = None
+    # every key folds through the PAIR packer (symmetric keys contribute the
+    # same codes to both sides): refactorisation always runs over the union
+    # of both sides, so the running combined codes stay comparable across
+    # sides no matter how sym/asym keys interleave
+    for col in sym_cols:
+        codes = _single_col_codes(table, col)
+        combined_l, combined_r = _pack_codes_pair(
+            combined_l, codes, combined_r, codes
+        )
+    for lexpr, rexpr in asym_pairs:
+        vl, nl_ = key_values_object(table, lexpr)
+        vr, nr_ = key_values_object(table, rexpr)
+        joint, _ = pd.factorize(pd.Series(np.concatenate([vl, vr])))
+        joint = joint.astype(np.int64)
+        cl, cr = joint[:n].copy(), joint[n:].copy()
+        cl[nl_] = -1
+        cr[nr_] = -1
+        combined_l, combined_r = _pack_codes_pair(
+            combined_l, cl, combined_r, cr
+        )
+    assert combined_l is not None and combined_r is not None
+    cache[key] = (combined_l, combined_r)
+    return cache[key]
+
+
+def _pack_codes_pair(
+    comb_l: np.ndarray | None,
+    cl: np.ndarray,
+    comb_r: np.ndarray | None,
+    cr: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Fold one key's (cl, cr) codes into the running (combined_l,
+    combined_r), refactorising over the UNION of both sides so codes stay
+    comparable across sides. -1 (null) anywhere nulls the whole key."""
+    if comb_l is None:
+        return cl.astype(np.int64), cr.astype(np.int64)
+    card = max(int(max(cl.max(initial=-1), cr.max(initial=-1))) + 1, 1)
+    packed_all = []
+    for comb, c in ((comb_l, cl), (comb_r, cr)):
+        null = (comb < 0) | (c < 0)
+        packed = comb * card + c
+        packed[null] = -1
+        packed_all.append(packed)
+    both = np.concatenate(packed_all)
+    valid = both >= 0
+    uniq, inv = np.unique(both[valid], return_inverse=True)
+    res = np.full(len(both), -1, np.int64)
+    res[valid] = inv
+    n = len(comb_l)
+    return res[:n], res[n:]
 
 
 def _sort_groups(codes: np.ndarray, rows: np.ndarray):
@@ -346,10 +453,24 @@ def _self_join(
     return rows_sorted[p], rows_sorted[q]
 
 
-def _cross_join(codes: np.ndarray, left_rows: np.ndarray, right_rows: np.ndarray):
-    """All cross pairs between left_rows and right_rows sharing a key code."""
-    lrows, lcodes, lstarts, lsizes = _sort_groups(codes, left_rows[codes[left_rows] >= 0])
-    rrows, rcodes, rstarts, rsizes = _sort_groups(codes, right_rows[codes[right_rows] >= 0])
+def _cross_join(
+    codes_l: np.ndarray,
+    left_rows: np.ndarray,
+    right_rows: np.ndarray,
+    codes_r: np.ndarray | None = None,
+):
+    """All cross pairs (i from left_rows, j from right_rows) whose key codes
+    match. With ``codes_r`` the two sides read different code arrays (an
+    asymmetric key like ``l.a = r.b`` — both factorised over one shared
+    vocabulary by _key_codes_asym); otherwise one array serves both."""
+    if codes_r is None:
+        codes_r = codes_l
+    lrows, lcodes, lstarts, lsizes = _sort_groups(
+        codes_l, left_rows[codes_l[left_rows] >= 0]
+    )
+    rrows, rcodes, rstarts, rsizes = _sort_groups(
+        codes_r, right_rows[codes_r[right_rows] >= 0]
+    )
     # intersect group keys
     common, li, ri = np.intersect1d(lcodes, rcodes, return_indices=True)
     if len(common) == 0:
@@ -493,22 +614,36 @@ def estimate_pair_upper_bound(
     total = 0
     for rule in rules:
         eq_pairs, residual = parse_blocking_rule(rule)
-        join_cols, residual = _split_join_keys(eq_pairs, residual)
-        if not join_cols:
+        sym_cols, asym, residual = _split_join_keys(eq_pairs, residual)
+        if not sym_cols and not asym:
             total += n * n
             continue
-        codes = _key_codes(table, join_cols)
+        if asym:
+            codes_l, codes_r = _key_codes_asym(table, sym_cols, asym)
+        else:
+            codes_l = codes_r = _key_codes(table, sym_cols)
+        m = (
+            int(max(codes_l.max(initial=-1), codes_r.max(initial=-1))) + 1
+            if len(codes_l)
+            else 1
+        )
+        if m <= 0:
+            continue
         if link_type == "link_only":
             assert n_left is not None
-            cl, cr = codes[:n_left], codes[n_left:]
-            m = int(codes.max()) + 1 if len(codes) else 1
-            if m <= 0:
-                continue
+            cl, cr = codes_l[:n_left], codes_r[n_left:]
             hl = np.bincount(cl[cl >= 0], minlength=m).astype(np.int64)
             hr = np.bincount(cr[cr >= 0], minlength=m).astype(np.int64)
             total += int(hl @ hr)
+        elif asym:
+            # self-join on an asymmetric key: l-side histogram against
+            # r-side histogram over-counts by the rank filter and the
+            # diagonal — it stays an upper bound, which is the contract
+            hl = np.bincount(codes_l[codes_l >= 0], minlength=m).astype(np.int64)
+            hr = np.bincount(codes_r[codes_r >= 0], minlength=m).astype(np.int64)
+            total += int(hl @ hr)
         else:
-            valid = codes[codes >= 0]
+            valid = codes_l[codes_l >= 0]
             if len(valid):
                 cnt = np.bincount(valid).astype(np.int64)
                 total += int((cnt * (cnt - 1) // 2).sum())
@@ -578,23 +713,42 @@ def _block_rules_into(
         left_rows, right_rows = all_rows[:n_left], all_rows[n_left:]
     for rule in rules:
         eq_pairs, residual = parse_blocking_rule(rule)
-        join_cols, residual = _split_join_keys(eq_pairs, residual)
+        sym_cols, asym, residual = _split_join_keys(eq_pairs, residual)
 
-        if join_cols:
-            codes = _key_codes(table, join_cols)
+        if asym:
+            # asymmetric equality keys (l.a = r.b): hash join over the
+            # shared-vocabulary code pair
+            codes_l, codes_r = _key_codes_asym(table, sym_cols, asym)
+            if link_type == "link_only":
+                i, j = _cross_join(codes_l, left_rows, right_rows, codes_r)
+            else:
+                # f(l) = g(r) was written with the l side first; the
+                # reference's join enumerates ordered (l, r) pairs and its
+                # where-condition keeps rank_l < rank_r — so cross-join the
+                # table against itself and keep that orientation (no swap:
+                # swapping would change which side each expression applies
+                # to)
+                i, j = _cross_join(codes_l, all_rows, all_rows, codes_r)
+                ranks, keys_unique = _uid_ranks(table, link_type)
+                keep = ranks[i] < ranks[j]
+                i, j = i[keep], j[keep]
+                if not keys_unique:
+                    i, j = _drop_equal_key_pairs(table, link_type, i, j)
+        elif sym_cols:
+            codes_l = codes_r = _key_codes(table, sym_cols)
             if link_type == "link_only":
                 # oriented by construction: left input on the l side
-                i, j = _cross_join(codes, left_rows, right_rows)
+                i, j = _cross_join(codes_l, left_rows, right_rows)
             else:
                 # group members pre-sorted by uid rank -> pairs come out
                 # already oriented; only duplicate-key inputs need the
                 # drop-equal pass
                 ranks, keys_unique = _uid_ranks(table, link_type)
-                i, j = _self_join(codes, order=ranks)
+                i, j = _self_join(codes_l, order=ranks)
                 if not keys_unique:
                     i, j = _drop_equal_key_pairs(table, link_type, i, j)
         else:
-            codes = None
+            codes_l = codes_r = None
             warnings.warn(
                 f"Blocking rule {rule!r} has no equality condition; evaluating "
                 "it against all row pairs (quadratic)."
@@ -604,12 +758,12 @@ def _block_rules_into(
         if residual is not None:
             i, j = _eval_residual(table, residual, i, j)
 
-        for prev_codes, prev_residual in prior_rules:
-            holds = _rule_holds(table, prev_codes, prev_residual, i, j)
+        for prev_l, prev_r, prev_residual in prior_rules:
+            holds = _rule_holds(table, prev_l, prev_r, prev_residual, i, j)
             keep = ~holds
             i, j = i[keep], j[keep]
 
-        prior_rules.append((codes, residual))
+        prior_rules.append((codes_l, codes_r, residual))
         n_new = len(i)
         sink.append(i, j)
         if pair_consumer is not None:
@@ -625,16 +779,19 @@ def _block_rules_into(
 
 def _rule_holds(
     table: EncodedTable,
-    codes: np.ndarray | None,
+    codes_l: np.ndarray | None,
+    codes_r: np.ndarray | None,
     residual: str | None,
     i: np.ndarray,
     j: np.ndarray,
 ) -> np.ndarray:
     """Whether an (earlier) rule's predicate holds for each candidate pair:
     combined join-key equality (null keys never match) AND the residual
-    (UNKNOWN counts as not-holding — ifnull(..., false))."""
-    if codes is not None:
-        ci, cj = codes[i], codes[j]
+    (UNKNOWN counts as not-holding — ifnull(..., false)). Candidates are
+    already oriented with i on the l side, so an asymmetric earlier rule
+    reads codes_l[i] against codes_r[j]."""
+    if codes_l is not None:
+        ci, cj = codes_l[i], codes_r[j]
         holds = (ci == cj) & (ci >= 0)
     else:
         holds = np.ones(len(i), bool)
@@ -647,20 +804,24 @@ def _rule_holds(
     return holds
 
 
-def _split_join_keys(eq_pairs, residual: str | None) -> tuple[list[str], str | None]:
-    """Same-column equalities become hash-join keys; cross-column equalities
-    (l.a = r.b — different key vocabularies) are appended to the residual
-    predicate so they still filter the joined candidates."""
-    cols, extra = [], []
+def _split_join_keys(
+    eq_pairs, residual: str | None
+) -> tuple[list[str], list[tuple[str, str]], str | None]:
+    """-> (sym_cols, asym_pairs, residual). Same-expression equalities
+    (``l.x = r.x``, ``substr(l.x,1,3) = substr(r.x,1,3)``) become symmetric
+    hash-join keys; cross-column / cross-expression equalities (``l.a =
+    r.b`` — a name-swap block, say) keep distinct left/right keys and
+    hash-join through a shared vocabulary (_key_codes_asym) instead of the
+    round-3 behaviour of filtering them as residuals after a join on the
+    remaining keys (quadratic when they were the ONLY equality)."""
+    sym: list[str] = []
+    asym: list[tuple[str, str]] = []
     for lc, rc in eq_pairs:
         if lc == rc:
-            cols.append(lc)
+            sym.append(lc)
         else:
-            extra.append(f'(l["{lc}"] == r["{rc}"])')
-    if extra:
-        parts = ([f"({residual})"] if residual else []) + extra
-        residual = " & ".join(parts)
-    return cols, residual
+            asym.append((lc, rc))
+    return sym, asym, residual
 
 
 def _all_pairs(table: EncodedTable, link_type: str, n_left: int | None):

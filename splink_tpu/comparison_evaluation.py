@@ -12,8 +12,17 @@ import re
 
 
 def blocking_rule_columns(blocking_rule: str) -> list[str]:
-    parts = re.split(r" |=", blocking_rule)
-    return [p.replace("l.", "") for p in parts if "l." in p]
+    """Every l.-side column the rule references, in order, deduplicated —
+    robust to function-of-column keys (``substr(l.surname, 1, 3) = ...``)
+    and cross-column equalities (``l.first_name = r.surname``), which the
+    reference's split-on-space-or-'=' parse would mangle into pseudo-column
+    names. For a derived key the diagnostic groups by the underlying raw
+    column — a superset blocking of the derived key, so still the right
+    skew probe."""
+    seen: dict[str, None] = {}
+    for m in re.finditer(r"\bl\.(\w+)", blocking_rule):
+        seen.setdefault(m.group(1))
+    return list(seen)
 
 
 def get_largest_blocks(blocking_rule: str, df, limit: int = 5):

@@ -251,12 +251,78 @@ def _parse_name_inversion(s: str) -> dict | None:
 _EQ_TERM = re.compile(r"^\s*l\.(\w+)\s*=\s*r\.(\w+)\s*$")
 
 
+def _split_single_eq(term: str) -> tuple[str, str] | None:
+    """Split a term on its single top-level '=' (not <=, >=, !=, <>, ==),
+    paren- and quote-aware. None when there is no clean single '='."""
+    positions = []
+    depth, i = 0, 0
+    while i < len(term):
+        ch = term[i]
+        if ch == "'":
+            end = term.find("'", i + 1)
+            i = len(term) if end < 0 else end + 1
+            continue
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "=" and depth == 0:
+            prev = term[i - 1] if i else ""
+            nxt = term[i + 1] if i + 1 < len(term) else ""
+            if prev not in "<>!=" and nxt != "=":
+                positions.append(i)
+        i += 1
+    if len(positions) != 1:
+        return None
+    p = positions[0]
+    return term[:p].strip(), term[p + 1 :].strip()
+
+
+def _try_derived_eq(term: str) -> tuple[str, str] | None:
+    """Recognise a function-of-column equality join term: ``EXPR_L = EXPR_R``
+    where one side references only l.* columns and the other only r.*
+    columns, both within the derived-key evaluator's function surface
+    (splink_tpu/derived_keys.py). Returns the side-stripped canonical
+    (left_key, right_key) — the reference runs such predicates as ordinary
+    Spark joins (/root/reference/splink/blocking.py:141-158); here they
+    become ordinary hash-join keys on precomputed derived columns."""
+    from .derived_keys import (
+        DerivedKeyError,
+        canonical,
+        expr_sides,
+        parse_key_expr,
+        strip_side,
+    )
+
+    parts = _split_single_eq(term)
+    if parts is None:
+        return None
+    try:
+        na, nb = parse_key_expr(parts[0]), parse_key_expr(parts[1])
+    except DerivedKeyError:
+        return None
+    sa, sb = expr_sides(na), expr_sides(nb)
+    if sa == {"l"} and sb == {"r"}:
+        pass
+    elif sa == {"r"} and sb == {"l"}:
+        na, nb = nb, na
+    else:
+        return None
+    return canonical(strip_side(na)), canonical(strip_side(nb))
+
+
 def parse_blocking_rule(rule: str):
     """Parse a blocking rule into (equality_pairs, residual_predicate).
 
-    equality_pairs: list of (left_col, right_col) from top-level AND-ed
-    ``l.col = r.col`` terms; these become hash-join keys (SQL inner-join
-    equality semantics: rows with a null key never match).
+    equality_pairs: list of (left_key, right_key) from top-level AND-ed
+    equality terms; these become hash-join keys (SQL inner-join equality
+    semantics: rows with a null key never match). Each key is either a bare
+    column name (``l.col = r.col``) or a side-stripped derived-key
+    expression (``substr(l.surname,1,3) = substr(r.surname,1,3)`` ->
+    ``substr(surname,1,3)`` on both sides) evaluated host-side by
+    splink_tpu/derived_keys.py. Cross-column / cross-expression equalities
+    (l.a = r.b) keep distinct left and right keys and hash-join over a
+    shared vocabulary.
 
     residual_predicate: a compiled python expression (numpy semantics) for any
     remaining AND-ed terms, or None. Evaluated against dicts ``l``/``r`` of
@@ -280,6 +346,10 @@ def parse_blocking_rule(rule: str):
         m = _EQ_TERM.match(t)
         if m:
             eq_pairs.append((m.group(1), m.group(2)))
+            continue
+        derived = _try_derived_eq(t)
+        if derived is not None:
+            eq_pairs.append(derived)
         else:
             residual_terms.append(t)
 
@@ -386,9 +456,41 @@ def _parens_match_whole(s: str) -> bool:
     return False
 
 
+def _rewrite_concat_and_cast(s: str) -> str:
+    """Quote-aware lexical rewrites for the atom translation:
+      * SQL's ``||`` string-concat operator becomes ``@`` (Python's MatMult
+        — unused otherwise, so the residual evaluators can give it concat
+        semantics WITHOUT conflating it with SQL's numeric ``+``, which on
+        strings means add-after-cast, not concatenation);
+      * ``cast(x AS t)`` becomes ``cast(x, 't')`` so the expression stays
+        parseable Python (``as`` is a keyword)."""
+    out, i = [], 0
+    while i < len(s):
+        ch = s[i]
+        if ch == "'":
+            end = s.find("'", i + 1)
+            end = len(s) if end < 0 else end + 1
+            out.append(s[i:end])
+            i = end
+            continue
+        if s.startswith("||", i):
+            out.append("@")
+            i += 2
+            continue
+        m = re.match(r"(?i)\bas\s+(\w+)\s*\)", s[i:])
+        if m and i and (s[i - 1].isspace()):
+            out.append(f", '{m.group(1)}')")
+            i += m.end()
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
 def _translate_atom(atom: str) -> str:
     """Translate one comparison atom (no boolean operators) to Python."""
-    s = re.sub(r"\bl\.(\w+)", r'l["\1"]', atom)
+    s = _rewrite_concat_and_cast(atom)
+    s = re.sub(r"\bl\.(\w+)", r'l["\1"]', s)
     s = re.sub(r"\br\.(\w+)", r'r["\1"]', s)
     s = re.sub(r"(?<![<>!=])=(?!=)", "==", s)
     s = s.replace("<>", "!=")

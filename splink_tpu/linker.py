@@ -234,10 +234,10 @@ class Splink:
         # to pair_batch_size
         batch = int(self.settings["pair_batch_size"])
         batch = max(min(batch, -(-max(bound, 1) // 8) * 8), 1024)
-        # _pattern_capable covers the custom-kernel, mesh, and
-        # pattern-space conditions — the same eligibility the
-        # post-blocking pipeline choice uses
-        if bound > max_resident and self._pattern_capable():
+        # _pattern_capable covers the custom-kernel and pattern-space
+        # conditions; the overlap PatternStream consumer is a materialised
+        # single-device pass, so a mesh additionally excludes it here
+        if bound > max_resident and self._pattern_capable() and mesh is None:
             self._pattern_program = program
             return PatternStream(program, batch)
         keep_limit = max_resident if mesh is None else 0
@@ -299,14 +299,15 @@ class Splink:
         return self._G
 
     def _pattern_capable(self) -> bool:
-        """Static part of the pattern-pipeline test: bounded pattern space,
-        no mesh (the mesh path shards gamma batches), and no custom
-        comparison kernels — a registered kernel could emit gammas outside
-        [-1, num_levels-1], which would alias pattern ids."""
+        """Static part of the pattern-pipeline test: bounded pattern space
+        and no custom comparison kernels — a registered kernel could emit
+        gammas outside [-1, num_levels-1], which would alias pattern ids.
+        A mesh does NOT disqualify: the virtual pair index shards its
+        batches over the mesh (pairgen.make_virtual_pattern_fn); only the
+        MATERIALISED pattern pass is single-device (its callers gate on
+        the mesh themselves)."""
         from .gammas import MAX_PATTERNS, pattern_strides_for
 
-        if mesh_from_settings(self.settings) is not None:
-            return False
         for c in self.settings["comparison_columns"]:
             if (c.get("comparison") or {}).get("kind") == "custom":
                 return False
@@ -376,6 +377,10 @@ class Splink:
             return True
         if not self._pattern_capable():
             return False
+        if mesh_from_settings(self.settings) is not None:
+            # the materialised pattern pass is single-device; mesh jobs
+            # without a virtual plan shard gamma batches instead
+            return False
         pairs = self._ensure_pairs()
         return pairs.n_pairs > int(self.settings["max_resident_pairs"])
 
@@ -403,6 +408,7 @@ class Splink:
                             self._pattern_program,
                             self._virtual,
                             int(self.settings["pair_batch_size"]),
+                            mesh=mesh_from_settings(self.settings),
                         )
                     )
                 logger.info(

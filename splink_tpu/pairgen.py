@@ -55,6 +55,7 @@ f64 host path — the CPU tier (x64) is bit-identical.
 from __future__ import annotations
 
 import ast
+import functools
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -111,6 +112,7 @@ class VirtualPlan:
     n_candidates: int  # sum of rule totals (mask not yet applied)
     res_ops: list[np.ndarray] = field(default_factory=list)  # residual operand arrays
     table: EncodedTable | None = None  # for host-side residual oracle
+    chunk: int = CHUNK  # unit extent the plan was built with (int32 margin)
 
     def rule_offsets(self) -> np.ndarray:
         """(R+1,) int64 global position offset of each rule's segment."""
@@ -163,7 +165,13 @@ class _ResCompiler:
             self.ops.append(build())
         return self.op_index[key]
 
-    def _col_values_null(self, col: str):
+    def _col_values_null(self, col):
+        if isinstance(col, tuple) and col[0] == "expr":
+            # a derived pseudo-column: a single-side SQL function
+            # subexpression precomputed host-side (see _derived_value)
+            from .derived_keys import key_values_object
+
+            return key_values_object(self.table, col[1])
         vals = np.asarray(self.table.column_values(col), dtype=object)
         null = self.table.is_null(col)
         return vals, null
@@ -220,7 +228,9 @@ class _ResCompiler:
             except TypeError as e:
                 raise _ResUnsupported(f"unsortable column {col!r}") from e
 
-        c1, c2 = sorted((cola, colb))
+        # key=repr: plain column names (str) and derived pseudo-columns
+        # (("expr", canon) tuples) are not mutually orderable
+        c1, c2 = sorted((cola, colb), key=repr)
         union_key = ("joint_vocab", c1, c2)
         if union_key not in self.aux:
             try:
@@ -339,11 +349,15 @@ class _ResCompiler:
                     "sub": lambda: x - y,
                     "mul": lambda: x * y,
                     "div": lambda: x / y,
-                    "mod": lambda: jnp.mod(x, y),
+                    # host parity: SQL % takes the dividend's sign
+                    "mod": lambda: jnp.fmod(x, y),
                     "pow": lambda: x**y,
                 }[opname]()
 
             return ("num", arith)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+            # `@` = compat_sql's translation of SQL's `||` concat operator
+            return self._derived_value(node)
         if isinstance(node, ast.Call):
             if isinstance(node.func, ast.Name) and node.func.id == "abs":
                 (arg,) = node.args
@@ -355,8 +369,50 @@ class _ResCompiler:
                     return jnp.abs(f(i, j, ops))
 
                 return ("num", absf)
-            raise _ResUnsupported("call in value position")
+            return self._derived_value(node)
         raise _ResUnsupported(f"value node {type(node).__name__}")
+
+    def _derived_value(self, node):
+        """Single-side SQL scalar function subexpressions (substr, lower,
+        concat, coalesce, length, ..., and ``@`` = SQL ``||``) precompute
+        host-side into a per-row derived operand via derived_keys — the
+        SAME implementation of the function semantics the host residual
+        interpreter and the blocking join keys use — then compare on
+        device by rank like any column. Functions mixing both sides in one
+        call (concat(l.a, r.b)) have no per-row precompute; those reject
+        the plan (host fallback)."""
+        from .derived_keys import (
+            DerivedKeyError,
+            canonical,
+            evaluate_key,
+            expr_sides,
+            pyast_to_keynode,
+            strip_side,
+        )
+
+        try:
+            knode = pyast_to_keynode(node)
+        except DerivedKeyError as e:
+            raise _ResUnsupported(str(e)) from None
+        sides = expr_sides(knode)
+        if len(sides) != 1:
+            raise _ResUnsupported("cross-side function subexpression")
+        (side,) = sides
+        canon = canonical(strip_side(knode))
+        try:
+            kind, vals, null = evaluate_key(self.table, canon)
+        except DerivedKeyError as e:
+            raise _ResUnsupported(str(e)) from None
+        if kind == "num":
+
+            def build(vals=vals, null=null):
+                out = vals.copy()
+                out[null] = np.nan
+                return out
+
+            idx = self._register(("dnum", canon), build)
+            return ("num", self._gather_num(idx, side))
+        return ("str", ("expr", canon), None, side)
 
     @staticmethod
     def _gather_num(idx: int, side: str):
@@ -370,6 +426,9 @@ class _ResCompiler:
         """Numeric closure from a value. String/raw columns coerce through
         the host's pd.to_numeric ONCE at plan build (the array uploads like
         any other operand), matching SQL's implicit CAST semantics."""
+        # marker for build_virtual_plan's f32-divergence warning: numeric
+        # arithmetic in a device residual evaluates in f32 on TPU
+        self.aux["numeric_used"] = True
         if v[0] == "num":
             return v[1]
         if v[0] == "lit_n":
@@ -683,9 +742,22 @@ def build_virtual_plan(
     res_aux: dict = {}
     for rule in rules:
         eq_pairs, residual = parse_blocking_rule(rule)
-        join_cols, residual = _split_join_keys(eq_pairs, residual)
-        if not join_cols:
+        sym_cols, asym, residual = _split_join_keys(eq_pairs, residual)
+        if not sym_cols:
+            # no symmetric key to group on (a lone l.a = r.b, or no
+            # equality at all): host blocking handles it
             return None
+        if asym:
+            # fold asymmetric equality keys into this rule's residual:
+            # candidates still group by the symmetric keys and the device
+            # mask enforces the cross-column equality via joint-vocabulary
+            # ranks — host blocking meanwhile uses its shared-vocabulary
+            # hash join (blocking._key_codes_asym); the pair sets match
+            from .derived_keys import asym_residual_src
+
+            term = asym_residual_src(asym)
+            residual = f"({residual}) & {term}" if residual else term
+        join_cols = sym_cols
         res_fn = None
         if residual is not None:
             res_fn = compile_residual_device(
@@ -695,6 +767,20 @@ def build_virtual_plan(
                 return None
         parsed_cols.append(join_cols)
         residuals.append((residual, res_fn))
+    if res_aux.get("numeric_used"):
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            import logging
+
+            logging.getLogger("splink_tpu").warning(
+                "device pair generation: a blocking residual contains "
+                "numeric arithmetic, which evaluates in float32 on TPU "
+                "(no f64) — a pair exactly on a threshold may land "
+                "differently than the float64 host path. Set "
+                "device_pair_generation='off' for bit-identical host "
+                "blocking."
+            )
 
     n = table.n_rows
     uid_codes = None
@@ -779,6 +865,7 @@ def build_virtual_plan(
         n_candidates=sum(rp.total for rp in plans),
         res_ops=res_ops,
         table=table,
+        chunk=chunk,
     )
 
 
@@ -851,12 +938,21 @@ def decode_positions(plan: VirtualPlan, rule: int, q: np.ndarray,
 
 def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
                             has_uid_mask: bool, own_res=None,
-                            prev_res=()):
+                            prev_res=(), mesh=None):
     """Jitted (pid, acc) kernel decoding + scoring one batch of virtual
     pair positions. Shapes of the plan arrays vary per rule, so XLA
     compiles one executable per (rule shape, kpad bucket) — a handful per
     run. own_res / prev_res are compiled residual closures (traced into
-    this jit; the ops arrays arrive as the res_ops argument)."""
+    this jit; the ops arrays arrive as the res_ops argument).
+
+    With ``mesh``, the batch SHARDS over the mesh's data axis: ``pos``
+    arrives as a sharded iota (the only sharded input — plan arrays, table
+    data and codes are replicated), every per-position op partitions
+    trivially along it, and XLA inserts one psum for the histogram
+    accumulator. This is how the virtual pair index composes with
+    multi-chip EM: each chip decodes and scores its own slice of every
+    unit, the way the reference's Spark join distributed its shuffle
+    partitions (/root/reference/splink/blocking.py:210)."""
     import jax
     import jax.numpy as jnp
 
@@ -864,10 +960,19 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
     strides_dev = jnp.asarray(program._pattern_strides, jnp.int32)
     gamma_fn = program._gamma_batch_fn
 
-    @jax.jit
-    def fn(packed, order, ua, la, ub, lb, prev_codes, uid_codes,
+    jit_kwargs = {}
+    if mesh is not None:
+        from .parallel.mesh import pair_sharding, replicated
+
+        # pid comes back sharded along the pair axis; the histogram is the
+        # cross-shard psum and replicates
+        jit_kwargs = {
+            "out_shardings": (pair_sharding(mesh), replicated(mesh)),
+        }
+
+    @functools.partial(jax.jit, **jit_kwargs)
+    def fn(pos, packed, order, ua, la, ub, lb, prev_codes, uid_codes,
            res_ops, pc_slice, u0, valid, acc):
-        pos = jnp.arange(batch_size, dtype=jnp.int32)
         ui = jnp.searchsorted(pc_slice, pos, side="right").astype(jnp.int32) - 1
         t = pos - pc_slice[ui]
         u = u0 + ui
@@ -923,14 +1028,19 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
 
 
 def compute_virtual_pattern_ids(program, plan: VirtualPlan,
-                                batch_size: int):
+                                batch_size: int, mesh=None):
     """One device pass over the VIRTUAL pair stream: (pids, counts,
     n_real). pids carries the sentinel value ``n_patterns`` for masked
     (deduped) positions; counts excludes them; n_real = counts.sum().
 
     Host work per batch is O(units-in-batch): a searchsorted plus an int32
     slice of the unit cumulative table. No pair indices cross the link.
+
+    With ``mesh``, each batch SHARDS over the mesh's data axis (see
+    make_virtual_pattern_fn) — bit-identical output to the single-device
+    pass, with per-chip work divided by the mesh size.
     """
+    import jax
     import jax.numpy as jnp
 
     from .gammas import _HIST_FLUSH_BATCHES
@@ -943,35 +1053,69 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
     counts = np.zeros(n_patterns, np.int64)
     if total == 0:
         return pids, counts, 0
-    batch_size = min(batch_size, max(total, 1))
+    # int32-safe bound: the device kernel reads batch-relative positions in
+    # int32, and pc_rel below can exceed the batch end by up to one unit's
+    # pair count (CHUNK^2) — an unbounded settings pair_batch_size near 2^31
+    # must clamp here, not silently corrupt the unit decode (np.clip alone
+    # would wrap positions INSIDE the batch)
+    # margin from the plan's ACTUAL unit extent, not the module default —
+    # a plan built with a larger chunk has larger pc_rel overshoot
+    safe = (1 << 31) - 1 - plan.chunk * plan.chunk
+    batch_size = min(batch_size, max(total, 1), safe)
+    if mesh is not None:
+        from .parallel.mesh import (
+            pad_to_multiple,
+            pair_sharding,
+            replicated,
+        )
+
+        # the sharded iota splits evenly over the mesh; positions past
+        # `valid` carry the sentinel and drop like any masked position.
+        # Padding must not push back above the int32-safe bound the clamp
+        # just enforced — round DOWN to a mesh multiple in that case
+        msz = mesh.devices.size
+        batch_size = pad_to_multiple(batch_size, msz)
+        if batch_size > safe:
+            batch_size = max(safe // msz, 1) * msz
+        shard = pair_sharding(mesh)
+        repl = replicated(mesh)
+        put = lambda a: jax.device_put(jnp.asarray(a), repl)  # noqa: E731
+        pos_dev = jax.device_put(
+            np.arange(batch_size, dtype=np.int32), shard
+        )
+    else:
+        put = jnp.asarray
+        pos_dev = jnp.arange(batch_size, dtype=jnp.int32)
     flush_every = max(min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1)
-    acc = jnp.zeros(n_patterns + 1, jnp.int32)
+    acc = put(np.zeros(n_patterns + 1, np.int32))
     in_acc = 0
     pending = None
     packed = program._packed
-    uid_dev = (
-        jnp.asarray(plan.uid_codes) if plan.uid_codes is not None
-        else jnp.zeros(1, jnp.int32)
+    if mesh is not None:
+        packed = jax.device_put(packed, repl)
+    uid_dev = put(
+        plan.uid_codes if plan.uid_codes is not None
+        else np.zeros(1, np.int32)
     )
     # all rules' codes and residual operand arrays upload ONCE (the
     # kernel's static n_prev bounds how many code rows it reads); per-rule
     # plan arrays + kernel are built per rule (shapes differ, so each rule
     # is its own jit specialisation)
-    codes_dev = jnp.asarray(plan.codes)
-    res_ops_dev = tuple(jnp.asarray(a) for a in plan.res_ops)
+    codes_dev = put(plan.codes)
+    res_ops_dev = tuple(put(a) for a in plan.res_ops)
     out_pos = 0
     for r, rp in enumerate(plan.rules):
         if rp.total == 0:
             continue
         dev = (
-            jnp.asarray(rp.order),
-            jnp.asarray(rp.ua),
-            jnp.asarray(rp.la),
-            jnp.asarray(rp.ub),
-            jnp.asarray(rp.lb),
+            put(rp.order),
+            put(rp.ua),
+            put(rp.la),
+            put(rp.ub),
+            put(rp.lb),
             codes_dev,
         )
-        kkey = (id(program), batch_size)
+        kkey = (id(program), batch_size, None if mesh is None else id(mesh))
         fn = rp.kernel_cache.get(kkey)
         if fn is None:
             fn = rp.kernel_cache[kkey] = make_virtual_pattern_fn(
@@ -979,6 +1123,7 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
                 has_uid_mask=plan.uid_codes is not None,
                 own_res=rp.residual_fn,
                 prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
+                mesh=mesh,
             )
         for p0 in range(0, rp.total, batch_size):
             p1 = min(p0 + batch_size, rp.total)
@@ -991,8 +1136,8 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
             padded = np.full(kpad, np.iinfo(np.int32).max, np.int64)
             padded[: k + 1] = np.clip(pc_rel, -(1 << 31) + 1, (1 << 31) - 1)
             pid, acc = fn(
-                packed, *dev[:5], dev[5], uid_dev, res_ops_dev,
-                jnp.asarray(padded.astype(np.int32)),
+                pos_dev, packed, *dev[:5], dev[5], uid_dev, res_ops_dev,
+                put(padded.astype(np.int32)),
                 jnp.int32(u0), jnp.int32(p1 - p0), acc,
             )
             if pending is not None:
@@ -1005,7 +1150,10 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
             in_acc += 1
             if in_acc >= flush_every:
                 counts += np.asarray(acc[:-1], np.int64)
-                acc = jnp.zeros(n_patterns + 1, jnp.int32)
+                # reset through put(): a plain jnp.zeros would drop the
+                # replicated sharding under a mesh and force a reshard /
+                # second executable on the next batch
+                acc = put(np.zeros(n_patterns + 1, np.int32))
                 in_acc = 0
     if pending is not None:
         ps, n_valid, prev = pending

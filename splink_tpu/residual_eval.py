@@ -19,6 +19,12 @@ this module replaces it with a typed AST interpreter:
     atom UNKNOWN, and UNKNOWN propagates through AND/OR/NOT by Kleene rules,
     with rows kept only when the predicate is known-true. (This also fixes
     ``l.x <> r.x`` keeping null rows, which numpy's NaN != NaN would do.)
+  * SQL scalar functions (substr, lower/upper, trim, concat / ``||``,
+    coalesce/ifnull, length, left/right, reverse, dmetaphone, round, cast,
+    ...) evaluate through derived_keys.PairEval — the SAME implementation
+    that computes derived blocking join keys and the device residual
+    compiler's precomputed operands, so one definition of each function's
+    (null) semantics serves all three consumers.
 
 The reference gets all of this from the SQL engine for free
 (/root/reference/splink/blocking.py:141-158); here it is ~200 lines that run
@@ -129,6 +135,20 @@ class RawOperand:
         return self.table.is_null(self.col)[self.rows]
 
 
+class Materialized:
+    """A computed string vector (the result of a SQL scalar function like
+    substr/lower/concat, evaluated by derived_keys.PairEval): object values
+    plus an explicit null mask. Compares like a raw column."""
+
+    def __init__(self, values: np.ndarray, null: np.ndarray):
+        self.values = values
+        self.null = null
+
+
+# Operands that carry (values, null) object vectors
+_OBJECT_OPERANDS = (StrOperand, RawOperand, Materialized)
+
+
 _CMP = {
     ast.Eq: np.equal,
     ast.NotEq: np.not_equal,
@@ -143,7 +163,8 @@ _ARITH = {
     ast.Sub: np.subtract,
     ast.Mult: np.multiply,
     ast.Div: np.divide,
-    ast.Mod: np.mod,
+    # fmod, not mod: SQL's % takes the dividend's sign (Spark: -7 % 3 = -1)
+    ast.Mod: np.fmod,
     ast.Pow: np.power,
 }
 
@@ -182,7 +203,7 @@ class _Evaluator:
             )
         (arg,) = node.args
         operand = self.value_eval(arg)
-        if isinstance(operand, (StrOperand, RawOperand)):
+        if isinstance(operand, _OBJECT_OPERANDS):
             null = operand.null
         elif isinstance(operand, np.ndarray):
             null = np.isnan(operand)
@@ -220,8 +241,10 @@ class _Evaluator:
             return self._numeric_cmp(ufunc, lv.ranks, lv.literal_rank(rv))
         if isinstance(rv, StrOperand) and isinstance(lv, str):
             return self._numeric_cmp(ufunc, rv.literal_rank(lv), rv.ranks)
-        # raw column involved: object comparison
-        if isinstance(lv, RawOperand) or isinstance(rv, RawOperand):
+        # raw / computed string operand involved: object comparison
+        if isinstance(lv, (RawOperand, Materialized)) or isinstance(
+            rv, (RawOperand, Materialized)
+        ):
             lvals, lnull = self._raw_side(lv)
             rvals, rnull = self._raw_side(rv)
             return self._object_cmp(ufunc, lvals, lnull, rvals, rnull)
@@ -251,7 +274,7 @@ class _Evaluator:
         return Kleene(val, unk)
 
     def _raw_side(self, v):
-        if isinstance(v, (StrOperand, RawOperand)):
+        if isinstance(v, _OBJECT_OPERANDS):
             return v.values, v.null
         arr = np.full(self.n, v, dtype=object)
         return arr, np.zeros(self.n, bool)
@@ -282,6 +305,9 @@ class _Evaluator:
             if isinstance(v, (np.ndarray, int, float)):
                 return -v
             raise ResidualEvalError("Unary minus on a non-numeric operand")
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+            # `@` is compat_sql's translation of SQL's `||` concat operator
+            return self._derived(node)
         if isinstance(node, ast.BinOp) and type(node.op) in _ARITH:
             a = self._numeric_value(node.left)
             b = self._numeric_value(node.right)
@@ -297,15 +323,34 @@ class _Evaluator:
         if isinstance(node.func, ast.Name) and node.func.id == "abs":
             (arg,) = node.args
             return np.abs(self._numeric_value(arg))
-        raise ResidualEvalError(
-            "Only abs(...) is supported as a value function in residuals"
-        )
+        return self._derived(node)
+
+    def _derived(self, node: ast.AST):
+        """SQL scalar functions (substr/lower/upper/trim/concat/coalesce/
+        length/left/right/reverse/dmetaphone/round/cast, plus ``@`` = SQL
+        ``||``) evaluate through derived_keys.PairEval — ONE implementation
+        of the function semantics shared with blocking join keys and the
+        device residual compiler (pairgen._ResCompiler)."""
+        from .derived_keys import DerivedKeyError, PairEval, pyast_to_keynode
+
+        try:
+            knode = pyast_to_keynode(node)
+            kind, vals, null = PairEval(
+                self.table, self.namespaces["l"], self.namespaces["r"]
+            ).eval(knode)
+        except DerivedKeyError as e:
+            raise ResidualEvalError(str(e)) from None
+        if kind == "num":
+            out = vals.copy()
+            out[null] = np.nan
+            return out
+        return Materialized(vals, null)
 
     def _numeric_value(self, node: ast.AST) -> np.ndarray | float | int:
         v = self.value_eval(node)
         if isinstance(v, (np.ndarray, int, float)):
             return v
-        if isinstance(v, (StrOperand, RawOperand)):
+        if isinstance(v, _OBJECT_OPERANDS):
             # SQL implicitly casts in numeric contexts (CAST(col AS DOUBLE));
             # unparseable values and nulls become NaN -> comparison unknown.
             import pandas as pd

@@ -94,9 +94,35 @@ def term_frequency_columns(settings: dict):
         if "col_name" in c:
             out.setdefault(c["col_name"])
         else:
-            for used in c.get("custom_columns_used", ()):
-                out.setdefault(used)
+            used = tuple(c.get("custom_columns_used", ()))
+            if used:
+                _warn_custom_tf_once(used)
+            for used_col in used:
+                out.setdefault(used_col)
     return out.keys()
+
+
+_custom_tf_warned = False
+
+
+def _warn_custom_tf_once(used: tuple) -> None:
+    """The reference does not support TF adjustment on custom comparisons
+    (its selection keys on col_name, /root/reference/splink/
+    term_frequencies.py:130-134); splink_tpu extends the per-column formula
+    to each custom_columns_used. Announce the extension once so previously
+    flagged configs know their scores now include these adjustments."""
+    global _custom_tf_warned
+    if _custom_tf_warned:
+        return
+    _custom_tf_warned = True
+    import logging
+
+    logging.getLogger("splink_tpu").warning(
+        "term_frequency_adjustments on a custom comparison applies "
+        "per-used-column adjustments to %s — an extension beyond the "
+        "reference, which skipped custom comparisons (see docs/api.md).",
+        list(used),
+    )
 
 
 def _next_pow2(n: int) -> int:

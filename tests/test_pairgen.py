@@ -564,3 +564,95 @@ def test_virtual_residual_string_typed_numeric_values():
     i, j = _pairs_from_plan(plan)
     assert len(i) == want.n_pairs
     assert _pair_set(i, j) == _pair_set(want.idx_l, want.idx_r)
+
+
+# ----------------------------------------------------------------------
+# Mesh-sharded virtual pair generation (VERDICT r3 next-#3): the device
+# pair stream shards over the mesh's data axis and must stay bitwise
+# identical to the single-device pass; the linker composes it with
+# mesh EM end-to-end.
+# ----------------------------------------------------------------------
+
+
+def test_virtual_pattern_ids_mesh_bit_parity():
+    from splink_tpu.parallel.mesh import make_mesh
+
+    df = _df(300, seed=29)
+    s = _settings(
+        ["l.city = r.city", "l.dob = r.dob", "l.name = r.name"],
+        cols=[
+            {"col_name": "name", "num_levels": 2},
+            {"col_name": "dob", "num_levels": 3},
+        ],
+    )
+    t = encode_table(df, s)
+    plan = build_virtual_plan(s, t, chunk=32)
+    assert plan is not None
+    prog = GammaProgram(s, t)
+    pids1, counts1, n1 = compute_virtual_pattern_ids(prog, plan, 997)
+    mesh = make_mesh(8)
+    pids2, counts2, n2 = compute_virtual_pattern_ids(
+        prog, plan, 997, mesh=mesh
+    )
+    assert n1 == n2
+    np.testing.assert_array_equal(counts1, counts2)
+    np.testing.assert_array_equal(pids1, pids2)
+
+
+def test_virtual_mesh_with_derived_keys_and_residuals():
+    from splink_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(31)
+    n = 260
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["ann", "bob", "cat", None], n),
+            "surname": rng.choice(
+                ["smithson", "smithers", "smyth", "jones", None], n
+            ),
+            "city": rng.choice(["c0", "c1", "c2"], n),
+            "dob": rng.choice(["d0", "d1"], n),
+        }
+    )
+    s = _settings(
+        [
+            "substr(l.surname, 1, 3) = substr(r.surname, 1, 3)",
+            "l.city = r.city and length(l.surname) = length(r.surname)",
+        ],
+        cols=[{"col_name": "name", "num_levels": 2}],
+    )
+    t = encode_table(df, s)
+    plan = build_virtual_plan(s, t, chunk=64)
+    assert plan is not None
+    prog = GammaProgram(s, t)
+    pids1, counts1, n1 = compute_virtual_pattern_ids(prog, plan, 640)
+    pids2, counts2, n2 = compute_virtual_pattern_ids(
+        prog, plan, 640, mesh=make_mesh(8)
+    )
+    assert n1 == n2
+    np.testing.assert_array_equal(counts1, counts2)
+    np.testing.assert_array_equal(pids1, pids2)
+
+
+def test_linker_virtual_mesh_e2e_matches_single_device():
+    """Full pipeline under a mesh: virtual pair generation shards its
+    batches; scores must match the single-device virtual run exactly."""
+    df = _df(260, seed=37)
+    base = _linker_settings(
+        device_pair_generation="on", max_resident_pairs=1024
+    )
+    single = Splink(base, df=df).get_scored_comparisons()
+    meshed = Splink(
+        dict(base, mesh={"data": 8}), df=df
+    ).get_scored_comparisons()
+    key = ["unique_id_l", "unique_id_r"]
+    single = single.sort_values(key).reset_index(drop=True)
+    meshed = meshed.sort_values(key).reset_index(drop=True)
+    assert len(single) == len(meshed)
+    np.testing.assert_array_equal(
+        single[key].to_numpy(), meshed[key].to_numpy()
+    )
+    np.testing.assert_allclose(
+        single["match_probability"], meshed["match_probability"], rtol=1e-12
+    )
