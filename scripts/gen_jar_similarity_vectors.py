@@ -1,0 +1,137 @@
+"""Generate the jar-similarity golden vector table.
+
+Executes the reference jar's JaroWinklerSimilarity / JaccardSimilarity /
+CosineDistance UDF bytecode (via scripts/jvm_mini.py — the commons-text
+classes the Scala wrappers delegate to) over a corpus of string pairs and
+writes tests/data/jar_similarity_vectors.json. The table pins
+splink_tpu's device kernels to the jar's actual behaviour
+(tests/test_jar_similarity.py):
+
+  * jw           — JaroWinklerDistance.apply on the raw pair
+  * jaccard      — JaccardSimilarity.apply on the raw pair (character-set
+                   Jaccard rounded to 2dp)
+  * jaccard_q2   — JaccardSimilarity.apply on the Q2-tokenised pair
+  * cosine_q2    — CosineDistance.apply on the Q2-tokenised pair
+                   (None where the jar throws on blank input)
+
+Tokenisation reproduces the Scala wrapper (``s.sliding(q).toList
+.mkString(" ")``: windows of q stepping 1; a non-empty string shorter
+than q yields itself as the single window).
+
+Run: python scripts/gen_jar_similarity_vectors.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from jvm_mini import jar_cosine_distance, jar_jaccard, jar_jaro_winkler
+
+
+def scala_sliding_tokenise(s: str, q: int) -> str:
+    if not s:
+        return ""
+    if len(s) < q:
+        return s
+    return " ".join(s[i : i + q] for i in range(len(s) - q + 1))
+
+
+NAMES = [
+    "martha", "marhta", "smith", "smyth", "smithson", "smithers",
+    "jones", "jonas", "johnson", "johnston", "dixon", "dicksonx",
+    "jellyfish", "smellyfish", "abigail", "abagail", "catherine",
+    "katherine", "o'hara", "ohara", "mc donald", "mcdonald",
+    "anne-marie", "annemarie", "de la cruz", "delacruz",
+    "elizabeth", "elisabeth", "zzzzz", "aaaaa", "a", "ab", "abc",
+    "abcdefghijkl", "abcdefghijlk", "abcdefghijklmnopqrst",
+    "abcdefghijklmnopqrsX",
+]
+
+
+def main():
+    rng = random.Random(1234)
+    pairs = []
+    # canonical + adversarial pairs
+    for a in NAMES:
+        for b in (a, a.upper() if a.upper() != a else a + "x"):
+            pairs.append((a, b))
+    for _ in range(260):
+        a = rng.choice(NAMES)
+        b = rng.choice(NAMES)
+        pairs.append((a, b))
+    # random edits (typos)
+    alpha = "abcdefghijklmnopqrstuvwxyz"
+    for _ in range(240):
+        a = "".join(rng.choice(alpha) for _ in range(rng.randint(1, 16)))
+        b = list(a)
+        for _e in range(rng.randint(0, 3)):
+            op = rng.randint(0, 2)
+            pos = rng.randrange(len(b)) if b else 0
+            if op == 0 and b:
+                b[pos] = rng.choice(alpha)
+            elif op == 1:
+                b.insert(pos, rng.choice(alpha))
+            elif op == 2 and len(b) > 1:
+                del b[pos]
+        pairs.append((a, "".join(b)))
+    # adjacent swaps (transpositions)
+    for _ in range(80):
+        a = "".join(rng.choice(alpha) for _ in range(rng.randint(4, 14)))
+        b = list(a)
+        k = rng.randrange(len(b) - 1)
+        b[k], b[k + 1] = b[k + 1], b[k]
+        pairs.append((a, "".join(b)))
+    # high-union pairs (mixed alphabet, up to 30 chars): exercises the
+    # charset-Jaccard rounding at unions >= 40, where a naive f32 ratio
+    # rounds differently from the jar (see ops/qgram.charset_jaccard)
+    wide = (
+        "abcdefghijklmnopqrstuvwxyz"
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+        "0123456789-.'#/&@!+=()[]"
+    )
+    for _ in range(160):
+        a = "".join(rng.choice(wide) for _ in range(rng.randint(26, 32)))
+        b = "".join(rng.choice(wide) for _ in range(rng.randint(26, 32)))
+        pairs.append((a, b))
+
+    # empties / degenerate
+    pairs += [("", ""), ("a", ""), ("", "b"), (" ", " "), ("ab", "ba")]
+
+    seen = set()
+    out = []
+    for a, b in pairs:
+        if (a, b) in seen:
+            continue
+        seen.add((a, b))
+        ta, tb = scala_sliding_tokenise(a, 2), scala_sliding_tokenise(b, 2)
+        try:
+            cos = jar_cosine_distance(ta, tb)
+        except Exception:
+            cos = None  # the jar throws on blank input
+        out.append(
+            {
+                "a": a,
+                "b": b,
+                "jw": jar_jaro_winkler(a, b),
+                "jaccard": jar_jaccard(a, b),
+                "jaccard_q2": jar_jaccard(ta, tb),
+                "cosine_q2": cos,
+            }
+        )
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "data", "jar_similarity_vectors.json",
+    )
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=0)
+    print(f"wrote {len(out)} vectors to {path}")
+
+
+if __name__ == "__main__":
+    main()

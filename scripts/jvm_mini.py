@@ -1,20 +1,30 @@
 """Minimal JVM class-file interpreter — just enough to execute the
-reference jar's org.apache.commons.codec.language.DoubleMetaphone
-(commons-codec 1.5, Java 1.4 bytecode) WITHOUT a JVM in the image.
+reference jar's similarity UDF implementations WITHOUT a JVM in the image:
 
-Purpose: the reference ships DoubleMetaphone only as a compiled binary
+  * org.apache.commons.codec.language.DoubleMetaphone (commons-codec 1.5)
+  * org.apache.commons.text.similarity.JaroWinklerDistance
+  * org.apache.commons.text.similarity.JaccardSimilarity
+  * org.apache.commons.text.similarity.CosineDistance (+ CosineSimilarity,
+    Counter, RegexTokenizer)
+
+Purpose: the reference ships these kernels only as compiled binaries
 (/root/reference/jars/scala-udf-similarity-0.0.6.jar, registered at
-/root/reference/tests/test_spark.py:48). To pin splink_tpu's pure-Python
-port bit-exactly against the actual artifact users ran, this interpreter
-executes the class file's bytecode directly and generates the golden
-vector table (tests/data/dmetaphone_vectors.json). It is a DEV TOOL, not a
-runtime dependency — the framework never imports it.
+/root/reference/tests/test_spark.py:44-56; the Scala wrappers
+uk.gov.moj.dash.linkage.* are one-line delegations to the commons-text
+classes, verified from their constant pools). To pin splink_tpu's kernels
+bit-exactly against the actual artifact users ran, this interpreter
+executes the class files' bytecode directly and generates golden vector
+tables (tests/data/dmetaphone_vectors.json, jar_similarity_vectors.json).
+It is a DEV TOOL, not a runtime dependency — the framework never imports
+it.
 
-Scope: the opcode subset javac 1.4 emits for this class (stack ops, int
-arithmetic, branches, tableswitch/lookupswitch, field/method access,
-object creation, String[] arrays) plus shims for the handful of
-java.lang String/StringBuffer/Locale methods it calls. No exceptions, no
-threads, no floats, no wide opcodes beyond what appears.
+Scope: the opcode subset javac emits for these classes (stack ops,
+int/long/double arithmetic, branches, tableswitch/lookupswitch,
+field/method access, object creation, typed arrays) plus shims for the
+java.lang/java.util surface they call (String, StringBuffer, Math,
+Arrays, HashSet/HashMap/ArrayList/Iterator, regex Pattern/Matcher,
+boxed Double/Integer). Doubles/longs live as single python values on the
+operand stack; category-2 stack ops use a value-type check.
 
 Usage:
     python scripts/jvm_mini.py WORD [WORD...]     # print primary/alternate
@@ -30,6 +40,14 @@ import zipfile
 JAR = "/root/reference/jars/scala-udf-similarity-0.0.6.jar"
 DM = "org/apache/commons/codec/language/DoubleMetaphone"
 DMR = DM + "$DoubleMetaphoneResult"
+_SIM = "org/apache/commons/text/similarity/"
+JWD = _SIM + "JaroWinklerDistance"
+JACC = _SIM + "JaccardSimilarity"
+COSD = _SIM + "CosineDistance"
+COSS = _SIM + "CosineSimilarity"
+COUNTER = _SIM + "Counter"
+REGTOK = _SIM + "RegexTokenizer"
+LOADED = (DM, DMR, JWD, JACC, COSD, COSS, COUNTER, REGTOK)
 
 
 # --------------------------------------------------------------------------
@@ -170,14 +188,54 @@ class JSB:
         self.buf = list(init)
 
 
+class JSet:
+    """java.util.HashSet shim. Results here are order-insensitive: the
+    doubles the similarity classes accumulate over set iterations are sums
+    of exact small integers, so Java's hash-bucket iteration order cannot
+    change the value."""
+
+    def __init__(self, items=()):
+        self.items = set(items)
+
+
+class JMap:
+    """java.util.HashMap shim."""
+
+    def __init__(self):
+        self.d = {}
+
+
+class JList:
+    """java.util.ArrayList / Collection-view shim."""
+
+    def __init__(self, items=None):
+        self.items = list(items) if items is not None else []
+
+
+class JIter:
+    def __init__(self, seq):
+        self.seq = list(seq)
+        self.pos = 0
+
+
+class JMatcher:
+    def __init__(self, matches):
+        self.matches = matches
+        self.pos = -1
+
+
+class JavaThrow(RuntimeError):
+    pass
+
+
 class Machine:
     def __init__(self, jar_path=JAR):
         zf = zipfile.ZipFile(jar_path)
         self.classes: dict[str, ClassFile] = {}
-        for cn in (DM, DMR):
+        for cn in LOADED:
             self.classes[cn] = ClassFile(zf.read(cn + ".class"))
         self.statics: dict[tuple, object] = {}
-        for cn in (DM, DMR):
+        for cn in LOADED:
             cf = self.classes[cn]
             if ("<clinit>", "()V") in cf.methods:
                 self.run(cf, "<clinit>", "()V", [])
@@ -197,13 +255,15 @@ class Machine:
 
     @staticmethod
     def n_args(desc):
-        """Count argument slots from a method descriptor (no long/double
-        in these classes, so every arg is one slot)."""
+        """Count argument VALUES from a method descriptor. The operand
+        stack here holds one python value per argument regardless of JVM
+        slot category (doubles/longs are single python floats/ints);
+        two-slot locals are re-expanded in run()."""
         n = 0
         i = 1
         while desc[i] != ")":
             c = desc[i]
-            if c in "IZBCSF":
+            if c in "IZBCSFJD":
                 n += 1
                 i += 1
             elif c == "L":
@@ -212,15 +272,163 @@ class Machine:
             elif c == "[":
                 i += 1
                 continue
-            elif c in "JD":
-                n += 2
-                i += 1
             else:
                 raise ValueError(desc)
         return n
 
+    @staticmethod
+    def arg_is_wide(desc):
+        """Per-argument flags: True where the JVM allots two local slots
+        (J/D) — used to lay out `local` to match the compiler's indices."""
+        out = []
+        i = 1
+        while desc[i] != ")":
+            c = desc[i]
+            if c == "[":
+                i += 1
+                continue
+            if c == "L":
+                out.append(False)
+                i = desc.index(";", i) + 1
+            elif c in "JD":
+                out.append(True)
+                i += 1
+            else:
+                out.append(False)
+                i += 1
+        return out
+
     # -- java.lang shims ---------------------------------------------------
     def shim(self, cls, name, desc, args):
+        recv = args[0] if args else None
+        # receiver-typed dispatch first: interface calls arrive with the
+        # interface class (java/util/Set, java/lang/CharSequence, ...)
+        if isinstance(recv, str) and name in (
+            "length", "charAt", "toString", "subSequence", "hashCode",
+        ):
+            if name == "length":
+                return len(recv)
+            if name == "charAt":
+                return ord(recv[args[1]])
+            if name == "toString":
+                return recv
+            if name == "subSequence":
+                return recv[args[1] : args[2]]
+            if name == "hashCode":
+                h = 0
+                for ch in recv:
+                    h = (h * 31 + ord(ch)) & 0xFFFFFFFF
+                return h - (1 << 32) if h >= (1 << 31) else h
+        if isinstance(recv, JSet):
+            if name == "<init>":
+                if len(args) > 1:
+                    src = args[1]
+                    recv.items = set(
+                        src.items if isinstance(src, (JSet, JList)) else src
+                    )
+                else:
+                    recv.items = set()
+                return None
+            if name == "add":
+                before = args[1] in recv.items
+                recv.items.add(args[1])
+                return 0 if before else 1
+            if name == "contains":
+                return 1 if args[1] in recv.items else 0
+            if name == "size":
+                return len(recv.items)
+            if name == "isEmpty":
+                return 1 if not recv.items else 0
+            if name == "retainAll":
+                other = args[1]
+                keep = set(
+                    other.items if isinstance(other, (JSet, JList)) else other
+                )
+                changed = not recv.items <= keep
+                recv.items &= keep
+                return 1 if changed else 0
+            if name == "iterator":
+                return JIter(sorted(recv.items, key=str))
+        if isinstance(recv, JMap):
+            if name == "<init>":
+                recv.d = {}
+                return None
+            if name == "put":
+                old = recv.d.get(args[1])
+                recv.d[args[1]] = args[2]
+                return old
+            if name == "get":
+                return recv.d.get(args[1])
+            if name == "containsKey":
+                return 1 if args[1] in recv.d else 0
+            if name == "keySet":
+                return JSet(recv.d.keys())
+            if name == "values":
+                return JList(recv.d.values())
+            if name == "size":
+                return len(recv.d)
+        if isinstance(recv, JList):
+            if name == "<init>":
+                recv.items = []
+                return None
+            if name == "add":
+                recv.items.append(args[1])
+                return 1
+            if name == "size":
+                return len(recv.items)
+            if name == "iterator":
+                return JIter(recv.items)
+            if name == "toArray":
+                return list(recv.items)
+        if isinstance(recv, JIter):
+            if name == "hasNext":
+                return 1 if recv.pos < len(recv.seq) else 0
+            if name == "next":
+                v = recv.seq[recv.pos]
+                recv.pos += 1
+                return v
+        if isinstance(recv, JMatcher):
+            if name == "find":
+                recv.pos += 1
+                return 1 if recv.pos < len(recv.matches) else 0
+            if name == "group":
+                return recv.matches[recv.pos]
+        if cls == "java/util/regex/Pattern":
+            if name == "compile":
+                return ("pattern", args[0])
+            if name == "matcher":
+                import re as _re
+
+                # Java \w is ASCII [a-zA-Z0-9_]; python needs re.ASCII
+                pat = _re.compile(args[0][1], _re.ASCII)
+                return JMatcher([m.group(0) for m in pat.finditer(args[1])])
+        if cls == "java/util/Arrays":
+            if name == "fill":
+                arr, v = args[0], args[1]
+                for i in range(len(arr)):
+                    arr[i] = v
+                return None
+        if cls == "java/lang/Double":
+            if name == "valueOf":
+                return float(args[0])
+            if name == "doubleValue":
+                return float(args[0])
+        if cls == "java/lang/Integer":
+            if name == "valueOf":
+                return int(args[0])
+            if name == "intValue":
+                return int(args[0])
+        if cls == "org/apache/commons/lang3/Validate" and name == "isTrue":
+            if not args[0]:
+                raise JavaThrow(f"Validate.isTrue failed: {args[1]}")
+            return None
+        if cls == "org/apache/commons/lang3/StringUtils":
+            if name in ("isNoneBlank", "isNotBlank", "isBlank"):
+                vals = args[0] if isinstance(args[0], list) else [args[0]]
+                blanks = [v is None or not str(v).strip() for v in vals]
+                if name == "isBlank":
+                    return 1 if blanks[0] else 0
+                return 0 if any(blanks) else 1
         if cls in ("java/lang/String",):
             s = args[0]
             if name == "length":
@@ -287,6 +495,21 @@ class Machine:
                 return min(args[0], args[1])
             if name == "max":
                 return max(args[0], args[1])
+            if name == "abs":
+                return abs(args[0])
+            if name == "sqrt":
+                return args[0] ** 0.5
+            if name == "pow":
+                return float(args[0]) ** float(args[1])
+            if name == "round":
+                # Java Math.round(double) = floor(d + 0.5) as long
+                import math
+
+                return int(math.floor(args[0] + 0.5))
+        if cls == "java/lang/IllegalArgumentException" and name == "<init>":
+            if isinstance(recv, JObject):
+                recv.fields["__msg"] = args[1] if len(args) > 1 else None
+            return None
         raise NotImplementedError(f"shim {cls}.{name}{desc}")
 
     def get_static_shim(self, cls, name):
@@ -306,7 +529,17 @@ class Machine:
 
     def run(self, cf: ClassFile, mname, mdesc, args):
         max_locals, code = cf.code(mname, mdesc)
-        local = list(args) + [None] * (max_locals - len(args))
+        # lay out locals matching the compiler's slot allocation: J/D
+        # arguments occupy two slots (value in the first, second unused)
+        wide = self.arg_is_wide(mdesc)
+        if mname != "<clinit>" and len(args) == len(wide) + 1:
+            wide = [False] + wide  # instance method: receiver first
+        local = []
+        for a, w in zip(args, wide + [False] * len(args)):
+            local.append(a)
+            if w:
+                local.append(None)
+        local += [None] * (max_locals - len(local))
         stack = []
         pc = 0
         cp = cf.cp
@@ -517,8 +750,16 @@ class Machine:
                     stack.append(JObject(cls))
                 elif cls in ("java/lang/StringBuffer", "java/lang/StringBuilder"):
                     stack.append(JSB())
+                elif cls in ("java/util/HashSet", "java/util/LinkedHashSet"):
+                    stack.append(JSet())
+                elif cls in ("java/util/HashMap", "java/util/LinkedHashMap"):
+                    stack.append(JMap())
+                elif cls == "java/util/ArrayList":
+                    stack.append(JList())
                 else:
-                    raise NotImplementedError(f"new {cls}")
+                    # exception types etc.: a generic object is enough for
+                    # <init> + athrow
+                    stack.append(JObject(cls))
                 pc += 3
             elif op == 0xBD:  # anewarray
                 n = stack.pop()
@@ -531,6 +772,165 @@ class Machine:
                 v = stack.pop()
                 stack.append(1 if isinstance(v, str) and cls == "java/lang/String" else 0)
                 pc += 3
+            # ---- long/double support (commons-text similarity classes).
+            # Doubles/longs are ONE python value on the operand stack;
+            # two-slot locals store the value at the low index.
+            elif op in (0x09, 0x0A):  # lconst_0/1
+                stack.append(op - 0x09)
+                pc += 1
+            elif op in (0x0E, 0x0F):  # dconst_0/1
+                stack.append(float(op - 0x0E))
+                pc += 1
+            elif op == 0x14:  # ldc2_w (long/double constant)
+                c = cp[u16(pc + 1)]
+                stack.append(float(c.val) if c.tag == 6 else c.val)
+                pc += 3
+            elif op in (0x16, 0x18):  # lload / dload
+                stack.append(local[code[pc + 1]])
+                pc += 2
+            elif 0x1E <= op <= 0x21:  # lload_0..3
+                stack.append(local[op - 0x1E])
+                pc += 1
+            elif 0x26 <= op <= 0x29:  # dload_0..3
+                stack.append(local[op - 0x26])
+                pc += 1
+            elif op in (0x37, 0x39):  # lstore / dstore
+                local[code[pc + 1]] = stack.pop()
+                pc += 2
+            elif 0x3F <= op <= 0x42:  # lstore_0..3
+                local[op - 0x3F] = stack.pop()
+                pc += 1
+            elif 0x47 <= op <= 0x4A:  # dstore_0..3
+                local[op - 0x47] = stack.pop()
+                pc += 1
+            elif op in (0x61, 0x63):  # ladd / dadd
+                b = stack.pop()
+                stack.append(stack.pop() + b)
+                pc += 1
+            elif op in (0x65, 0x67):  # lsub / dsub
+                b = stack.pop()
+                stack.append(stack.pop() - b)
+                pc += 1
+            elif op in (0x69, 0x6B):  # lmul / dmul
+                b = stack.pop()
+                stack.append(stack.pop() * b)
+                pc += 1
+            elif op == 0x6F:  # ddiv
+                b = stack.pop()
+                a = stack.pop()
+                stack.append(a / b if b != 0 else float("inf") * (1 if a > 0 else -1 if a < 0 else float("nan")))
+                pc += 1
+            elif op == 0x6C:  # idiv (Java truncates toward zero)
+                b = stack.pop()
+                a = stack.pop()
+                q = abs(a) // abs(b)
+                stack.append(q if (a >= 0) == (b >= 0) else -q)
+                pc += 1
+            elif op == 0x70:  # irem (sign of dividend)
+                b = stack.pop()
+                a = stack.pop()
+                r = abs(a) % abs(b)
+                stack.append(r if a >= 0 else -r)
+                pc += 1
+            elif op == 0x74:  # ineg
+                stack.append(-stack.pop())
+                pc += 1
+            elif op == 0x77:  # dneg
+                stack.append(-stack.pop())
+                pc += 1
+            elif op == 0x94:  # lcmp
+                b = stack.pop()
+                a = stack.pop()
+                stack.append((a > b) - (a < b))
+                pc += 1
+            elif op in (0x97, 0x98):  # dcmpl / dcmpg
+                b = stack.pop()
+                a = stack.pop()
+                if a != a or b != b:  # NaN
+                    stack.append(-1 if op == 0x97 else 1)
+                else:
+                    stack.append((a > b) - (a < b))
+                pc += 1
+            elif op == 0x85:  # i2l
+                pc += 1
+            elif op == 0x87:  # i2d
+                stack.append(float(stack.pop()))
+                pc += 1
+            elif op == 0x8A:  # l2d
+                stack.append(float(stack.pop()))
+                pc += 1
+            elif op == 0x8E:  # d2i (truncate toward zero)
+                stack.append(int(stack.pop()))
+                pc += 1
+            elif op in (0xAD, 0xAF):  # lreturn / dreturn
+                return stack.pop()
+            elif op == 0x58:  # pop2 (one double, or two cat-1 values)
+                if isinstance(stack[-1], float):
+                    stack.pop()
+                else:
+                    stack.pop()
+                    stack.pop()
+                pc += 1
+            elif op == 0x5B:  # dup_x2: v3 v2 v1 -> v1 v3 v2 v1 (cat-1 v1)
+                v1 = stack.pop()
+                if isinstance(stack[-1], float):  # v2 is a double
+                    v2 = stack.pop()
+                    stack += [v1, v2, v1]
+                else:
+                    v2 = stack.pop()
+                    v3 = stack.pop()
+                    stack += [v1, v3, v2, v1]
+                pc += 1
+            elif op == 0x5C:  # dup2 (one double, or two cat-1 values)
+                if isinstance(stack[-1], float):
+                    stack.append(stack[-1])
+                else:
+                    stack += [stack[-2], stack[-1]]
+                pc += 1
+            elif op == 0x5D:  # dup2_x1 with a double on top
+                if isinstance(stack[-1], float):
+                    v1 = stack.pop()
+                    v2 = stack.pop()
+                    stack += [v1, v2, v1]
+                else:
+                    v1 = stack.pop()
+                    v2 = stack.pop()
+                    v3 = stack.pop()
+                    stack += [v2, v1, v3, v2, v1]
+                pc += 1
+            elif op == 0xBC:  # newarray (typed primitive array)
+                n = stack.pop()
+                atype = code[pc + 1]
+                fill = 0.0 if atype in (6, 7) else 0  # float/double else int-ish
+                stack.append([fill] * n)
+                pc += 2
+            elif op in (0x2E, 0x33, 0x34):  # iaload / baload / caload
+                i = stack.pop()
+                arr = stack.pop()
+                stack.append(arr[i])
+                pc += 1
+            elif op in (0x4F, 0x54, 0x55):  # iastore / bastore / castore
+                v = stack.pop()
+                i = stack.pop()
+                arr = stack.pop()
+                arr[i] = v
+                pc += 1
+            elif op == 0xB9:  # invokeinterface
+                cls, name, desc = cf.ref(u16(pc + 1))
+                argc = self.n_args(desc)
+                call_args = [stack.pop() for _ in range(argc)][::-1]
+                call_args.insert(0, stack.pop())
+                if isinstance(call_args[0], JObject):
+                    ret = self.invoke(call_args[0].cls, name, desc, call_args)
+                else:
+                    ret = self.shim(cls, name, desc, call_args)
+                if not desc.endswith(")V"):
+                    stack.append(ret)
+                pc += 5
+            elif op == 0xBF:  # athrow
+                exc = stack.pop()
+                msg = exc.fields.get("__msg") if isinstance(exc, JObject) else exc
+                raise JavaThrow(f"{getattr(exc, 'cls', exc)}: {msg}")
             else:
                 raise NotImplementedError(
                     f"opcode 0x{op:02x} at pc={pc} in {cf.this_name}.{mname}"
@@ -542,18 +942,58 @@ _MACHINE = None
 
 def jar_double_metaphone(word, alternate=False):
     """Run the reference jar's DoubleMetaphone on one word."""
+    m = _machine()
+    return m.invoke(
+        DM,
+        "doubleMetaphone",
+        "(Ljava/lang/String;Z)Ljava/lang/String;",
+        [m._dm, word, 1 if alternate else 0],
+    )
+
+
+def _machine():
     global _MACHINE
     if _MACHINE is None:
         _MACHINE = Machine()
         dm = _MACHINE.new_instance(DM)
         _MACHINE.invoke(DM, "<init>", "()V", [dm])
         _MACHINE._dm = dm
-    return _MACHINE.invoke(
-        DM,
-        "doubleMetaphone",
-        "(Ljava/lang/String;Z)Ljava/lang/String;",
-        [_MACHINE._dm, word, 1 if alternate else 0],
+    return _MACHINE
+
+
+def _sim_apply(cls, a, b):
+    m = _machine()
+    key = "_sim_" + cls
+    inst = getattr(m, key, None)
+    if inst is None:
+        inst = m.new_instance(cls)
+        m.invoke(cls, "<init>", "()V", [inst])
+        setattr(m, key, inst)
+    return m.invoke(
+        cls,
+        "apply",
+        "(Ljava/lang/CharSequence;Ljava/lang/CharSequence;)Ljava/lang/Double;",
+        [inst, a, b],
     )
+
+
+def jar_jaro_winkler(a: str, b: str) -> float:
+    """The jar's JaroWinklerSimilarity UDF: the Scala wrapper's one-line
+    delegation to commons-text JaroWinklerDistance.apply (similarity,
+    despite the class name), executed from the bytecode."""
+    return float(_sim_apply(JWD, a, b))
+
+
+def jar_jaccard(a: str, b: str) -> float:
+    """The jar's JaccardSimilarity UDF (character-set Jaccard as
+    commons-text computes it)."""
+    return float(_sim_apply(JACC, a, b))
+
+
+def jar_cosine_distance(a: str, b: str) -> float:
+    """The jar's CosineDistance UDF (token-count cosine distance over
+    ``(\\w)+`` word tokens, as commons-text computes it)."""
+    return float(_sim_apply(COSD, a, b))
 
 
 def main(argv):
@@ -568,6 +1008,12 @@ def main(argv):
             gp, ga = jar_double_metaphone(w), jar_double_metaphone(w, True)
             status = "ok" if (gp, ga) == (p, a) else f"MISMATCH expected {(p, a)}"
             print(f"{w}: {gp} / {ga}  {status}")
+        print("MARTHA/MARHTA jw:", jar_jaro_winkler("MARTHA", "MARHTA"))
+        print("night/nacht jaccard:", jar_jaccard("night", "nacht"))
+        print(
+            "cosine('hello world','world hello'):",
+            jar_cosine_distance("hello world", "world hello"),
+        )
         return
     for w in argv:
         print(w, jar_double_metaphone(w), jar_double_metaphone(w, True))

@@ -20,8 +20,10 @@ Supported surface (enough for hand-written comparison CASEs):
   across *different* columns compares characters, not token ids)
 * literals: numbers, ``'strings'``, ``NULL``, booleans ``TRUE``/``FALSE``
 * string functions: ``jaro_winkler_sim``, ``levenshtein``,
-  ``jaccard_sim``, ``cosine_distance`` (q-gram q=2, or wrap the args in
-  ``QNgramTokeniser(...)`` for other q), ``length``, ``lower``, ``upper``,
+  ``jaccard_sim`` (jar-exact character-set Jaccard rounded to 2 decimals,
+  with or without a ``QNgramTokeniser(...)`` wrapper — see
+  ops/qgram.charset_jaccard), ``cosine_distance`` (q-gram count cosine,
+  q from the tokeniser wrapper, default 2), ``length``, ``lower``, ``upper``,
   ``substr`` / ``substring`` (constant 1-based start/length — a static
   slice on the padded char arrays, as used by the reference's own fixture
   CASE /root/reference/tests/conftest.py:116), ``concat``, ``trim`` /
@@ -959,7 +961,7 @@ class _Evaluator:
 
         a, b = self._two_strings(args, "jaro_winkler_sim")
         ca, cb = self._str_align(a, b)
-        sim = string_ops.jaro_winkler(ca, cb, a.length, b.length, 0.1, 0.0)
+        sim = string_ops.jaro_winkler(ca, cb, a.length, b.length, 0.1, 0.7)
         return _Num(sim, a.null | b.null)
 
     _fn_jaro_winkler = _fn_jaro_winkler_sim
@@ -973,8 +975,9 @@ class _Evaluator:
         return _Num(d.astype(self.jnp.float32), a.null | b.null)
 
     def _qgram_args(self, args, fname):
-        """jaccard_sim(x, y) | jaccard_sim(QNgramTokeniser(x), ...) -> (a,b,q)."""
-        q = 2
+        """jaccard_sim(x, y) | jaccard_sim(QNgramTokeniser(x), ...) ->
+        (a, b, q); q is None when no tokeniser wrapped the arguments."""
+        q = None
         unwrapped = []
         for arg in args:
             if arg[0] == "func":
@@ -992,19 +995,32 @@ class _Evaluator:
         return a, b, q
 
     def _fn_jaccard_sim(self, args):
+        """Jar-exact JaccardSimilarity: character-set Jaccard rounded
+        half-up to 2 decimals (the commons-text class the UDF delegates
+        to — NOT q-gram Jaccard; golden-pinned against the jar bytecode in
+        tests/test_jar_similarity.py). A QNgramTokeniser argument shifts
+        the comparison to the tokenised strings' character sets. The exact
+        q-gram set Jaccard remains available as the native comparison kind
+        'qgram_jaccard'."""
         from .ops import qgram as qgram_ops
 
         a, b, q = self._qgram_args(args, "jaccard_sim")
         ca, cb = self._str_align(a, b)
-        sim = qgram_ops.qgram_jaccard(ca, cb, a.length, b.length, q)
+        sim = qgram_ops.charset_jaccard(ca, cb, a.length, b.length, q)
         return _Num(sim, a.null | b.null)
 
     def _fn_cosine_distance(self, args):
+        """Cosine distance over q-gram COUNT vectors (q from the tokeniser
+        wrapper, default 2). Deviation from the jar, documented: commons-
+        text re-splits the tokenised string on non-word characters, so
+        grams containing spaces/punctuation fragment there; here each gram
+        is atomic. For \\w-only inputs longer than q the two agree to
+        float precision (pinned in tests/test_jar_similarity.py)."""
         from .ops import qgram as qgram_ops
 
         a, b, q = self._qgram_args(args, "cosine_distance")
         ca, cb = self._str_align(a, b)
-        d = qgram_ops.qgram_cosine_distance(ca, cb, a.length, b.length, q)
+        d = qgram_ops.qgram_cosine_distance(ca, cb, a.length, b.length, q or 2)
         return _Num(d, a.null | b.null)
 
     def _fn_dmetaphone(self, args):

@@ -351,7 +351,7 @@ def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
 
     if kind == "jaro_winkler":
         sim = string_ops.jaro_winkler(
-            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, 0.1, 0.0
+            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, 0.1, 0.7
         )
         return bucket_similarity(sim, thresholds, pc.null)
 
@@ -393,7 +393,7 @@ def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
             thresholds = (0.94, 0.88)  # the reference's defaults
         t1, t2 = thresholds[0], thresholds[1]
         sim_self = string_ops.jaro_winkler(
-            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, 0.1, 0.0
+            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, 0.1, 0.7
         )
         inverted = jnp.zeros(sim_self.shape, bool)
         for other in spec.get("other_columns", []):
@@ -402,7 +402,7 @@ def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
             width = max(pc.chars_l.shape[1], oc.chars_r.shape[1])
             a = _pad_chars(pc.chars_l, width)
             b = _pad_chars(oc.chars_r, width)
-            sim_o = string_ops.jaro_winkler(a, b, pc.len_l, oc.len_r, 0.1, 0.0)
+            sim_o = string_ops.jaro_winkler(a, b, pc.len_l, oc.len_r, 0.1, 0.7)
             inverted = inverted | ((sim_o > t1) & ~oc.null_r)
         gamma = jnp.where(
             sim_self > t1,

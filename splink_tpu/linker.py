@@ -78,6 +78,11 @@ class Splink:
         self._n_left_released: int | None = None
         self.save_state_fn = save_state_fn
         self._check_args()
+        # unconditional: a later linker WITHOUT profile_dir must clear the
+        # process-wide trace flag a previous instance set
+        from .utils.profiling import set_trace_dir
+
+        set_trace_dir(self.settings.get("profile_dir") or None)
 
         self._table: EncodedTable | None = None
         self._pairs: PairIndex | None = None

@@ -1,14 +1,24 @@
-"""Q-gram set similarities (Jaccard, cosine) on device — EXACT.
+"""Q-gram and character-set similarities (Jaccard, cosine) on device.
 
 TPU-native equivalents of the reference jar's JaccardSimilarity,
 CosineDistance and Q2-Q6gramTokeniser UDFs
-(/root/reference/tests/test_spark.py:46-52). Semantics (defined precisely
-here and pinned by oracle tests, tests/test_qgram_exact.py):
+(/root/reference/tests/test_spark.py:46-52). Two Jaccard kernels with
+different contracts:
 
-  * Jaccard: |A ∩ B| / |A ∪ B| over the SETS of distinct q-grams.
-  * Cosine distance: 1 - cos(count vectors) over the q-gram MULTISETS.
-  * A string shorter than q contributes no grams; if either side has no
-    grams the similarity is 0 (distance 1).
+  * charset_jaccard — the JAR's actual semantics, bit-exact (character-set
+    Jaccard rounded half-up to 2 decimals; verified against the bytecode,
+    tests/test_jar_similarity.py). This is what ``jaccard_sim(...)`` in a
+    CASE expression computes.
+  * qgram_jaccard — exact |A ∩ B| / |A ∪ B| over the SETS of distinct
+    q-grams (the native 'qgram_jaccard' comparison kind; pinned by
+    tests/test_qgram_exact.py) — the better-conditioned metric, offered as
+    an extension.
+
+Cosine distance: 1 - cos(count vectors) over the q-gram MULTISETS; a
+string shorter than q contributes no grams, and a side with no grams gives
+distance 1. (Deviation from the jar, documented in case_compiler: commons-
+text re-splits tokenised strings on non-word characters; for \\w-only
+inputs the two agree — pinned in tests/test_jar_similarity.py.)
 
 Rather than materialising variable-length token sets (hostile to XLA's
 static shapes), each q-gram is encoded as an exact integer code — base-256
@@ -102,6 +112,69 @@ qgram_jaccard = jax.vmap(qgram_jaccard_single, in_axes=(0, 0, 0, 0, None))
 qgram_cosine_distance = jax.vmap(
     qgram_cosine_distance_single, in_axes=(0, 0, 0, 0, None)
 )
+
+
+def charset_jaccard_single(s1, s2, l1, l2, q: int | None = None):
+    """The reference jar's JaccardSimilarity semantics, BIT-EXACT (commons
+    -text bytecode executed by scripts/jvm_mini.py; golden table
+    tests/data/jar_similarity_vectors.json): Jaccard over the sets of
+    DISTINCT CHARACTERS — not q-grams — with the result rounded HALF-UP to
+    two decimal places (Java ``Math.round(v * 100) / 100``), and 0.0 when
+    either side is empty.
+
+    With ``q`` (the call site wrapped its arguments in a QNgramTokeniser),
+    the jar compares the TOKENISED strings — whose character set is the
+    original's plus a space whenever the string yields two or more grams
+    (length > q; Scala's ``sliding`` yields the whole string as one window
+    below that) — so the tokenised set is derived here without
+    materialising tokens.
+
+    Rounding is computed in INTEGER form — floor((200·i + u) / (2·u)) —
+    which f32 evaluates exactly for any union < ~65k (the quotient is
+    either exactly an integer or >= 1/(2u) away from one, far beyond f32
+    eps at 100), giving the mathematically correct half-up result for
+    every ratio. Known divergence, deliberate: at EXACT .005 ties whose
+    float64 evaluation lands a hair below (e.g. 23/40: (23/40)*100 in f64
+    is 57.49999…), the jar itself rounds DOWN where true half-up rounds
+    up — 10 such ratios with union <= 300, each off by exactly 0.01. The
+    golden test treats exact ties as ±0.01 and everything else as exact.
+    """
+    L = s1.shape[0]
+    idx = jnp.arange(L)
+    va = idx < l1
+    vb = idx < l2
+    sp = jnp.asarray(ord(" "), s1.dtype)
+
+    def firsts(s, v):
+        seen_earlier = (
+            (s[None, :] == s[:, None]) & v[None, :] & (idx[None, :] < idx[:, None])
+        ).any(axis=1)
+        return v & ~seen_earlier
+
+    fa = firsts(s1, va)
+    fb = firsts(s2, vb)
+    nsa = s1 != sp
+    nsb = s2 != sp
+    present_in_b = ((s1[:, None] == s2[None, :]) & vb[None, :]).any(axis=1)
+    inter_ns = jnp.sum(fa & nsa & present_in_b)
+    da = jnp.sum(fa & nsa)
+    db = jnp.sum(fb & nsb)
+    space_a = ((s1 == sp) & va).any()
+    space_b = ((s2 == sp) & vb).any()
+    if q is not None:
+        space_a = space_a | (l1 > q)
+        space_b = space_b | (l2 > q)
+    inter = inter_ns + (space_a & space_b)
+    union = jnp.maximum(
+        da + db + space_a.astype(da.dtype) + space_b.astype(da.dtype) - inter,
+        1,
+    )
+    num = (200 * inter + union).astype(jnp.float32)
+    rounded = jnp.floor(num / (2 * union).astype(jnp.float32)) / 100.0
+    return jnp.where((l1 == 0) | (l2 == 0), 0.0, rounded).astype(jnp.float32)
+
+
+charset_jaccard = jax.vmap(charset_jaccard_single, in_axes=(0, 0, 0, 0, None))
 
 
 def qgram_tokenise(value: str, q: int) -> list[str]:

@@ -31,41 +31,56 @@ def _f(x):
 
 
 def jaro_winkler_single(
-    s1, s2, l1, l2, prefix_scale: float = 0.1, boost_threshold: float = 0.0
+    s1, s2, l1, l2, prefix_scale: float = 0.1, boost_threshold: float = 0.7
 ):
-    """Jaro-Winkler similarity of two fixed-width byte strings.
+    """Jaro-Winkler similarity of two fixed-width byte strings, matching the
+    reference jar's JaroWinklerSimilarity UDF BIT-FOR-BIT in structure (the
+    commons-text JaroWinklerDistance.apply the Scala wrapper delegates to,
+    verified against its bytecode by scripts/jvm_mini.py; golden table
+    tests/data/jar_similarity_vectors.json):
 
-    Matches the standard definition used by the reference's
-    JaroWinklerSimilarity UDF (commons-text semantics: the Winkler prefix
-    boost is applied unconditionally; set boost_threshold=0.7 for the
-    original Winkler variant). Returns 0.0 when exactly one string is empty,
-    1.0 when both are empty.
+      * the greedy matching pass iterates the SHORTER string's characters
+        over the longer (matches() assigns min/max — direction changes the
+        greedy assignment when lengths differ);
+      * transpositions = floor(mismatched-matched-positions / 2) — an
+        INTEGER halving (Java's `transpositions / 2`), not /2.0;
+      * the Winkler prefix run is NOT capped at 4, and its scaling factor
+        is min(prefix_scale, 1/max(l1, l2));
+      * the boost applies only when jaro >= boost_threshold (0.7, Java's
+        `j < 0.7 ? j : boosted`);
+      * m == 0 returns 0.0 — including BOTH strings empty.
 
-    The greedy matching pass is sequential in the s1 index (shared used2
-    state), but every per-step operation is a dense (L,) vector op — the
-    "first eligible partner" is selected with a cumsum-based first-true mask
-    and consumed with a one-hot OR, never a scatter or argmax, so the vmapped
-    batch runs entirely on the VPU.
+    The greedy matching pass is sequential in the short-side index (shared
+    used2 state), but every per-step operation is a dense (L,) vector op —
+    the "first eligible partner" is selected with a cumsum-based first-true
+    mask and consumed with a one-hot OR, never a scatter or argmax, so the
+    vmapped batch runs entirely on the VPU.
     """
     L = s1.shape[0]
     idx = jnp.arange(L)
     l1 = l1.astype(jnp.int32)
     l2 = l2.astype(jnp.int32)
-    valid2 = idx < l2
-    window = jnp.maximum(jnp.maximum(l1, l2) // 2 - 1, 0)
+    # iterate the shorter string over the longer (jar matches() semantics)
+    swap = l1 > l2
+    a = jnp.where(swap, s2, s1)
+    b = jnp.where(swap, s1, s2)
+    la = jnp.minimum(l1, l2)
+    lb = jnp.maximum(l1, l2)
+    valid_b = idx < lb
+    window = jnp.maximum(lb // 2 - 1, 0)
 
-    def step(used2, xs):
+    def step(used_b, xs):
         ch, i = xs
         cand = (
-            (s2 == ch) & (jnp.abs(idx - i) <= window) & valid2 & (~used2) & (i < l1)
+            (b == ch) & (jnp.abs(idx - i) <= window) & valid_b & (~used_b) & (i < la)
         )
         first = cand & (jnp.cumsum(cand) == 1)  # one-hot of first eligible j
-        return used2 | first, first.any()
+        return used_b | first, first.any()
 
-    used2, matched1 = lax.scan(
-        step, jnp.zeros(L, bool), (s1, jnp.arange(L, dtype=jnp.int32))
+    used_b, matched_a = lax.scan(
+        step, jnp.zeros(L, bool), (a, jnp.arange(L, dtype=jnp.int32))
     )
-    m = jnp.sum(matched1).astype(jnp.int32)
+    m = jnp.sum(matched_a).astype(jnp.int32)
 
     # Order-preserving compaction of each side's matched characters via a
     # rank-indicator matmul (MXU work, no scatters): seq[k] = sum_i
@@ -75,26 +90,24 @@ def jaro_winkler_single(
         ind = (rank[:, None] == idx[None, :]) & matched[:, None]  # (L, L)
         return (s.astype(jnp.float32) * matched) @ ind.astype(jnp.float32)
 
-    seq1 = compact(s1, matched1)
-    seq2 = compact(s2, used2)
+    seq1 = compact(a, matched_a)
+    seq2 = compact(b, used_b)
     in_match = idx < m
-    half_transpositions = jnp.sum((seq1 != seq2) & in_match)
+    mismatched = jnp.sum(((seq1 != seq2) & in_match).astype(jnp.int32))
 
     mf = _f(m)
-    t = _f(half_transpositions) / 2.0
+    t = _f(mismatched // 2)  # Java integer division
     jaro = jnp.where(
         m > 0,
         (mf / _f(l1) + mf / _f(l2) + (mf - t) / mf) / 3.0,
         0.0,
     )
 
-    prefix_run = jnp.cumprod(((s1 == s2) & (idx < l1) & valid2).astype(jnp.int32))
-    ell = jnp.minimum(jnp.sum(prefix_run), 4).astype(jnp.float32)
-    boosted = jaro + ell * prefix_scale * (1.0 - jaro)
-    jw = jnp.where(jaro > boost_threshold, boosted, jaro)
-
-    both_empty = (l1 == 0) & (l2 == 0)
-    return jnp.where(both_empty, 1.0, jw)
+    prefix_run = jnp.cumprod(((s1 == s2) & (idx < la)).astype(jnp.int32))
+    ell = jnp.sum(prefix_run).astype(jnp.float32)  # NOT capped (jar)
+    scale = jnp.minimum(prefix_scale, 1.0 / jnp.maximum(_f(lb), 1.0))
+    boosted = jaro + ell * scale * (1.0 - jaro)
+    return jnp.where(jaro < boost_threshold, jaro, boosted)
 
 
 def levenshtein_single(s1, s2, l1, l2):
@@ -166,7 +179,7 @@ def levenshtein_ratio(s1, s2, l1, l2):
     return jnp.where(denom > 0, d / denom, 0.0)
 
 
-def jaro_winkler(s1, s2, l1, l2, prefix_scale=0.1, boost_threshold=0.0):
+def jaro_winkler(s1, s2, l1, l2, prefix_scale=0.1, boost_threshold=0.7):
     """Batched Jaro-Winkler: Pallas lane-tile kernel on TPU for ASCII
     fixed-width columns, vmapped pure-JAX elsewhere (wide unicode, CPU)."""
     from .strings_pallas import jaro_winkler_pallas, pallas_supported
@@ -176,5 +189,5 @@ def jaro_winkler(s1, s2, l1, l2, prefix_scale=0.1, boost_threshold=0.0):
     return jaro_winkler_vmapped(s1, s2, l1, l2, prefix_scale, boost_threshold)
 
 
-def jaro_winkler_batch(s1, s2, l1, l2, prefix_scale=0.1, boost_threshold=0.0):
+def jaro_winkler_batch(s1, s2, l1, l2, prefix_scale=0.1, boost_threshold=0.7):
     return jaro_winkler(s1, s2, l1, l2, prefix_scale, boost_threshold)

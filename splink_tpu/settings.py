@@ -54,6 +54,7 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "pair_batch_size",
     "max_resident_pairs",
     "spill_dir",
+    "profile_dir",
     "float64",
 ]
 

@@ -8,6 +8,21 @@ from collections import defaultdict
 
 _TIMINGS: dict[str, list[float]] = defaultdict(list)
 
+# Process-wide profiler target (set from settings["profile_dir"] by the
+# linker): device-heavy stages then capture a perfetto/tensorboard trace
+# under <dir>/<stage>. One flag -> utilisation data for an EM pass, the
+# analogue of inspecting a Spark UI stage timeline.
+_TRACE_DIR: str | None = None
+_TRACED_STAGES = {"gammas", "gammas_patterns", "em", "em_streamed"}
+_TRACE_ACTIVE = False  # jax.profiler.trace cannot nest
+
+
+def set_trace_dir(path: str | None) -> None:
+    """Enable (or disable with None) jax profiler traces for device-heavy
+    stages. Called by the linker when settings["profile_dir"] is set."""
+    global _TRACE_DIR
+    _TRACE_DIR = path
+
 
 class StageTimer(contextlib.AbstractContextManager):
     """Context manager recording wall time for a named pipeline stage.
@@ -20,23 +35,31 @@ class StageTimer(contextlib.AbstractContextManager):
 
     def __init__(self, stage: str, trace_dir: str | None = None):
         self.stage = stage
+        if trace_dir is None and _TRACE_DIR and stage in _TRACED_STAGES:
+            import os
+
+            trace_dir = os.path.join(_TRACE_DIR, stage)
         self.trace_dir = trace_dir
         self._trace = None
 
     def __enter__(self):
-        if self.trace_dir:
+        global _TRACE_ACTIVE
+        if self.trace_dir and not _TRACE_ACTIVE:
             import jax
 
             self._trace = jax.profiler.trace(self.trace_dir)
             self._trace.__enter__()
+            _TRACE_ACTIVE = True
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        global _TRACE_ACTIVE
         self.elapsed = time.perf_counter() - self._t0
         _TIMINGS[self.stage].append(self.elapsed)
         if self._trace is not None:
             self._trace.__exit__(*exc)
+            _TRACE_ACTIVE = False
         return False
 
 

@@ -56,13 +56,20 @@ def rng():
 # ----------------------------------------------------------------------
 
 
-def py_jaro_winkler(s1, s2, p=0.1, boost_threshold=0.0):
-    if not s1 and not s2:
-        return 1.0
-    if not s1 or not s2:
-        return 0.0
+def py_jaro_winkler(s1, s2, p=0.1, boost_threshold=0.7):
+    """Jar-exact commons-text JaroWinklerDistance (verified against the
+    reference jar's bytecode — scripts/jvm_mini.py, golden table
+    tests/data/jar_similarity_vectors.json): the greedy match iterates the
+    SHORTER string over the longer, transpositions are integer-halved, the
+    Winkler prefix is uncapped with a min(p, 1/maxlen) scaling factor, the
+    boost applies only at jaro >= threshold, and m == 0 (including both
+    strings empty) gives 0.0."""
+    if len(s1) > len(s2):
+        s1, s2 = s2, s1  # jaro term m/l1 + m/l2 is symmetric
     l1, l2 = len(s1), len(s2)
-    window = max(max(l1, l2) // 2 - 1, 0)
+    if l1 == 0:
+        return 0.0
+    window = max(l2 // 2 - 1, 0)
     used2 = [False] * l2
     matched1 = []
     for i, c in enumerate(s1):
@@ -76,15 +83,17 @@ def py_jaro_winkler(s1, s2, p=0.1, boost_threshold=0.0):
         return 0.0
     seq1 = [s1[i] for i in matched1]
     seq2 = [s2[j] for j in range(l2) if used2[j]]
-    t = sum(a != b for a, b in zip(seq1, seq2)) / 2
+    t = sum(a != b for a, b in zip(seq1, seq2)) // 2  # Java integer halving
     jaro = (m / l1 + m / l2 + (m - t) / m) / 3
     ell = 0
     for a, b in zip(s1, s2):
-        if a == b and ell < 4:
+        if a == b:
             ell += 1
         else:
             break
-    return jaro + ell * p * (1 - jaro) if jaro > boost_threshold else jaro
+    if jaro < boost_threshold:
+        return jaro
+    return jaro + ell * min(p, 1.0 / l2) * (1 - jaro)
 
 
 def py_levenshtein(s1, s2):

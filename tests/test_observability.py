@@ -264,3 +264,41 @@ def test_spill_sweep_reclaims_recycled_pid_dirs(tmp_path):
     assert not recycled.exists(), "recycled-pid orphan not reclaimed"
     assert kept.exists(), "live owner's dir must not be touched"
     assert not dead.exists(), "dead-pid orphan not reclaimed"
+
+
+def test_profile_dir_captures_traces(tmp_path):
+    """settings["profile_dir"] -> device-heavy stages emit jax profiler
+    traces (one flag turns an EM pass into utilisation data)."""
+    import os
+
+    import numpy as np
+    import pandas as pd
+
+    from splink_tpu import Splink
+    from splink_tpu.utils.profiling import set_trace_dir
+
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame(
+        {
+            "unique_id": range(200),
+            "name": rng.choice(["ann", "bob", "cat"], 200),
+            "dob": rng.choice([f"d{k}" for k in range(10)], 200),
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+        "blocking_rules": ["l.dob = r.dob"],
+        "max_iterations": 2,
+        "profile_dir": str(tmp_path),
+    }
+    try:
+        Splink(s, df=df).get_scored_comparisons()
+        found = [
+            os.path.join(root, f)
+            for root, _dirs, files in os.walk(tmp_path)
+            for f in files
+        ]
+        assert found, "no trace files captured"
+    finally:
+        set_trace_dir(None)  # process-wide flag: do not leak into other tests

@@ -54,25 +54,26 @@ CASES = [
 
 def test_jaro_winkler_matches_oracle():
     s1, s2, l1, l2 = batch(CASES)
-    got = np.asarray(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.0))
+    got = np.asarray(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.7))
     want = [py_jaro_winkler(a, b) for a, b in CASES]
     np.testing.assert_allclose(got, want, atol=1e-6)
 
 
 def test_jaro_winkler_known_values():
     s1, s2, l1, l2 = batch([("MARTHA", "MARHTA"), ("DIXON", "DICKSONX")])
-    got = np.asarray(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.0))
+    got = np.asarray(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.7))
     assert got[0] == pytest.approx(0.9611, abs=1e-4)
     assert got[1] == pytest.approx(0.8133, abs=1e-4)
 
 
 def test_jaro_winkler_boost_threshold():
-    s1, s2, l1, l2 = batch([("abc", "cba")])
-    boosted = float(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.0)[0])
+    # jar semantics: the boost gates at jaro >= 0.7; abcdef/abzzzz has
+    # jaro 5/9 < 0.7 with a 2-char common prefix -> NO boost applied
+    s1, s2, l1, l2 = batch([("abcdef", "abzzzz")])
     gated = float(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.7)[0])
-    # jaro of abc/cba is 5/9 < 0.7: no boost when gated (and no common prefix
-    # anyway, so values agree); sanity only
-    assert gated <= boosted + 1e-9
+    ungated = float(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.0)[0])
+    assert gated == pytest.approx(5 / 9, abs=1e-6)
+    assert ungated > gated  # boost engages only when the gate allows
 
 
 def test_jaro_winkler_random_fuzz(rng):
@@ -88,7 +89,7 @@ def test_jaro_winkler_random_fuzz(rng):
             )
         )
     s1, s2, l1, l2 = batch(pairs)
-    got = np.asarray(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.0))
+    got = np.asarray(strings.jaro_winkler(s1, s2, l1, l2, 0.1, 0.7))
     want = [py_jaro_winkler(a, b) for a, b in pairs]
     np.testing.assert_allclose(got, want, atol=1e-6)
 

@@ -41,7 +41,7 @@ def test_matches_oracle_on_known_cases(width):
     b1, l1 = _encode(s1, width)
     b2, l2 = _encode(s2, width)
     got = np.asarray(
-        jaro_winkler_pallas(b1, b2, l1, l2, 0.1, 0.0, interpret=True)
+        jaro_winkler_pallas(b1, b2, l1, l2, 0.1, 0.7, interpret=True)
     )
     want = np.array(
         [py_jaro_winkler(a[:width], b[:width]) for a, b in CASES], np.float32
@@ -56,7 +56,7 @@ def test_matches_oracle_random(rng):
     strs2 = ["".join(letters[rng.integers(0, 8, rng.integers(0, 9))]) for _ in range(n)]
     b1, l1 = _encode(strs1, width)
     b2, l2 = _encode(strs2, width)
-    got = np.asarray(jaro_winkler_pallas(b1, b2, l1, l2, 0.1, 0.0, interpret=True))
+    got = np.asarray(jaro_winkler_pallas(b1, b2, l1, l2, 0.1, 0.7, interpret=True))
     want = np.array(
         [py_jaro_winkler(a, b) for a, b in zip(strs1, strs2)], np.float32
     )
@@ -99,6 +99,6 @@ def test_matches_vmapped_kernel(rng):
     strs2 = ["".join(letters[rng.integers(0, 12, rng.integers(0, 17))]) for _ in range(n)]
     b1, l1 = _encode(strs1, width)
     b2, l2 = _encode(strs2, width)
-    got = np.asarray(jaro_winkler_pallas(b1, b2, l1, l2, 0.1, 0.0, interpret=True))
-    want = np.asarray(jaro_winkler_vmapped(b1, b2, l1, l2, 0.1, 0.0))
+    got = np.asarray(jaro_winkler_pallas(b1, b2, l1, l2, 0.1, 0.7, interpret=True))
+    want = np.asarray(jaro_winkler_vmapped(b1, b2, l1, l2, 0.1, 0.7))
     np.testing.assert_allclose(got, want, atol=1e-5)

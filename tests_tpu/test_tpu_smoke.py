@@ -26,7 +26,7 @@ class TestPallasKernelsOnHardware:
 
         s1, s2, l1, l2 = _dev(*string_batch)
         got = np.asarray(jaro_winkler_pallas(s1, s2, l1, l2))
-        want = np.asarray(strings.jaro_winkler_vmapped(s1, s2, l1, l2, 0.1, 0.0))
+        want = np.asarray(strings.jaro_winkler_vmapped(s1, s2, l1, l2, 0.1, 0.7))
         np.testing.assert_allclose(got, want, atol=1e-6)
 
     def test_jaro_winkler_known_value(self):
