@@ -83,7 +83,7 @@ def main():
     if pallas_supported(s1):
         cases += [
             ("jaro_winkler", "pallas",
-             jax.jit(lambda: jaro_winkler_pallas(s1, s2, l1, l2, 0.1, 0.0))),
+             jax.jit(lambda: jaro_winkler_pallas(s1, s2, l1, l2, 0.1, 0.7))),
             ("levenshtein", "pallas",
              jax.jit(lambda: levenshtein_pallas(s1, s2, l1, l2))),
         ]

@@ -163,6 +163,11 @@ def config_4(scale):
             "SPLINK_TPU_SPILL_DIR", os.path.join(os.path.dirname(__file__), "spill")
         ),
     }
+    if os.environ.get("SPLINK_TPU_BENCH_FORCE_VIRTUAL"):
+        # sub-scale runs sit below the auto threshold (2^28 pairs); force
+        # the device pair path so the CPU tier still exercises/benches it
+        settings["device_pair_generation"] = "on"
+        settings["max_resident_pairs"] = 1 << 20
     n_rows = len(df)
     t0 = time.perf_counter()
     linker = Splink(settings, df=df)

@@ -1,0 +1,99 @@
+"""Derived-key blocking: function-of-column join keys, jar-true kernels.
+
+The reference executed blocking rules as arbitrary Spark SQL join
+predicates (/root/reference/splink/blocking.py:141-158), so rules like
+``substr(l.surname, 1, 3) = substr(r.surname, 1, 3)`` or a dmetaphone
+key are routine splink usage. splink_tpu evaluates the derived key ONCE
+per row host-side and hash-joins on the resulting codes — a derived key
+costs the same as a plain-column key, and composes with the device
+virtual pair index and sequential-rule dedup.
+
+Shown here:
+  * a substring prefix key (catches surname typos past position 3),
+  * a phonetic dmetaphone key (catches respelled surnames),
+  * a cross-column key (l.first_name = r.surname name-swap block),
+  * a scalar-function residual (length guard).
+
+Run:  python examples/derived_key_blocking.py [--platform cpu]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import pandas as pd
+
+
+def make_data(n=4000, seed=11):
+    rng = np.random.default_rng(seed)
+
+    def name(k=7):
+        return "".join(rng.choice(list("abcdefghijklmnopqrstuvwxyz"), k))
+
+    base = pd.DataFrame(
+        {
+            "first_name": [name(5) for _ in range(n)],
+            "surname": [name() for _ in range(n)],
+            "dob": [
+                f"19{rng.integers(40, 99)}-{rng.integers(1, 12):02d}"
+                for _ in range(n)
+            ],
+        }
+    )
+    # duplicates with surname typos AFTER the third character — invisible
+    # to an exact surname block, caught by the substr(…,1,3) key
+    dup = base.iloc[: n // 5].copy()
+    dup["surname"] = [s[:4] + name(2) for s in dup["surname"]]
+    df = pd.concat([base, dup], ignore_index=True)
+    df["cluster"] = list(range(len(base))) + list(range(len(dup)))
+    df["unique_id"] = np.arange(len(df))
+    return df
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from splink_tpu import Splink
+
+    df = make_data()
+    settings = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {"col_name": "surname", "num_levels": 3},
+            {"col_name": "dob", "comparison": {"kind": "exact"}},
+        ],
+        "blocking_rules": [
+            # derived prefix key — typo-tolerant surname block
+            "substr(l.surname, 1, 3) = substr(r.surname, 1, 3)",
+            # phonetic key on the host-precomputed dmetaphone column
+            "dmetaphone(l.surname) = dmetaphone(r.surname)",
+            # cross-column name-swap block with a function residual
+            "l.first_name = r.surname and length(l.surname) > 4",
+        ],
+        "additional_columns_to_retain": ["cluster"],
+        "max_iterations": 15,
+    }
+    linker = Splink(settings, df=df)
+    scored = linker.get_scored_comparisons()
+    hits = scored[scored.match_probability > 0.8]
+    truth = scored.cluster_l == scored.cluster_r
+    tp = int(((scored.match_probability > 0.8) & truth).sum())
+    print(f"candidate pairs scored : {len(scored):>8}")
+    print(f"true duplicate pairs   : {int(truth.sum()):>8}")
+    print(f"hits at p > 0.8        : {len(hits):>8}")
+    print(f"recall (blocked)       : {tp / max(int(truth.sum()), 1):>8.3f}")
+    print(f"precision              : {tp / max(len(hits), 1):>8.3f}")
+
+
+if __name__ == "__main__":
+    main()

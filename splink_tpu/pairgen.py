@@ -1080,12 +1080,10 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
         shard = pair_sharding(mesh)
         repl = replicated(mesh)
         put = lambda a: jax.device_put(jnp.asarray(a), repl)  # noqa: E731
-        pos_dev = jax.device_put(
-            np.arange(batch_size, dtype=np.int32), shard
-        )
     else:
         put = jnp.asarray
-        pos_dev = jnp.arange(batch_size, dtype=jnp.int32)
+    # per-bucket iota cache: rules sharing a rule_bs bucket share one array
+    pos_cache: dict = {}
     flush_every = max(min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1)
     acc = put(np.zeros(n_patterns + 1, np.int32))
     in_acc = 0
@@ -1107,6 +1105,25 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
     for r, rp in enumerate(plan.rules):
         if rp.total == 0:
             continue
+        # clamp the batch to this RULE's total (power-of-two bucket so jit
+        # specialisations stay bounded): a 38k-pair rule must not run a
+        # full pair_batch_size of padded lanes — with many small rules the
+        # padding waste would dominate the whole pass. rule_bs <= batch_size
+        # always, so the int32-safety clamp above still covers it (under a
+        # mesh, batch_size is already a mesh multiple, so padding rule_bs
+        # cannot exceed it)
+        rule_bs = min(batch_size, 1 << max(int(rp.total - 1).bit_length(), 6))
+        if mesh is not None:
+            rule_bs = pad_to_multiple(rule_bs, mesh.devices.size)
+        pos_rule = pos_cache.get(rule_bs)
+        if pos_rule is None:
+            if mesh is not None:
+                pos_rule = jax.device_put(
+                    np.arange(rule_bs, dtype=np.int32), shard
+                )
+            else:
+                pos_rule = jnp.arange(rule_bs, dtype=jnp.int32)
+            pos_cache[rule_bs] = pos_rule
         dev = (
             put(rp.order),
             put(rp.ua),
@@ -1115,18 +1132,18 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
             put(rp.lb),
             codes_dev,
         )
-        kkey = (id(program), batch_size, None if mesh is None else id(mesh))
+        kkey = (id(program), rule_bs, None if mesh is None else id(mesh))
         fn = rp.kernel_cache.get(kkey)
         if fn is None:
             fn = rp.kernel_cache[kkey] = make_virtual_pattern_fn(
-                program, batch_size, n_prev=r,
+                program, rule_bs, n_prev=r,
                 has_uid_mask=plan.uid_codes is not None,
                 own_res=rp.residual_fn,
                 prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
                 mesh=mesh,
             )
-        for p0 in range(0, rp.total, batch_size):
-            p1 = min(p0 + batch_size, rp.total)
+        for p0 in range(0, rp.total, rule_bs):
+            p1 = min(p0 + rule_bs, rp.total)
             u0 = int(np.searchsorted(rp.pc, p0, side="right")) - 1
             u1 = int(np.searchsorted(rp.pc, p1 - 1, side="right")) - 1
             k = u1 - u0 + 1
@@ -1136,7 +1153,7 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
             padded = np.full(kpad, np.iinfo(np.int32).max, np.int64)
             padded[: k + 1] = np.clip(pc_rel, -(1 << 31) + 1, (1 << 31) - 1)
             pid, acc = fn(
-                pos_dev, packed, *dev[:5], dev[5], uid_dev, res_ops_dev,
+                pos_rule, packed, *dev[:5], dev[5], uid_dev, res_ops_dev,
                 put(padded.astype(np.int32)),
                 jnp.int32(u0), jnp.int32(p1 - p0), acc,
             )
