@@ -30,4 +30,10 @@ done
 echo "== 5/5 device-native example (virtual pair index on chip) =="
 python examples/large_scale_dedupe.py --rows 500000 || exit 1
 
+echo "== 6 regime comparison (pattern vs streamed-stats EM) =="
+python benchmarks/regime_bench.py --rows 60000 || exit 1
+
+echo "== 7 derived-key blocking example on chip =="
+python examples/derived_key_blocking.py || exit 1
+
 echo "ALL GREEN"
