@@ -468,17 +468,22 @@ class GammaProgram:
         self._pattern_strides = strides
         if self.n_patterns <= MAX_PATTERNS:
             strides_dev = jnp.asarray(strides, jnp.int32)
+            n_patterns = self.n_patterns
 
-            @jax.jit
-            def _pattern_batch(packed, idx_l, idx_r, valid, acc):
+            # ONE kernel body, jitted twice (plain, and per-mesh with
+            # out_shardings): the documented mesh/single-device bit parity
+            # rests on these being the same computation
+            def _pattern_kernel(packed, idx_l, idx_r, valid, acc):
                 G = _gamma_batch_p(packed, idx_l, idx_r).astype(jnp.int32)
                 pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
                 masked = jnp.where(
-                    jnp.arange(pid.shape[0]) < valid, pid, self.n_patterns
+                    jnp.arange(pid.shape[0]) < valid, pid, n_patterns
                 )
-                acc = acc + jnp.bincount(masked, length=self.n_patterns + 1)
+                acc = acc + jnp.bincount(masked, length=n_patterns + 1)
                 return pid, acc
 
+            self._pattern_kernel = _pattern_kernel
+            _pattern_batch = jax.jit(_pattern_kernel)
             self._pattern_batch = lambda il, ir, v, acc: _pattern_batch(
                 self._packed, il, ir, v, acc
             )
@@ -487,12 +492,65 @@ class GammaProgram:
             # dense histogram would OOM); callers must use the gamma-matrix
             # paths
             self._pattern_batch = None
+            self._pattern_kernel = None
+        self._pattern_batch_mesh_cache: dict = {}
+
+    def _pattern_batch_for_mesh(self, mesh):
+        """Mesh-sharded twin of the pattern-batch kernel (same
+        _pattern_kernel body): the pair index arrays shard over the data
+        axis (the only sharded inputs — packed table data and the
+        accumulator replicate), XLA partitions the gather + gamma +
+        bincount along pairs and inserts the histogram psum. Mirrors
+        pairgen.make_virtual_pattern_fn's sharding layout so materialised
+        pattern jobs compose with multi-chip EM the same way virtual ones
+        do. Cached per Mesh VALUE (Mesh is hashable), so equal meshes from
+        repeated mesh_from_settings calls share one compile."""
+        if mesh not in self._pattern_batch_mesh_cache:
+            import functools
+
+            from .parallel.mesh import pair_sharding, replicated
+
+            self._pattern_batch_mesh_cache[mesh] = functools.partial(
+                jax.jit,
+                out_shardings=(pair_sharding(mesh), replicated(mesh)),
+            )(self._pattern_kernel)
+        return self._pattern_batch_mesh_cache[mesh]
+
+    def _mesh_pattern_context(self, mesh):
+        """(run_batch, zero_acc) for a mesh pattern pass — the shared
+        setup compute_pattern_ids and PatternStream both need: replicated
+        packed table, sharded index uploads, replicated accumulator."""
+        import jax
+
+        from .parallel.mesh import pair_sharding, replicated
+
+        shard = pair_sharding(mesh)
+        repl = replicated(mesh)
+        packed_dev = jax.device_put(self._packed, repl)
+        fn = self._pattern_batch_for_mesh(mesh)
+
+        def run_batch(bl, br, valid, acc):
+            return fn(
+                packed_dev,
+                jax.device_put(bl, shard),
+                jax.device_put(br, shard),
+                valid,
+                acc,
+            )
+
+        def zero_acc():
+            return jax.device_put(
+                np.zeros(self.n_patterns + 1, np.int32), repl
+            )
+
+        return run_batch, zero_acc
 
     def compute_pattern_ids(
         self,
         idx_l: np.ndarray,
         idx_r: np.ndarray,
         batch_size: int = DEFAULT_PAIR_BATCH,
+        mesh=None,
     ):
         """One pass over the pair set: (pattern_ids, counts).
 
@@ -500,6 +558,10 @@ class GammaProgram:
         otherwise); counts is the (n_patterns,) int64 histogram. The int32
         device accumulator flushes to host int64 every _HIST_FLUSH_BATCHES
         batches so counts cannot overflow.
+
+        With ``mesh``, each batch shards over the mesh's data axis
+        (_pattern_batch_for_mesh) — bit-identical output, per-chip work
+        divided by the mesh size.
         """
         if self._pattern_batch is None:
             raise ValueError(
@@ -513,8 +575,18 @@ class GammaProgram:
         if n == 0:
             return pids, total
         batch_size = min(batch_size, max(n, 1))
+        if mesh is not None:
+            from .parallel.mesh import pad_to_multiple
+
+            batch_size = pad_to_multiple(batch_size, mesh.devices.size)
+            run_batch, zero_acc = self._mesh_pattern_context(mesh)
+        else:
+            run_batch = lambda bl, br, valid, acc: self._pattern_batch(  # noqa: E731
+                jnp.asarray(bl), jnp.asarray(br), valid, acc
+            )
+            zero_acc = lambda: jnp.zeros(self.n_patterns + 1, jnp.int32)  # noqa: E731
         flush_every = max(min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1)
-        acc = jnp.zeros(self.n_patterns + 1, jnp.int32)
+        acc = zero_acc()
         in_acc = 0
         pending = None
         for start in range(0, n, batch_size):
@@ -525,9 +597,7 @@ class GammaProgram:
                 pad = batch_size - (stop - start)
                 bl = np.concatenate([bl, np.zeros(pad, bl.dtype)])
                 br = np.concatenate([br, np.zeros(pad, br.dtype)])
-            pid, acc = self._pattern_batch(
-                jnp.asarray(bl), jnp.asarray(br), stop - start, acc
-            )
+            pid, acc = run_batch(bl, br, stop - start, acc)
             if pending is not None:
                 ps, pe, prev = pending
                 pids[ps:pe] = np.asarray(prev)[: pe - ps].astype(id_dtype)
@@ -535,7 +605,7 @@ class GammaProgram:
             in_acc += 1
             if in_acc >= flush_every:
                 total += np.asarray(acc[:-1], np.int64)
-                acc = jnp.zeros(self.n_patterns + 1, jnp.int32)
+                acc = zero_acc()
                 in_acc = 0
         ps, pe, prev = pending
         pids[ps:pe] = np.asarray(prev)[: pe - ps].astype(id_dtype)
@@ -739,20 +809,32 @@ class PatternStream(_StreamBatcher):
     still runs instead of as a second sweep over the (possibly spilled)
     pair index."""
 
-    def __init__(self, program: "GammaProgram", batch_size: int):
-        super().__init__(batch_size)
+    def __init__(self, program: "GammaProgram", batch_size: int, mesh=None):
         if program._pattern_batch is None:
             raise ValueError(
                 f"pattern space {program.n_patterns} exceeds MAX_PATTERNS "
                 f"({MAX_PATTERNS}); use GammaStream"
             )
+        self.mesh = mesh
+        if mesh is not None:
+            from .parallel.mesh import pad_to_multiple
+
+            batch_size = pad_to_multiple(batch_size, mesh.devices.size)
+            self._run_batch, self._zero_acc = program._mesh_pattern_context(
+                mesh
+            )
+        else:
+            self._zero_acc = lambda: jnp.zeros(
+                program.n_patterns + 1, jnp.int32
+            )
+        super().__init__(batch_size)
         self.program = program
         self.id_dtype = (
             np.uint16 if program.n_patterns <= (1 << 16) else np.int32
         )
         self._parts: list[np.ndarray] = []
         self._pending: tuple[int, jnp.ndarray] | None = None
-        self._acc = jnp.zeros(program.n_patterns + 1, jnp.int32)
+        self._acc = self._zero_acc()
         self._in_acc = 0
         self._flush_every = max(
             min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1
@@ -760,9 +842,12 @@ class PatternStream(_StreamBatcher):
         self._total_counts = np.zeros(program.n_patterns, np.int64)
 
     def _emit(self, bl, br, valid):
-        pid, self._acc = self.program._pattern_batch(
-            jnp.asarray(bl), jnp.asarray(br), valid, self._acc
-        )
+        if self.mesh is not None:
+            pid, self._acc = self._run_batch(bl, br, valid, self._acc)
+        else:
+            pid, self._acc = self.program._pattern_batch(
+                jnp.asarray(bl), jnp.asarray(br), valid, self._acc
+            )
         if self._pending is not None:
             v, prev = self._pending
             self._parts.append(np.asarray(prev)[:v].astype(self.id_dtype))
@@ -770,7 +855,7 @@ class PatternStream(_StreamBatcher):
         self._in_acc += 1
         if self._in_acc >= self._flush_every:
             self._total_counts += np.asarray(self._acc[:-1], np.int64)
-            self._acc = jnp.zeros(self.program.n_patterns + 1, jnp.int32)
+            self._acc = self._zero_acc()
             self._in_acc = 0
 
     def finish(self):

@@ -240,11 +240,11 @@ class Splink:
         batch = int(self.settings["pair_batch_size"])
         batch = max(min(batch, -(-max(bound, 1) // 8) * 8), 1024)
         # _pattern_capable covers the custom-kernel and pattern-space
-        # conditions; the overlap PatternStream consumer is a materialised
-        # single-device pass, so a mesh additionally excludes it here
-        if bound > max_resident and self._pattern_capable() and mesh is None:
+        # conditions; under a mesh the PatternStream shards its batches
+        # over the data axis (gammas.PatternStream mesh support)
+        if bound > max_resident and self._pattern_capable():
             self._pattern_program = program
-            return PatternStream(program, batch)
+            return PatternStream(program, batch, mesh=mesh)
         keep_limit = max_resident if mesh is None else 0
         return GammaStream(program, batch, keep_device_limit=keep_limit)
 
@@ -307,10 +307,10 @@ class Splink:
         """Static part of the pattern-pipeline test: bounded pattern space
         and no custom comparison kernels — a registered kernel could emit
         gammas outside [-1, num_levels-1], which would alias pattern ids.
-        A mesh does NOT disqualify: the virtual pair index shards its
-        batches over the mesh (pairgen.make_virtual_pattern_fn); only the
-        MATERIALISED pattern pass is single-device (its callers gate on
-        the mesh themselves)."""
+        A mesh does NOT disqualify: both the virtual pair index
+        (pairgen.make_virtual_pattern_fn) and the materialised pattern
+        pass (GammaProgram._pattern_batch_for_mesh, PatternStream) shard
+        their batches over the mesh's data axis."""
         from .gammas import MAX_PATTERNS, pattern_strides_for
 
         for c in self.settings["comparison_columns"]:
@@ -382,10 +382,6 @@ class Splink:
             return True
         if not self._pattern_capable():
             return False
-        if mesh_from_settings(self.settings) is not None:
-            # the materialised pattern pass is single-device; mesh jobs
-            # without a virtual plan shard gamma batches instead
-            return False
         pairs = self._ensure_pairs()
         return pairs.n_pairs > int(self.settings["max_resident_pairs"])
 
@@ -422,6 +418,9 @@ class Splink:
                 )
                 return self._P, self._pattern_counts, self._pattern_program
             pairs = self._ensure_pairs()
+            if self._P is not None:
+                # the overlap PatternStream already computed them
+                return self._P, self._pattern_counts, self._pattern_program
             with StageTimer("gammas_patterns"):
                 self._pattern_program = GammaProgram(
                     self.settings, table, float_dtype=self._float_dtype
@@ -431,6 +430,7 @@ class Splink:
                         pairs.idx_l,
                         pairs.idx_r,
                         batch_size=self.settings["pair_batch_size"],
+                        mesh=mesh_from_settings(self.settings),
                     )
                 )
         return self._P, self._pattern_counts, self._pattern_program
