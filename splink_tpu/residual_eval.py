@@ -267,10 +267,17 @@ class _Evaluator:
         val = np.zeros(self.n, bool)
         known = ~unk
         if known.any():
-            with np.errstate(invalid="ignore"):
-                val[known] = np.asarray(
-                    ufunc(lvals[known], rvals[known]), dtype=bool
-                )
+            try:
+                with np.errstate(invalid="ignore"):
+                    val[known] = np.asarray(
+                        ufunc(lvals[known], rvals[known]), dtype=bool
+                    )
+            except TypeError as e:
+                # e.g. ordering a float column against a computed string —
+                # surface a typed error instead of a raw numpy TypeError
+                raise ResidualEvalError(
+                    f"Incomparable operand types in residual comparison: {e}"
+                ) from None
         return Kleene(val, unk)
 
     def _raw_side(self, v):

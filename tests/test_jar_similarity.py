@@ -210,3 +210,56 @@ def test_jw_canonical_jar_values(a, b, expected):
         )[0]
     )
     assert abs(got - expected) < 2e-6
+
+
+def test_case_expression_jaccard_sim_matches_jar():
+    """jaccard_sim inside a compiled CASE expression uses the jar's
+    charset semantics (threshold decisions match the bytecode)."""
+    import pandas as pd
+
+    from splink_tpu import Splink
+
+    rows = [(v["a"], v["b"]) for v in VECTORS[:220] if v["a"] and v["b"]]
+    df_l = pd.DataFrame(
+        {"unique_id": range(len(rows)), "name": [a for a, _ in rows]}
+    )
+    df_r = pd.DataFrame(
+        {"unique_id": range(len(rows)), "name": [b for _, b in rows]}
+    )
+    # link rows pairwise by unique_id so each golden pair scores once
+    s = {
+        "link_type": "link_only",
+        "comparison_columns": [
+            {
+                "custom_name": "jac",
+                "custom_columns_used": ["name"],
+                "num_levels": 2,
+                "case_expression": (
+                    "CASE WHEN name_l IS NULL OR name_r IS NULL THEN -1 "
+                    "WHEN jaccard_sim(name_l, name_r) > 0.42 THEN 1 "
+                    "ELSE 0 END"
+                ),
+            }
+        ],
+        "blocking_rules": ["l.unique_id_key = r.unique_id_key"],
+        "max_iterations": 0,
+        "additional_columns_to_retain": [],
+    }
+    df_l["unique_id_key"] = df_l["unique_id"]
+    df_r["unique_id_key"] = df_r["unique_id"]
+    out = Splink(s, df_l=df_l, df_r=df_r).manually_apply_fellegi_sunter_weights()
+    jar_by_pair = {
+        (v["a"], v["b"]): v["jaccard"] for v in VECTORS
+    }
+    uid2 = {i: (a, b) for i, (a, b) in enumerate(rows)}
+    checked = 0
+    for _, r in out.iterrows():
+        if r.unique_id_l != r.unique_id_r:
+            continue
+        a, b = uid2[r.unique_id_l]
+        jar = jar_by_pair[(a, b)]
+        if abs(jar - 0.42) < 1e-9:
+            continue  # threshold boundary
+        assert int(r.gamma_jac) == (1 if jar > 0.42 else 0), (a, b, jar)
+        checked += 1
+    assert checked > 150

@@ -261,3 +261,41 @@ def test_arithmetic_on_raw_passthrough_column():
     got = {tuple(sorted((a, b))) for a, b in zip(out.unique_id_l, out.unique_id_r)}
     # |30-32|<5, |30-31|<5, |32-31|<5; NaN row 3 joins nothing; row 2 too far
     assert got == {(0, 1), (0, 4), (1, 4)}
+
+
+def test_incomparable_types_raise_typed_error():
+    """Ordering a numeric column against a COMPUTED string (Materialized
+    operand) cannot fall back to ranks: the object comparison must raise
+    ResidualEvalError, not leak numpy's raw TypeError."""
+    import numpy as np
+    import pandas as pd
+    import pytest
+
+    from splink_tpu.data import encode_table
+    from splink_tpu.residual_eval import ResidualEvalError, evaluate_residual
+    from splink_tpu.settings import complete_settings_dict
+
+    df = pd.DataFrame(
+        {
+            "unique_id": [0, 1],
+            "name": ["ann", "bob"],
+            "age": [30.0, 40.0],
+        }
+    )
+    s = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {"col_name": "name", "num_levels": 2},
+                {"col_name": "age", "data_type": "numeric", "num_levels": 2},
+            ],
+            "blocking_rules": ["l.name = r.name"],
+        }
+    )
+    t = encode_table(df, s)
+    i = np.array([0])
+    j = np.array([1])
+    with pytest.raises(ResidualEvalError):
+        # upper(r.name) is a Materialized string; ordering it against the
+        # float column hits the object-comparison TypeError path
+        evaluate_residual(t, 'l["age"] < upper(r["name"])', i, j)
