@@ -244,7 +244,7 @@ class Splink:
         # over the data axis (gammas.PatternStream mesh support)
         if bound > max_resident and self._pattern_capable():
             self._pattern_program = program
-            return PatternStream(program, batch, mesh=mesh)
+            return PatternStream(program, batch, mesh=self._pattern_mesh())
         keep_limit = max_resident if mesh is None else 0
         return GammaStream(program, batch, keep_device_limit=keep_limit)
 
@@ -385,6 +385,22 @@ class Splink:
         pairs = self._ensure_pairs()
         return pairs.n_pairs > int(self.settings["max_resident_pairs"])
 
+    def _pattern_mesh(self):
+        """The mesh pattern passes shard over: the configured mesh on a
+        single controller; None under multi-controller — the sharded
+        passes device_put host-local full arrays onto the mesh, which is a
+        single-controller layout. Each host then runs the full pattern
+        pass on its own default device: duplicated device work, but no
+        gamma matrix ever materialises and every host derives the same
+        histogram/params (a host-sliced multi-controller pattern pass is
+        future work)."""
+        mesh = mesh_from_settings(self.settings)
+        if mesh is None:
+            return None
+        import jax
+
+        return mesh if jax.process_count() == 1 else None
+
     def _ensure_pattern_ids(self):
         """(pattern_ids, counts, program): ONE device pass over the pair
         index computing gammas, pattern ids and their histogram. The gamma
@@ -409,7 +425,7 @@ class Splink:
                             self._pattern_program,
                             self._virtual,
                             int(self.settings["pair_batch_size"]),
-                            mesh=mesh_from_settings(self.settings),
+                            mesh=self._pattern_mesh(),
                         )
                     )
                 logger.info(
@@ -430,7 +446,7 @@ class Splink:
                         pairs.idx_l,
                         pairs.idx_r,
                         batch_size=self.settings["pair_batch_size"],
-                        mesh=mesh_from_settings(self.settings),
+                        mesh=self._pattern_mesh(),
                     )
                 )
         return self._P, self._pattern_counts, self._pattern_program
@@ -664,9 +680,12 @@ class Splink:
         and the mesh path (stats psum across devices).
 
         Under a multi-controller run (jax.process_count() > 1) each host
-        streams only its global_pair_slice of the pair set; the psum inside
-        the sharded stats makes the union a global aggregate, like every
-        host's Spark executor reading its own partitions."""
+        streams only its global_pair_slice of the pair set and the
+        per-pass sufficient statistics reduce across processes with
+        all_sum_stats (one allgather per pass — the path proven
+        bit-compatible with a single process by
+        tests/test_multiprocess_em.py), like every host's Spark executor
+        reading its own partitions."""
         import jax
 
         from .parallel.distributed import global_pair_slice
@@ -677,8 +696,16 @@ class Splink:
         init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
         batch = int(self.settings["pair_batch_size"])
         mesh = mesh_from_settings(self.settings)
+        stats_reduce = None
         if jax.process_count() > 1:
+            from .parallel.distributed import all_sum_stats
+
             G = G[global_pair_slice(len(G))]
+            # host-local mesh shardings don't span controllers; the
+            # explicit cross-process reduction is what makes each host's
+            # partial stats a global aggregate
+            mesh = None
+            stats_reduce = all_sum_stats
 
         def batches():
             for s in range(0, len(G), batch):
@@ -706,6 +733,7 @@ class Splink:
                 mesh=mesh,
                 compute_ll=compute_ll,
                 on_iteration=on_iteration,
+                stats_reduce=stats_reduce,
             )
         if converged:
             logger.info("EM algorithm has converged")

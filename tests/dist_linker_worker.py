@@ -1,0 +1,92 @@
+"""Worker for the two-process LINKER-facade multi-controller test.
+
+Unlike tests/dist_worker.py (which drives run_em_streamed directly), this
+worker runs the full ``Splink`` facade under jax.distributed: every
+process builds the SAME input frame, the facade's streamed-stats EM path
+slices the pair set by global_pair_slice internally and reduces each
+pass's sufficient statistics with all_sum_stats — the wiring added in
+round 4 (previously only the direct API was multi-host correct).
+
+MAX_PATTERNS is patched to 1 so the job takes the streamed-stats regime
+(the pattern pipeline would otherwise run a full local pass per host,
+which is also correct but exercises nothing cross-process).
+
+argv: <process_id> <num_processes> <port> <out_json>
+"""
+
+import json
+import sys
+
+
+def main():
+    pid, n_procs, port, out = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from splink_tpu.parallel.distributed import initialize_multihost
+
+    initialize_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=n_procs,
+        process_id=pid,
+    )
+    assert jax.process_count() == n_procs, jax.process_count()
+
+    import numpy as np
+    import pandas as pd
+
+    import splink_tpu.gammas as gammas
+    from splink_tpu import Splink
+
+    gammas.MAX_PATTERNS = 1  # force the streamed-stats regime
+
+    rng = np.random.default_rng(7)  # identical data on every process
+    n = 4000
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["ann", "bob", "cat", None], n),
+            "city": rng.choice(["x", "y"], n),
+            "dob": rng.choice([f"d{k}" for k in range(12)], n),
+        }
+    )
+    settings = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "name", "num_levels": 3},
+            {"col_name": "city", "num_levels": 2},
+        ],
+        "blocking_rules": ["l.dob = r.dob"],
+        "max_resident_pairs": 1024,
+        "device_pair_generation": "off",
+        "overlap_blocking": False,  # G must materialise for the slice path
+        "max_iterations": 5,
+        "float64": True,
+    }
+    linker = Splink(settings, df=df)
+    G = linker._ensure_gammas()
+    linker._run_em(G, compute_ll=False)
+
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "process_id": pid,
+                "process_count": jax.process_count(),
+                "n_pairs": int(len(G)),
+                "lam": float(linker.params.params["λ"]),
+                "n_iterations": len(linker.params.param_history),
+            },
+            f,
+        )
+
+
+if __name__ == "__main__":
+    main()
