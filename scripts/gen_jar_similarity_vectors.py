@@ -99,6 +99,16 @@ def main():
         b = "".join(rng.choice(wide) for _ in range(rng.randint(26, 32)))
         pairs.append((a, b))
 
+    # unicode (BMP) names: the jar's charAt works on UTF-16 code units,
+    # which equal code points inside the BMP — the encoded uint32
+    # codepoint columns must agree there
+    uni = [
+        ("rené", "rene"), ("müller", "mueller"), ("françois", "francois"),
+        ("Ødegård", "Odegard"), ("šimek", "simek"), ("rené", "renée"),
+        ("müller", "müler"), ("朝倉", "朝仓"),
+    ]
+    pairs += uni
+
     # empties / degenerate
     pairs += [("", ""), ("a", ""), ("", "b"), (" ", " "), ("ab", "ba")]
 
