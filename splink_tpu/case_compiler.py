@@ -474,10 +474,13 @@ def _substr_const_args(args, expr: str) -> tuple[int, int | None]:
             )
         vals.append(int(c))
     start = vals[0]
-    if start <= 0:
+    if start == 0:
+        start = 1  # Spark: substring(s, 0, n) behaves like start 1
+    if start < 0:
         raise SqlTranslationError(
-            f"substr start must be >= 1 (SQL is 1-based; negative "
-            f"from-the-end starts are unsupported): {expr!r}"
+            f"substr start must be >= 0 (negative from-the-end starts are "
+            f"unsupported in CASE expressions; they ARE supported in "
+            f"blocking keys via derived_keys): {expr!r}"
         )
     length = vals[1] if len(vals) > 1 else None
     if length is not None and length < 0:

@@ -683,9 +683,11 @@ def test_substr_dynamic_start_rejected():
             "then 1 else 0 end",
             2,
         )
-    with pytest.raises(SqlTranslationError, match=">= 1"):
+    with pytest.raises(SqlTranslationError, match=">= 0"):
+        # negative from-the-end starts stay unsupported in CASE (they ARE
+        # supported in blocking keys); start 0 now behaves like start 1
         compile_case_expression(
-            "case when substr(name_l, 0, 3) = 'abc' then 1 else 0 end", 2
+            "case when substr(name_l, -2, 2) = 'bc' then 1 else 0 end", 2
         )
 
 
@@ -859,3 +861,22 @@ def test_alias_suffix_tolerated():
         num_levels=2,
     )
     assert fn is not None
+
+
+def test_substr_start_zero_behaves_like_one():
+    """Spark: substring(s, 0, n) behaves like start 1 — the CASE compiler
+    remaps rather than rejecting (round 4)."""
+    df = pd.DataFrame(
+        {"unique_id": range(3), "name": ["abcde", "abcxx", "zzzzz"]}
+    )
+    got0 = _gamma_for(
+        "case when substr(name_l, 0, 3) = substr(name_r, 0, 3) "
+        "then 1 else 0 end",
+        df,
+    )
+    got1 = _gamma_for(
+        "case when substr(name_l, 1, 3) = substr(name_r, 1, 3) "
+        "then 1 else 0 end",
+        df,
+    )
+    assert got0 == got1
