@@ -1,0 +1,197 @@
+"""Break down the on-chip cost of the virtual pair-index pass.
+
+Times, per 1M-position batch over the same data bench.py uses:
+  a) full current pass (plan slices H2D, kernel, pid D2H)  — baseline
+  b) kernel only, pids left on device, one sync at the end — no D2H
+  c) kernel without the bincount histogram                 — no scatter
+  d) decode only (no gamma gathers, no bincount)           — transfer+decode
+  e) raw D2H of one batch's pid array                      — link bandwidth
+
+Run on the chip: python scripts/virtual_breakdown.py
+"""
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import bench as B
+    from splink_tpu.data import encode_table
+    from splink_tpu.gammas import GammaProgram
+    from splink_tpu.pairgen import (
+        build_virtual_plan,
+        compute_virtual_pattern_ids,
+    )
+    from splink_tpu.settings import complete_settings_dict
+
+    rng = np.random.default_rng(0)
+    settings = complete_settings_dict(dict(B.SETTINGS))
+    table = encode_table(B._make_df(rng, B.N_ROWS), settings)
+    prog = GammaProgram(settings, table)
+    plan = build_virtual_plan(settings, table)
+    assert plan is not None
+    BATCH = 1 << 20
+    print(f"candidates={plan.n_candidates} rules={len(plan.rules)} "
+          f"n_patterns={prog.n_patterns}", flush=True)
+
+    # -- a) full pass ------------------------------------------------------
+    compute_virtual_pattern_ids(prog, plan, BATCH)  # warmup/compile
+    t0 = time.perf_counter()
+    _, counts, n_real = compute_virtual_pattern_ids(prog, plan, BATCH)
+    t_full = time.perf_counter() - t0
+    print(f"a) full pass          {t_full:7.3f}s  "
+          f"{plan.n_candidates/t_full/1e6:6.2f}M pos/s", flush=True)
+
+    # Shared single-rule batch setup for the isolated variants
+    rp = plan.rules[0]
+    n_patterns = prog.n_patterns
+    strides = jnp.asarray(prog._pattern_strides, jnp.int32)
+    gamma_fn = prog._gamma_batch_fn
+    packed = prog._packed
+    order = jnp.asarray(rp.order)
+    ua, la, ub, lb = (jnp.asarray(a) for a in (rp.ua, rp.la, rp.ub, rp.lb))
+    uid = jnp.asarray(plan.uid_codes if plan.uid_codes is not None
+                      else np.zeros(1, np.int32))
+    pos = jnp.arange(BATCH, dtype=jnp.int32)
+
+    def batches():
+        out = []
+        for p0 in range(0, rp.total, BATCH):
+            p1 = min(p0 + BATCH, rp.total)
+            u0 = int(np.searchsorted(rp.pc, p0, side="right")) - 1
+            u1 = int(np.searchsorted(rp.pc, p1 - 1, side="right")) - 1
+            pc_rel = (rp.pc[u0:u1 + 2] - p0).astype(np.int64)
+            kpad = 1 << int(max(len(pc_rel), 2) - 1).bit_length()
+            padded = np.full(kpad, np.iinfo(np.int32).max, np.int64)
+            padded[:len(pc_rel)] = np.clip(pc_rel, -(1 << 31), (1 << 31) - 1)
+            out.append((padded.astype(np.int32), u0, p1 - p0))
+        return out
+
+    bs = batches()
+    kpads = {len(b[0]) for b in bs}
+
+    def decode(pc_slice, u0):
+        ui = jnp.searchsorted(pc_slice, pos, side="right").astype(jnp.int32) - 1
+        t = pos - pc_slice[ui]
+        u = u0 + ui
+        A, LA, Bs, LB = ua[u], la[u], ub[u], lb[u]
+        tri = A == Bs
+        lf, tf = LA.astype(jnp.float32), t.astype(jnp.float32)
+        disc = (2.0 * lf - 1.0) ** 2 - 8.0 * tf
+        a_t = jnp.floor(((2.0 * lf - 1.0) - jnp.sqrt(
+            jnp.maximum(disc, 0.0))) / 2.0).astype(jnp.int32)
+
+        def off(a):
+            return a * LA - (a * (a + 1)) // 2
+
+        a_t = jnp.where(off(a_t + 1) <= t, a_t + 1, a_t)
+        a_t = jnp.where(off(a_t) > t, a_t - 1, a_t)
+        b_t = t - off(a_t) + a_t + 1
+        lb_safe = jnp.maximum(LB, 1)
+        a_r = t // lb_safe
+        b_r = t - a_r * lb_safe
+        a = jnp.where(tri, a_t, a_r)
+        b = jnp.where(tri, b_t, b_r)
+        return order[A + a], order[Bs + b]
+
+    @jax.jit
+    def k_nodl(pc_slice, u0, valid, acc):
+        i, j = decode(pc_slice, u0)
+        masked = (pos >= valid) | (uid[i] == uid[j])
+        G = gamma_fn(packed, i, j).astype(jnp.int32)
+        pid = jnp.sum((G + 1) * strides[None, :], axis=1)
+        pid = jnp.where(masked, n_patterns, pid)
+        return pid, acc + jnp.bincount(pid, length=n_patterns + 1)
+
+    @jax.jit
+    def k_nobin(pc_slice, u0, valid):
+        i, j = decode(pc_slice, u0)
+        masked = (pos >= valid) | (uid[i] == uid[j])
+        G = gamma_fn(packed, i, j).astype(jnp.int32)
+        pid = jnp.sum((G + 1) * strides[None, :], axis=1)
+        return jnp.where(masked, n_patterns, pid)
+
+    @jax.jit
+    def k_dec(pc_slice, u0):
+        i, j = decode(pc_slice, u0)
+        return i + j
+
+    def run(tag, fn, args_of, n_out=1, download=False):
+        for b in bs[:1]:
+            r = fn(*args_of(b))
+            jax.block_until_ready(r)
+        # compile every kpad bucket
+        for kp in kpads:
+            for b in bs:
+                if len(b[0]) == kp:
+                    jax.block_until_ready(fn(*args_of(b)))
+                    break
+        t0 = time.perf_counter()
+        last = None
+        for b in bs:
+            r = fn(*args_of(b))
+            if download:
+                if last is not None:
+                    np.asarray(last[0] if isinstance(last, tuple) else last)
+                last = r
+            else:
+                last = r
+        if download and last is not None:
+            np.asarray(last[0] if isinstance(last, tuple) else last)
+        jax.block_until_ready(last)
+        dt = time.perf_counter() - t0
+        total = rp.total
+        print(f"{tag}  {dt:7.3f}s  {total/dt/1e6:6.2f}M pos/s", flush=True)
+        return dt
+
+    acc0 = jnp.zeros(n_patterns + 1, jnp.int32)
+    run("b) kernel, no D2H    ",
+        k_nodl, lambda b: (jnp.asarray(b[0]), jnp.int32(b[1]),
+                           jnp.int32(b[2]), acc0))
+    run("b2) kernel + pid D2H ",
+        k_nodl, lambda b: (jnp.asarray(b[0]), jnp.int32(b[1]),
+                           jnp.int32(b[2]), acc0), download=True)
+    run("c) no bincount       ",
+        k_nobin, lambda b: (jnp.asarray(b[0]), jnp.int32(b[1]),
+                            jnp.int32(b[2])))
+    run("d) decode only       ", k_dec, lambda b: (jnp.asarray(b[0]),
+                                                   jnp.int32(b[1])))
+
+    # e) raw transfer of one batch worth of pids
+    host = np.zeros(BATCH, np.uint16)
+    dev = jnp.asarray(host)
+    jax.block_until_ready(dev)
+    t0 = time.perf_counter()
+    for _ in range(8):
+        np.asarray(dev)
+    t_d2h = (time.perf_counter() - t0) / 8
+    t0 = time.perf_counter()
+    for _ in range(8):
+        jax.block_until_ready(jnp.asarray(host))
+    t_h2d = (time.perf_counter() - t0) / 8
+    print(f"e) 2MB pid D2H {t_d2h*1e3:.1f}ms  H2D {t_h2d*1e3:.1f}ms",
+          flush=True)
+
+    # f) dispatch latency: tiny kernel round trip
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    x = jnp.zeros((8,), jnp.int32)
+    jax.block_until_ready(tiny(x))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        jax.block_until_ready(tiny(x))
+    print(f"f) tiny dispatch round-trip {(time.perf_counter()-t0)/20*1e3:.1f}ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

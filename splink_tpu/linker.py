@@ -401,6 +401,20 @@ class Splink:
 
         return mesh if jax.process_count() == 1 else None
 
+    def _ensure_pattern_program(self) -> "GammaProgram":
+        """The pattern-capable GammaProgram, built lazily. Scoring-only
+        consumers (manual FS weights, the virtual score stream) need just
+        the program — NOT the histogram pass _ensure_pattern_ids runs —
+        so they must come through here to avoid a redundant device pass
+        over every candidate pair."""
+        if self._pattern_program is None:
+            self._pattern_program = GammaProgram(
+                self.settings,
+                self._ensure_encoded(),
+                float_dtype=self._float_dtype,
+            )
+        return self._pattern_program
+
     def _ensure_pattern_ids(self):
         """(pattern_ids, counts, program): ONE device pass over the pair
         index computing gammas, pattern ids and their histogram. The gamma
@@ -413,26 +427,30 @@ class Splink:
             if self._virtual_plan() is not None:
                 # device pair generation: pairs decode on device from the
                 # plan's unit structure; nothing is materialised or
-                # transferred per pair
+                # transferred per pair. Histogram-ONLY pass: per-pair ids
+                # are not pulled back — over a tunnelled device that
+                # download costs ~25x the kernel (virtual_breakdown.py);
+                # the score stream recomputes them chunk-wise on demand.
+                if self._pattern_counts is not None:
+                    return None, self._pattern_counts, self._pattern_program
                 from .pairgen import compute_virtual_pattern_ids
 
                 with StageTimer("gammas_patterns"):
-                    self._pattern_program = GammaProgram(
-                        self.settings, table, float_dtype=self._float_dtype
-                    )
-                    self._P, self._pattern_counts, n_real = (
+                    self._ensure_pattern_program()
+                    _, self._pattern_counts, n_real = (
                         compute_virtual_pattern_ids(
                             self._pattern_program,
                             self._virtual,
                             int(self.settings["pair_batch_size"]),
                             mesh=self._pattern_mesh(),
+                            return_ids=False,
                         )
                     )
                 logger.info(
                     "device pair generation scored %d pairs (%d candidate "
                     "positions)", n_real, self._virtual.n_candidates,
                 )
-                return self._P, self._pattern_counts, self._pattern_program
+                return None, self._pattern_counts, self._pattern_program
             pairs = self._ensure_pairs()
             if self._P is not None:
                 # the overlap PatternStream already computed them
@@ -455,7 +473,7 @@ class Splink:
         """Per-pattern lookup tables (host): match probability and, when
         intermediates are retained, per-column prob_m/prob_u. Reuses the
         batched scoring path, which bounds HBM at any pattern count."""
-        _, _, program = self._ensure_pattern_ids()
+        program = self._ensure_pattern_program()
         PM = program.patterns_matrix()
         dtype = self._float_dtype
         lam, m, u, _ = self.params.to_arrays(dtype=dtype)
@@ -468,10 +486,13 @@ class Splink:
     def _stream_pattern_chunks(self):
         """Yield scored chunks from the pattern-id pipeline: pure numpy LUT
         gathers per chunk, no device round-trips."""
-        P, _, _ = self._ensure_pattern_ids()
-        if self._virtual is not None:
-            yield from self._stream_virtual_chunks(P)
+        if self._virtual_plan() is not None:
+            # scoring needs only the program + one ids pass — not the
+            # histogram pass (skipping it halves device time when EM
+            # never ran, e.g. manual FS weights)
+            yield from self._stream_virtual_chunks()
             return
+        P, _, _ = self._ensure_pattern_ids()
         pairs = self._ensure_pairs()
         PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
         batch = int(self.settings["pair_batch_size"])
@@ -488,38 +509,40 @@ class Splink:
                     pu_lut[Pc] if pu_lut is not None else None,
                 )
 
-    def _stream_virtual_chunks(self, P):
-        """Scored chunks under device pair generation: per chunk, filter the
-        masked sentinel positions, decode (idx_l, idx_r) host-side from the
-        plan's unit structure (f64 is exact on the host), and LUT-score."""
-        from .pairgen import decode_positions
+    def _stream_virtual_chunks(self):
+        """Scored chunks under device pair generation: re-drive the device
+        pass chunk-wise (kernels are cached on the plan — no recompile),
+        pull each chunk's pattern ids, filter the masked sentinel
+        positions, decode (idx_l, idx_r) host-side from the plan's unit
+        structure (f64 is exact on the host), and LUT-score. Recomputing
+        here instead of keeping the EM pass's ids means the EM pass never
+        downloads per-pair bytes at all, and a score stream is the one
+        consumer that inherently materialises per-pair output anyway."""
+        from .pairgen import _virtual_pass_iter, decode_positions
 
         plan = self._virtual
+        program = self._ensure_pattern_program()
         PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
-        sentinel = self._pattern_program.n_patterns
-        offsets = plan.rule_offsets()
-        batch = int(self.settings["pair_batch_size"])
+        sentinel = program.n_patterns
         with StageTimer("score_patterns"):
-            for s in range(0, len(P), batch):
-                e = min(s + batch, len(P))
-                Pc = P[s:e].astype(np.int32, copy=False)
+            for r, p0, _, n_valid, chunk in _virtual_pass_iter(
+                program,
+                plan,
+                int(self.settings["pair_batch_size"]),
+                mesh=self._pattern_mesh(),
+            ):
+                Pc = chunk.astype(np.int32, copy=False)
                 keep = Pc != sentinel
                 if not keep.any():
                     continue
-                qs = np.arange(s, e, dtype=np.int64)[keep]
-                il = np.empty(len(qs), np.int64)
-                ir = np.empty(len(qs), np.int64)
-                rule_idx = np.searchsorted(offsets, qs, side="right") - 1
-                for r in np.unique(rule_idx):
-                    m = rule_idx == r
-                    # the kernel's sentinel already filtered masked pairs —
-                    # don't re-run residual predicates on the host
-                    i, j, _ = decode_positions(
-                        plan, int(r), qs[m] - offsets[r],
-                        compute_masked=False,
-                    )
-                    il[m] = i
-                    ir[m] = j
+                # batch-relative positions -> rule-relative (batches never
+                # cross a rule boundary)
+                qs = p0 + np.flatnonzero(keep).astype(np.int64)
+                # the kernel's sentinel already filtered masked pairs —
+                # don't re-run residual predicates on the host
+                il, ir, _ = decode_positions(
+                    plan, r, qs, compute_masked=False
+                )
                 Pk = Pc[keep]
                 yield self._assemble_df_e(
                     PM[Pk],

@@ -972,10 +972,54 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
 
     @functools.partial(jax.jit, **jit_kwargs)
     def fn(pos, packed, order, ua, la, ub, lb, prev_codes, uid_codes,
-           res_ops, pc_slice, u0, valid, acc):
-        ui = jnp.searchsorted(pc_slice, pos, side="right").astype(jnp.int32) - 1
+           res_ops, meta, acc):
+        # meta packs this batch's scalars with its pc slice in ONE device
+        # array — [u0, valid, pc_slice...] — uploaded per batch by the
+        # driver with device_put (async on every backend measured; see
+        # the driver-loop comment for why it must never be an eager
+        # device-side slice of a preuploaded table instead).
+        u0 = meta[0]
+        valid = meta[1]
+        pc_slice = meta[2:]
+        kpad = pc_slice.shape[0]
+        bs = pos.shape[0]
+        if mesh is None:
+            # positions are consecutive within the batch, so the unit
+            # index is a monotone step function of pos: scatter +1 at
+            # every unit start position and prefix-sum. One small
+            # scatter-add (kpad updates) + one cumsum replaces a
+            # log2(kpad)-step per-position binary search — the search's
+            # ~11 gathers per position were the bulk of the decode cost
+            # on chip (178ms/batch vs 43ms for the whole gamma+score).
+            # pc_slice[1:] are the batch-relative starts of units
+            # u0+1...; entries past the last unit (and padding) are int32
+            # max and fall into the dropped overflow slot.
+            starts = pc_slice[1:]
+            idx = jnp.clip(starts, 0, bs)
+            marks = jnp.zeros(bs + 1, jnp.int32).at[idx].add(
+                jnp.where(starts < bs, 1, 0), mode="drop"
+            )[:bs]
+            ui = jnp.cumsum(marks)
+        else:
+            # under a mesh, pos arrives SHARDED along the batch axis; a
+            # cumsum there would need cross-device prefix comms, so keep
+            # the branchless bit ladder: largest ui with
+            # pc_slice[ui] <= pos (pc_slice is replicated, power-of-two
+            # padded with int32 max, and pc_slice[0] <= 0 <= pos). NOT
+            # jnp.searchsorted: its scan lowering wraps a vmapped while
+            # loop XLA refuses to fuse through.
+            ui = jnp.zeros_like(pos)
+            half = kpad >> 1
+            while half:
+                cand = ui + half
+                ui = jnp.where(pc_slice[cand] <= pos, cand, ui)
+                half >>= 1
         t = pos - pc_slice[ui]
         u = u0 + ui
+        # four separate 1-word gathers beat a packed (n_units, 4) row
+        # gather here: the 4-wide minor dim pads to the 128 lane width on
+        # TPU and wastes 32x the bandwidth (measured 2.19s vs 1.55s for
+        # the 16M-position pass)
         A = ua[u]
         LA = la[u]
         Bs = ub[u]
@@ -997,7 +1041,17 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
         a_t = jnp.where(off(a_t) > t, a_t - 1, a_t)
         b_t = t - off(a_t) + a_t + 1
         lb_safe = jnp.maximum(LB, 1)
-        a_r = t // lb_safe
+        # rectangle decode without integer division (no VPU int-div; XLA
+        # expands // by a non-constant into a long scalar sequence): f32
+        # reciprocal multiply is within 1 of exact for t < 2^23 (unit
+        # pair counts are < CHUNK^2 = 2^22), then a +-1 correction lands
+        # it
+        q = jnp.floor(
+            t.astype(jnp.float32) * (1.0 / lb_safe.astype(jnp.float32))
+        ).astype(jnp.int32)
+        q = jnp.where((q + 1) * lb_safe <= t, q + 1, q)
+        q = jnp.where(q * lb_safe > t, q - 1, q)
+        a_r = q
         b_r = t - a_r * lb_safe
         a = jnp.where(tri, a_t, a_r)
         b = jnp.where(tri, b_t, b_r)
@@ -1027,18 +1081,21 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
     return fn
 
 
-def compute_virtual_pattern_ids(program, plan: VirtualPlan,
-                                batch_size: int, mesh=None):
-    """One device pass over the VIRTUAL pair stream: (pids, counts,
-    n_real). pids carries the sentinel value ``n_patterns`` for masked
-    (deduped) positions; counts excludes them; n_real = counts.sum().
+def _virtual_pass_iter(program, plan: VirtualPlan, batch_size: int,
+                       mesh=None, want_ids: bool = True, counts_out=None):
+    """Drive one device pass over the virtual pair stream, yielding
+    ``(rule, rule_p0, out_pos, n_valid, pid_host)`` per batch with
+    one-batch pipelining (batch k+1 is dispatched before batch k's pattern
+    ids are pulled to the host). ``pid_host`` is None when ``want_ids`` is
+    False — then NO per-pair bytes cross the link at all: the only D2H is
+    the int32 histogram accumulator flush every ~2^10 batches, which is
+    what makes the EM-only pattern pass tunnel-latency-immune (measured on
+    chip: 74M pos/s without pid downloads vs 2.8M pos/s with a blocking
+    2MB download per 1M-position batch; scripts/virtual_breakdown.py).
 
-    Host work per batch is O(units-in-batch): a searchsorted plus an int32
-    slice of the unit cumulative table. No pair indices cross the link.
-
-    With ``mesh``, each batch SHARDS over the mesh's data axis (see
-    make_virtual_pattern_fn) — bit-identical output to the single-device
-    pass, with per-chip work divided by the mesh size.
+    The histogram accumulates into ``counts_out`` (int64, n_patterns); the
+    caller owns the array. Host work per batch is O(units-in-batch): a
+    searchsorted plus an int32 slice of the unit cumulative table.
     """
     import jax
     import jax.numpy as jnp
@@ -1046,13 +1103,12 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
     from .gammas import _HIST_FLUSH_BATCHES
 
     n_patterns = program.n_patterns
-    # sentinel must be representable
-    id_dtype = np.uint16 if n_patterns + 1 <= (1 << 16) else np.int32
     total = plan.n_candidates
-    pids = np.empty(total, id_dtype)
-    counts = np.zeros(n_patterns, np.int64)
+    counts = counts_out if counts_out is not None else np.zeros(
+        n_patterns, np.int64
+    )
     if total == 0:
-        return pids, counts, 0
+        return
     # int32-safe bound: the device kernel reads batch-relative positions in
     # int32, and pc_rel below can exceed the batch end by up to one unit's
     # pair count (CHUNK^2) — an unbounded settings pair_batch_size near 2^31
@@ -1124,14 +1180,8 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
             else:
                 pos_rule = jnp.arange(rule_bs, dtype=jnp.int32)
             pos_cache[rule_bs] = pos_rule
-        dev = (
-            put(rp.order),
-            put(rp.ua),
-            put(rp.la),
-            put(rp.ub),
-            put(rp.lb),
-            codes_dev,
-        )
+        order_dev = put(rp.order)
+        units_dev = tuple(put(a) for a in (rp.ua, rp.la, rp.ub, rp.lb))
         kkey = (id(program), rule_bs, None if mesh is None else id(mesh))
         fn = rp.kernel_cache.get(kkey)
         if fn is None:
@@ -1142,27 +1192,41 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
                 prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
                 mesh=mesh,
             )
-        for p0 in range(0, rp.total, rule_bs):
+        # One metadata row [u0, valid, pc_rel...] per batch, padded to ONE
+        # power-of-two kpad for the whole rule (one kernel specialisation
+        # per rule). Uploaded per batch with device_put — uploads are
+        # ASYNC on every backend measured (including the tunnelled axon
+        # platform, where they cost ~0.2ms dispatched vs 67ms for an
+        # EAGER device-side op like meta_dev[b]; never slice eagerly in
+        # this loop).
+        starts = list(range(0, rp.total, rule_bs))
+        u0s, u1s = [], []
+        for p0 in starts:
             p1 = min(p0 + rule_bs, rp.total)
-            u0 = int(np.searchsorted(rp.pc, p0, side="right")) - 1
-            u1 = int(np.searchsorted(rp.pc, p1 - 1, side="right")) - 1
-            k = u1 - u0 + 1
+            u0s.append(int(np.searchsorted(rp.pc, p0, side="right")) - 1)
+            u1s.append(int(np.searchsorted(rp.pc, p1 - 1, side="right")) - 1)
+        kmax = max(u1 - u0 + 2 for u0, u1 in zip(u0s, u1s))
+        kpad = 1 << int(max(kmax, 2) - 1).bit_length()
+        imax = np.iinfo(np.int32).max
+        for b, p0 in enumerate(starts):
+            u0, u1 = u0s[b], u1s[b]
+            p1 = min(p0 + rule_bs, rp.total)
             pc_rel = (rp.pc[u0 : u1 + 2] - p0).astype(np.int64)
-            # pad to a power of two so kpad buckets bound recompiles
-            kpad = 1 << int(max(k + 1, 2) - 1).bit_length()
-            padded = np.full(kpad, np.iinfo(np.int32).max, np.int64)
-            padded[: k + 1] = np.clip(pc_rel, -(1 << 31) + 1, (1 << 31) - 1)
+            meta = np.full(kpad + 2, imax, np.int32)
+            meta[0] = u0
+            meta[1] = p1 - p0
+            meta[2 : u1 - u0 + 4] = np.clip(pc_rel, -(1 << 31) + 1, imax)
             pid, acc = fn(
-                pos_rule, packed, *dev[:5], dev[5], uid_dev, res_ops_dev,
-                put(padded.astype(np.int32)),
-                jnp.int32(u0), jnp.int32(p1 - p0), acc,
+                pos_rule, packed, order_dev, *units_dev, codes_dev,
+                uid_dev, res_ops_dev, put(meta), acc,
             )
             if pending is not None:
-                ps, n_valid, prev = pending
-                pids[ps : ps + n_valid] = (
-                    np.asarray(prev)[:n_valid].astype(id_dtype)
+                pr, pp0, ps, n_valid, prev = pending
+                yield pr, pp0, ps, n_valid, (
+                    None if prev is None else np.asarray(prev)[:n_valid]
                 )
-            pending = (out_pos, p1 - p0, pid)
+            pending = (r, p0, out_pos, p1 - p0,
+                       pid if want_ids else None)
             out_pos += p1 - p0
             in_acc += 1
             if in_acc >= flush_every:
@@ -1173,8 +1237,46 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
                 acc = put(np.zeros(n_patterns + 1, np.int32))
                 in_acc = 0
     if pending is not None:
-        ps, n_valid, prev = pending
-        pids[ps : ps + n_valid] = np.asarray(prev)[:n_valid].astype(id_dtype)
+        pr, pp0, ps, n_valid, prev = pending
+        yield pr, pp0, ps, n_valid, (
+            None if prev is None else np.asarray(prev)[:n_valid]
+        )
+        pending = None
     if in_acc:
         counts += np.asarray(acc[:-1], np.int64)
+
+
+def compute_virtual_pattern_ids(program, plan: VirtualPlan,
+                                batch_size: int, mesh=None,
+                                return_ids: bool = True):
+    """One device pass over the VIRTUAL pair stream: (pids, counts,
+    n_real). pids carries the sentinel value ``n_patterns`` for masked
+    (deduped) positions; counts excludes them; n_real = counts.sum().
+
+    With ``return_ids=False`` the pass computes ONLY the histogram — pids
+    comes back None and no per-pair bytes ever cross the host<->device
+    link. This is the EM-path mode: over a tunnelled device the blocking
+    per-batch pid download costs ~25x the kernel itself (measured —
+    scripts/virtual_breakdown.py), and EM needs nothing but counts. The
+    score-output stream recomputes ids chunk-wise later via
+    ``_virtual_pass_iter`` (kernels are cached on the plan, so the second
+    pass pays no compile).
+
+    With ``mesh``, each batch SHARDS over the mesh's data axis (see
+    make_virtual_pattern_fn) — bit-identical output to the single-device
+    pass, with per-chip work divided by the mesh size.
+    """
+    n_patterns = program.n_patterns
+    # sentinel must be representable
+    id_dtype = np.uint16 if n_patterns + 1 <= (1 << 16) else np.int32
+    counts = np.zeros(n_patterns, np.int64)
+    pids = (
+        np.empty(plan.n_candidates, id_dtype) if return_ids else None
+    )
+    for _, _, ps, n_valid, chunk in _virtual_pass_iter(
+        program, plan, batch_size, mesh=mesh, want_ids=return_ids,
+        counts_out=counts,
+    ):
+        if return_ids:
+            pids[ps : ps + n_valid] = chunk.astype(id_dtype)
     return pids, counts, int(counts.sum())
