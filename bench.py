@@ -180,30 +180,47 @@ def main():
 
     @jax.jit
     def score_batch(idx_l, idx_r, params):
-        """packed row gathers -> comparison kernels -> gammas -> FS score."""
+        """packed row gathers -> comparison kernels -> gammas -> FS score.
+        Also returns the batch's probability sum: the scalar the timing
+        barrier fetches (an eager .sum() outside jit would be a blocking
+        ~67ms round trip per batch on the tunnelled platform)."""
         G = prog._gamma_batch(idx_l, idx_r)
-        return G, match_probability(G, params)
+        p = match_probability(G, params)
+        return G, p, p.sum()
 
-    # pair batches (simulating blocked-pair index streams)
-    idx_l = rng.integers(0, N_ROWS, N_PAIRS).astype(np.int32)
-    idx_r = rng.integers(0, N_ROWS, N_PAIRS).astype(np.int32)
+    # pair batches (simulating blocked-pair index streams); one extra
+    # batch reserved for warmup so no timed (executable, input-buffers)
+    # pair has executed before — the tunnelled runtime was observed
+    # returning instantly for exact repeats
+    idx_l = rng.integers(0, N_ROWS, N_PAIRS + BATCH).astype(np.int32)
+    idx_r = rng.integers(0, N_ROWS, N_PAIRS + BATCH).astype(np.int32)
     batches = [
         (jnp.asarray(idx_l[s : s + BATCH]), jnp.asarray(idx_r[s : s + BATCH]))
         for s in range(0, N_PAIRS, BATCH)
     ]
+    warm_batch = (jnp.asarray(idx_l[N_PAIRS:]), jnp.asarray(idx_r[N_PAIRS:]))
 
-    # warmup / compile
-    G0, p0 = score_batch(*batches[0], params)
-    p0.block_until_ready()
+    # the ONLY trustworthy execution barrier on the tunnelled platform is
+    # reading a VALUE back (block_until_ready was observed returning in
+    # 0.1ms for ~10ms of work — see benchmarks/kernel_bench._time_chain);
+    # reduce every batch's probabilities to a scalar on device, combine,
+    # and close the clock on float()
+    psum_fn = jax.jit(lambda *xs: sum(x.sum() for x in xs))
+
+    # warmup / compile (score_batch AND the psum combiner — an unwarmed
+    # combiner would charge its trace+compile to the timed window)
+    G0, p0, s0 = score_batch(*warm_batch, params)
+    float(s0)
+    float(psum_fn(*([s0] * len(batches))))
 
     t0 = time.perf_counter()
     Gs = []
-    last = None
+    psums = []
     for bl, br in batches:
-        G, p = score_batch(bl, br, params)
+        G, p, s = score_batch(bl, br, params)
         Gs.append(G)
-        last = p
-    last.block_until_ready()
+        psums.append(s)
+    float(psum_fn(*psums))
     score_time = time.perf_counter() - t0
     pairs_per_sec = N_PAIRS / score_time
 
@@ -216,11 +233,11 @@ def main():
     )
     res = run_em(G_all, init, max_iterations=25, max_levels=max_levels,
                  em_convergence=1e-4)
-    res.params.lam.block_until_ready()
+    float(res.params.lam)  # value fetch = real barrier
     t1 = time.perf_counter()
     res = run_em(G_all, init, max_iterations=25, max_levels=max_levels,
                  em_convergence=1e-4)
-    res.params.lam.block_until_ready()
+    float(res.params.lam)  # value fetch = real barrier
     em_time = time.perf_counter() - t1
 
     extras = _bench_virtual_pipeline(settings, table, prog)

@@ -1,12 +1,13 @@
 """Kernel-only throughput: Pallas vs vmapped-JAX string similarity.
 
-Round 2's kernel numbers (BENCHMARKS.md) were taken with chained-execution
-timing because ``block_until_ready`` was unreliable through the tunnel;
-this script is the PROPER re-measurement harness: every timed repetition
-synchronises on the result, the first (compile) call is excluded, and the
-median of ``--reps`` runs is reported.
+Chained-execution timing with a single value fetch: see _time_chain for
+the three measurement traps this harness guards against (constant
+folding via closures, runtime memoisation of repeated input buffers,
+and a block_until_ready that does not actually block on the tunnelled
+platform). The first (compile) call is excluded; the reported figure is
+wall clock over ``--chain`` dispatches divided by the chain length.
 
-    python benchmarks/kernel_bench.py [--pairs 1048576] [--width 24] [--reps 5]
+    python benchmarks/kernel_bench.py [--pairs 1048576] [--width 24] [--chain 8]
 
 Prints one JSON line per (kernel, implementation).
 """
@@ -32,22 +33,49 @@ def _random_strings(rng, n, width):
     return (chars * mask).astype(np.uint8), lengths
 
 
-def _time_median(fn, reps):
-    fn()  # compile + warm
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = fn()
-        out.block_until_ready()  # REAL synchronisation, per repetition
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+def _time_chain(fn, arg_sets, chain):
+    """Seconds per invocation of fn over a chain of dispatches with ONE
+    value fetch at the end.
+
+    Measurement traps this guards against (each produced impossible
+    throughput numbers on real hardware before):
+      * arrays are passed as jit ARGUMENTS, never closed over — a nullary
+        jit treats closures as compile-time constants, which lets XLA
+        constant-fold or DCE parts of the computation;
+      * every dispatch gets a DISTINCT input buffer set (arg_sets
+        cycles) — a tunnelled runtime was observed returning instantly
+        for a repeated (executable, input-buffers) pair;
+      * ``block_until_ready`` is NOT trusted as a barrier — on the
+        tunnelled axon platform it was observed returning in 0.1ms for
+        work that takes ~10ms (the only reliable barrier is reading a
+        VALUE back, so each kernel reduces to a scalar, a jitted
+        combiner adds the chain's scalars on device, and the wall clock
+        closes on float() of the result; the single ~66ms round trip
+        amortises over the chain).
+    """
+    import functools
+    import operator
+
+    import jax
+
+    assert len(arg_sets) > chain, "need a distinct input set per dispatch"
+    fsum = jax.jit(lambda *a: fn(*a).sum())
+    combiner = jax.jit(lambda *xs: functools.reduce(operator.add, xs))
+    # warm on the LAST set only — the timed dispatches use sets 0..chain-1,
+    # so no timed (executable, buffers) pair has ever executed before
+    float(fsum(*arg_sets[-1]))
+    float(combiner(*[fsum(*arg_sets[-1])] * chain))
+    t0 = time.perf_counter()
+    outs = [fsum(*arg_sets[k]) for k in range(chain)]
+    float(combiner(*outs))
+    return (time.perf_counter() - t0) / chain
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pairs", type=int, default=1 << 20)
     ap.add_argument("--width", type=int, default=24)
-    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--chain", type=int, default=8)
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
 
@@ -67,41 +95,42 @@ def main():
     )
 
     rng = np.random.default_rng(0)
-    a_chars, a_len = _random_strings(rng, args.pairs, args.width)
-    b_chars, b_len = _random_strings(rng, args.pairs, args.width)
-    s1 = jnp.asarray(a_chars)
-    s2 = jnp.asarray(b_chars)
-    l1 = jnp.asarray(a_len)
-    l2 = jnp.asarray(b_len)
+    arg_sets = []
+    for _ in range(args.chain + 1):
+        a_chars, a_len = _random_strings(rng, args.pairs, args.width)
+        b_chars, b_len = _random_strings(rng, args.pairs, args.width)
+        arg_sets.append((jnp.asarray(a_chars), jnp.asarray(b_chars),
+                         jnp.asarray(a_len), jnp.asarray(b_len)))
+    s1, s2, l1, l2 = arg_sets[0]
 
-    jw_vmap = jax.jit(lambda: so.jaro_winkler_batch(s1, s2, l1, l2))
+    jw_vmap = jax.jit(so.jaro_winkler_batch)
     lev_vmap = jax.jit(
-        lambda: jax.vmap(so.levenshtein_single)(s1, s2, l1, l2)
+        lambda a, b, c, d: jax.vmap(so.levenshtein_single)(a, b, c, d)
     )
     cases = [("jaro_winkler", "vmapped", jw_vmap),
              ("levenshtein", "vmapped", lev_vmap)]
     if pallas_supported(s1):
         cases += [
             ("jaro_winkler", "pallas",
-             jax.jit(lambda: jaro_winkler_pallas(s1, s2, l1, l2, 0.1, 0.7))),
-            ("levenshtein", "pallas",
-             jax.jit(lambda: levenshtein_pallas(s1, s2, l1, l2))),
+             jax.jit(lambda a, b, c, d: jaro_winkler_pallas(
+                 a, b, c, d, 0.1, 0.7))),
+            ("levenshtein", "pallas", jax.jit(levenshtein_pallas)),
         ]
     else:
         print(json.dumps({"note": "pallas unsupported on this backend; "
                           "vmapped only"}))
 
     for kernel, impl, fn in cases:
-        sec = _time_median(fn, args.reps)
+        sec = _time_chain(fn, arg_sets, args.chain)
         print(json.dumps({
             "kernel": kernel,
             "impl": impl,
             "pairs": args.pairs,
             "width": args.width,
-            "seconds_median": round(sec, 4),
+            "seconds_per_call": round(sec, 4),
             "pairs_per_sec": round(args.pairs / sec),
             "device": str(jax.devices()[0]),
-            "sync": "block_until_ready per rep",
+            "sync": f"chained x{args.chain}, one value fetch",
         }))
 
 
