@@ -33,6 +33,61 @@ from .utils.profiling import StageTimer
 
 logger = logging.getLogger("splink_tpu")
 
+_compilation_cache_applied: str | None = None
+
+
+def _enable_compilation_cache(path) -> None:
+    """Point jax at a persistent XLA compilation cache directory.
+
+    Re-jitting the same program shapes is the dominant cold-start cost on
+    the TPU path (each per-rule virtual kernel or EM program costs tens
+    of seconds to compile through a tunnelled device; BENCHMARKS.md
+    config-1's 13.8s wall is mostly one EM compile). The cache persists
+    compiled executables across PROCESSES, so a second run of the same
+    job shapes skips straight to execution — the analogue of the
+    reference's Spark reusing a warmed JVM.
+
+    Precedence: a JAX_COMPILATION_CACHE_DIR env var wins outright (the
+    setting is never applied over it); otherwise the FIRST linker in the
+    process applies its setting and later linkers never re-apply — jax
+    binds its cache object to the first directory it initialises with,
+    so a mid-process dir change would make jax.config report one path
+    while entries keep landing in another. Empty/None disables."""
+    global _compilation_cache_applied
+    if not path:
+        return
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        logger.debug(
+            "JAX_COMPILATION_CACHE_DIR is set; leaving the env-configured "
+            "compilation cache in place"
+        )
+        return
+    path = os.path.expanduser(path)
+    if _compilation_cache_applied is not None:
+        if _compilation_cache_applied != path:
+            logger.debug(
+                "compilation cache already initialised at %s; ignoring %s "
+                "(first linker wins for the process)",
+                _compilation_cache_applied, path,
+            )
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache small programs too (the per-rule kernels are what
+        # repeat) — but never clobber a user's own env-var tuning
+        if "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES" not in os.environ:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5
+            )
+        _compilation_cache_applied = path
+        logger.debug("persistent compilation cache at %s", path)
+    except Exception as e:  # noqa: BLE001 - cache is an optimisation only
+        logger.warning("compilation cache unavailable: %s", e)
+
 try:  # pandas is required for the linker facade (not for the kernels)
     import pandas as pd
 except ImportError:  # pragma: no cover
@@ -83,6 +138,9 @@ class Splink:
         from .utils.profiling import set_trace_dir
 
         set_trace_dir(self.settings.get("profile_dir") or None)
+        _enable_compilation_cache(
+            self.settings.get("compilation_cache_dir")
+        )
 
         self._table: EncodedTable | None = None
         self._pairs: PairIndex | None = None

@@ -55,6 +55,7 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "max_resident_pairs",
     "spill_dir",
     "profile_dir",
+    "compilation_cache_dir",
     "float64",
 ]
 

@@ -302,3 +302,76 @@ def test_profile_dir_captures_traces(tmp_path):
         assert found, "no trace files captured"
     finally:
         set_trace_dir(None)  # process-wide flag: do not leak into other tests
+
+
+def test_compilation_cache_dir_applies(tmp_path):
+    """settings["compilation_cache_dir"] -> jax persistent compilation
+    cache enabled at that path (process-wide, first linker wins); entries
+    actually land once a compile exceeds the time threshold (forced to 0
+    here so the CPU tier's sub-second compiles qualify)."""
+    import os
+
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    import splink_tpu.linker as linker_mod
+    from splink_tpu import Splink
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_applied = linker_mod._compilation_cache_applied
+    prev_min_time = jax.config.jax_persistent_cache_min_compile_time_secs
+    prev_min_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+    cache = tmp_path / "xla"
+    df = pd.DataFrame(
+        {
+            "unique_id": range(100),
+            "name": ["ann", "bob"] * 50,
+            "dob": [f"d{k % 7}" for k in range(100)],
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+        "blocking_rules": ["l.dob = r.dob"],
+        "max_iterations": 1,
+        "compilation_cache_dir": str(cache),
+    }
+    try:
+        linker_mod._compilation_cache_applied = None
+        Splink(s, df=df)
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # drop in-process executable caches: earlier tests may have
+        # compiled these same shapes, and only a real compile persists.
+        # jax also binds its persistent-cache object to the FIRST dir it
+        # initialised with (an earlier linker in this process), so reset
+        # it to pick up this test's dir
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+        jax.clear_caches()
+        Splink(s, df=df).get_scored_comparisons()
+        entries = [
+            f for _root, _dirs, files in os.walk(cache) for f in files
+        ]
+        assert entries, "no compiled executables persisted"
+        # empty value disables for a fresh process but must NOT clear the
+        # already-applied process-wide dir (first linker wins)
+        Splink({**s, "compilation_cache_dir": ""}, df=df)
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+        # a later linker with a DIFFERENT dir must also be ignored
+        Splink({**s, "compilation_cache_dir": str(tmp_path / "b")}, df=df)
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_min_time
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", prev_min_size
+        )
+        linker_mod._compilation_cache_applied = prev_applied
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
