@@ -36,7 +36,7 @@ logger = logging.getLogger("splink_tpu")
 _compilation_cache_applied: str | None = None
 
 
-def _enable_compilation_cache(path) -> None:
+def _enable_compilation_cache(path, explicit: bool = False) -> None:
     """Point jax at a persistent XLA compilation cache directory.
 
     Re-jitting the same program shapes is the dominant cold-start cost on
@@ -62,6 +62,19 @@ def _enable_compilation_cache(path) -> None:
             "compilation cache in place"
         )
         return
+    if explicit is False:
+        # default-on applies to accelerator backends only: XLA:CPU AOT
+        # entries embed exact machine features and reloading one compiled
+        # under different target flags warns "could lead to SIGILL" —
+        # and CPU compiles are fast enough not to need the cache. An
+        # explicitly-set dir is honoured on any backend.
+        try:
+            import jax
+
+            if jax.default_backend() == "cpu":
+                return
+        except Exception:  # noqa: BLE001 - backend probe must not fail init
+            return
     path = os.path.expanduser(path)
     if _compilation_cache_applied is not None:
         if _compilation_cache_applied != path:
@@ -117,6 +130,10 @@ class Splink:
                 (/root/reference/splink/iterate.py:54-55).
             spark: ignored (the reference's SparkSession slot).
         """
+        # before completion fills defaults (in place): did the USER set a
+        # compilation cache dir? An explicit value — even one equal to
+        # the default — opts in on any backend, incl. CPU
+        _cache_explicit = "compilation_cache_dir" in settings
         self.settings = complete_settings_dict(settings)
         backend = self.settings["backend"]
         if backend != "jax":  # schema enum also rejects; double-checked here
@@ -139,7 +156,8 @@ class Splink:
 
         set_trace_dir(self.settings.get("profile_dir") or None)
         _enable_compilation_cache(
-            self.settings.get("compilation_cache_dir")
+            self.settings.get("compilation_cache_dir"),
+            explicit=_cache_explicit,
         )
 
         self._table: EncodedTable | None = None

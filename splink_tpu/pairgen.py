@@ -81,6 +81,12 @@ CHUNK = 2048
 # shape is the wrong tool anyway; such inputs fall back to host blocking.
 MAX_UNITS_PER_GROUP = (1 << 20) - 1
 
+# Concurrent pattern-id downloads in the ids-returning virtual pass: how
+# many batches may be in flight on the D2H thread pool before the driver
+# blocks. 3 overlaps the ~66ms tunnel round trips with ~16ms kernels
+# without unbounded pid buffers pinned on device.
+_D2H_DEPTH = 3
+
 
 @dataclass
 class RulePlan:
@@ -1084,9 +1090,13 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
 def _virtual_pass_iter(program, plan: VirtualPlan, batch_size: int,
                        mesh=None, want_ids: bool = True, counts_out=None):
     """Drive one device pass over the virtual pair stream, yielding
-    ``(rule, rule_p0, out_pos, n_valid, pid_host)`` per batch with
-    one-batch pipelining (batch k+1 is dispatched before batch k's pattern
-    ids are pulled to the host). ``pid_host`` is None when ``want_ids`` is
+    ``(rule, rule_p0, out_pos, n_valid, pid_host)`` per batch.
+    With ``want_ids``, pattern-id downloads run on a small thread pool a
+    few batches deep (yield order stays submission order): one D2H costs
+    a ~66ms round trip over a tunnelled link while the kernel runs ~16ms,
+    so serialising downloads on the driver thread — even pipelined one
+    batch behind — left the pass download-latency-bound.
+    ``pid_host`` is None when ``want_ids`` is
     False — then NO per-pair bytes cross the link at all: the only D2H is
     the int32 histogram accumulator flush every ~2^10 batches, which is
     what makes the EM-only pattern pass tunnel-latency-immune (measured on
@@ -1097,6 +1107,9 @@ def _virtual_pass_iter(program, plan: VirtualPlan, batch_size: int,
     caller owns the array. Host work per batch is O(units-in-batch): a
     searchsorted plus an int32 slice of the unit cumulative table.
     """
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
     import jax
     import jax.numpy as jnp
 
@@ -1143,107 +1156,113 @@ def _virtual_pass_iter(program, plan: VirtualPlan, batch_size: int,
     flush_every = max(min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1)
     acc = put(np.zeros(n_patterns + 1, np.int32))
     in_acc = 0
-    pending = None
-    packed = program._packed
-    if mesh is not None:
-        packed = jax.device_put(packed, repl)
-    uid_dev = put(
-        plan.uid_codes if plan.uid_codes is not None
-        else np.zeros(1, np.int32)
-    )
-    # all rules' codes and residual operand arrays upload ONCE (the
-    # kernel's static n_prev bounds how many code rows it reads); per-rule
-    # plan arrays + kernel are built per rule (shapes differ, so each rule
-    # is its own jit specialisation)
-    codes_dev = put(plan.codes)
-    res_ops_dev = tuple(put(a) for a in plan.res_ops)
-    out_pos = 0
-    for r, rp in enumerate(plan.rules):
-        if rp.total == 0:
-            continue
-        # clamp the batch to this RULE's total (power-of-two bucket so jit
-        # specialisations stay bounded): a 38k-pair rule must not run a
-        # full pair_batch_size of padded lanes — with many small rules the
-        # padding waste would dominate the whole pass. rule_bs <= batch_size
-        # always, so the int32-safety clamp above still covers it (under a
-        # mesh, batch_size is already a mesh multiple, so padding rule_bs
-        # cannot exceed it)
-        rule_bs = min(batch_size, 1 << max(int(rp.total - 1).bit_length(), 6))
+    pool = ThreadPoolExecutor(max_workers=_D2H_DEPTH) if want_ids else None
+    inflight: deque = deque()  # (rule, rule_p0, out_pos, n_valid, future)
+    try:
+        packed = program._packed
         if mesh is not None:
-            rule_bs = pad_to_multiple(rule_bs, mesh.devices.size)
-        pos_rule = pos_cache.get(rule_bs)
-        if pos_rule is None:
-            if mesh is not None:
-                pos_rule = jax.device_put(
-                    np.arange(rule_bs, dtype=np.int32), shard
-                )
-            else:
-                pos_rule = jnp.arange(rule_bs, dtype=jnp.int32)
-            pos_cache[rule_bs] = pos_rule
-        order_dev = put(rp.order)
-        units_dev = tuple(put(a) for a in (rp.ua, rp.la, rp.ub, rp.lb))
-        kkey = (id(program), rule_bs, None if mesh is None else id(mesh))
-        fn = rp.kernel_cache.get(kkey)
-        if fn is None:
-            fn = rp.kernel_cache[kkey] = make_virtual_pattern_fn(
-                program, rule_bs, n_prev=r,
-                has_uid_mask=plan.uid_codes is not None,
-                own_res=rp.residual_fn,
-                prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
-                mesh=mesh,
-            )
-        # One metadata row [u0, valid, pc_rel...] per batch, padded to ONE
-        # power-of-two kpad for the whole rule (one kernel specialisation
-        # per rule). Uploaded per batch with device_put — uploads are
-        # ASYNC on every backend measured (including the tunnelled axon
-        # platform, where they cost ~0.2ms dispatched vs 67ms for an
-        # EAGER device-side op like meta_dev[b]; never slice eagerly in
-        # this loop).
-        starts = list(range(0, rp.total, rule_bs))
-        u0s, u1s = [], []
-        for p0 in starts:
-            p1 = min(p0 + rule_bs, rp.total)
-            u0s.append(int(np.searchsorted(rp.pc, p0, side="right")) - 1)
-            u1s.append(int(np.searchsorted(rp.pc, p1 - 1, side="right")) - 1)
-        kmax = max(u1 - u0 + 2 for u0, u1 in zip(u0s, u1s))
-        kpad = 1 << int(max(kmax, 2) - 1).bit_length()
-        imax = np.iinfo(np.int32).max
-        for b, p0 in enumerate(starts):
-            u0, u1 = u0s[b], u1s[b]
-            p1 = min(p0 + rule_bs, rp.total)
-            pc_rel = (rp.pc[u0 : u1 + 2] - p0).astype(np.int64)
-            meta = np.full(kpad + 2, imax, np.int32)
-            meta[0] = u0
-            meta[1] = p1 - p0
-            meta[2 : u1 - u0 + 4] = np.clip(pc_rel, -(1 << 31) + 1, imax)
-            pid, acc = fn(
-                pos_rule, packed, order_dev, *units_dev, codes_dev,
-                uid_dev, res_ops_dev, put(meta), acc,
-            )
-            if pending is not None:
-                pr, pp0, ps, n_valid, prev = pending
-                yield pr, pp0, ps, n_valid, (
-                    None if prev is None else np.asarray(prev)[:n_valid]
-                )
-            pending = (r, p0, out_pos, p1 - p0,
-                       pid if want_ids else None)
-            out_pos += p1 - p0
-            in_acc += 1
-            if in_acc >= flush_every:
-                counts += np.asarray(acc[:-1], np.int64)
-                # reset through put(): a plain jnp.zeros would drop the
-                # replicated sharding under a mesh and force a reshard /
-                # second executable on the next batch
-                acc = put(np.zeros(n_patterns + 1, np.int32))
-                in_acc = 0
-    if pending is not None:
-        pr, pp0, ps, n_valid, prev = pending
-        yield pr, pp0, ps, n_valid, (
-            None if prev is None else np.asarray(prev)[:n_valid]
+            packed = jax.device_put(packed, repl)
+        uid_dev = put(
+            plan.uid_codes if plan.uid_codes is not None
+            else np.zeros(1, np.int32)
         )
-        pending = None
-    if in_acc:
-        counts += np.asarray(acc[:-1], np.int64)
+        # all rules' codes and residual operand arrays upload ONCE (the
+        # kernel's static n_prev bounds how many code rows it reads); per-rule
+        # plan arrays + kernel are built per rule (shapes differ, so each rule
+        # is its own jit specialisation)
+        codes_dev = put(plan.codes)
+        res_ops_dev = tuple(put(a) for a in plan.res_ops)
+        out_pos = 0
+        for r, rp in enumerate(plan.rules):
+            if rp.total == 0:
+                continue
+            # clamp the batch to this RULE's total (power-of-two bucket so jit
+            # specialisations stay bounded): a 38k-pair rule must not run a
+            # full pair_batch_size of padded lanes — with many small rules the
+            # padding waste would dominate the whole pass. rule_bs <= batch_size
+            # always, so the int32-safety clamp above still covers it (under a
+            # mesh, batch_size is already a mesh multiple, so padding rule_bs
+            # cannot exceed it)
+            rule_bs = min(batch_size, 1 << max(int(rp.total - 1).bit_length(), 6))
+            if mesh is not None:
+                rule_bs = pad_to_multiple(rule_bs, mesh.devices.size)
+            pos_rule = pos_cache.get(rule_bs)
+            if pos_rule is None:
+                if mesh is not None:
+                    pos_rule = jax.device_put(
+                        np.arange(rule_bs, dtype=np.int32), shard
+                    )
+                else:
+                    pos_rule = jnp.arange(rule_bs, dtype=jnp.int32)
+                pos_cache[rule_bs] = pos_rule
+            order_dev = put(rp.order)
+            units_dev = tuple(put(a) for a in (rp.ua, rp.la, rp.ub, rp.lb))
+            kkey = (id(program), rule_bs, None if mesh is None else id(mesh))
+            fn = rp.kernel_cache.get(kkey)
+            if fn is None:
+                fn = rp.kernel_cache[kkey] = make_virtual_pattern_fn(
+                    program, rule_bs, n_prev=r,
+                    has_uid_mask=plan.uid_codes is not None,
+                    own_res=rp.residual_fn,
+                    prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
+                    mesh=mesh,
+                )
+            # One metadata row [u0, valid, pc_rel...] per batch, padded to ONE
+            # power-of-two kpad for the whole rule (one kernel specialisation
+            # per rule). Uploaded per batch with device_put — uploads are
+            # ASYNC on every backend measured (including the tunnelled axon
+            # platform, where they cost ~0.2ms dispatched vs 67ms for an
+            # EAGER device-side op like meta_dev[b]; never slice eagerly in
+            # this loop).
+            starts = list(range(0, rp.total, rule_bs))
+            u0s, u1s = [], []
+            for p0 in starts:
+                p1 = min(p0 + rule_bs, rp.total)
+                u0s.append(int(np.searchsorted(rp.pc, p0, side="right")) - 1)
+                u1s.append(int(np.searchsorted(rp.pc, p1 - 1, side="right")) - 1)
+            kmax = max(u1 - u0 + 2 for u0, u1 in zip(u0s, u1s))
+            kpad = 1 << int(max(kmax, 2) - 1).bit_length()
+            imax = np.iinfo(np.int32).max
+            for b, p0 in enumerate(starts):
+                u0, u1 = u0s[b], u1s[b]
+                p1 = min(p0 + rule_bs, rp.total)
+                pc_rel = (rp.pc[u0 : u1 + 2] - p0).astype(np.int64)
+                meta = np.full(kpad + 2, imax, np.int32)
+                meta[0] = u0
+                meta[1] = p1 - p0
+                meta[2 : u1 - u0 + 4] = np.clip(pc_rel, -(1 << 31) + 1, imax)
+                pid, acc = fn(
+                    pos_rule, packed, order_dev, *units_dev, codes_dev,
+                    uid_dev, res_ops_dev, put(meta), acc,
+                )
+                if want_ids:
+                    inflight.append(
+                        (r, p0, out_pos, p1 - p0, pool.submit(np.asarray, pid))
+                    )
+                    while len(inflight) > _D2H_DEPTH:
+                        pr, pp0, ps, n_valid, fut = inflight.popleft()
+                        yield pr, pp0, ps, n_valid, fut.result()[:n_valid]
+                else:
+                    yield r, p0, out_pos, p1 - p0, None
+                out_pos += p1 - p0
+                in_acc += 1
+                if in_acc >= flush_every:
+                    counts += np.asarray(acc[:-1], np.int64)
+                    # reset through put(): a plain jnp.zeros would drop the
+                    # replicated sharding under a mesh and force a reshard /
+                    # second executable on the next batch
+                    acc = put(np.zeros(n_patterns + 1, np.int32))
+                    in_acc = 0
+        while inflight:
+            pr, pp0, ps, n_valid, fut = inflight.popleft()
+            yield pr, pp0, ps, n_valid, fut.result()[:n_valid]
+        if in_acc:
+            counts += np.asarray(acc[:-1], np.int64)
+    finally:
+        # consumer may abandon the generator mid-stream (exception in
+        # a scoring chunk): do not leak pool threads or pinned buffers
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
 
 
 def compute_virtual_pattern_ids(program, plan: VirtualPlan,
