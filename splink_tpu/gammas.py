@@ -127,6 +127,58 @@ class _NumericField:
         self.null_bit = null_bit
 
 
+class _QgramField:
+    """Lane layout of one column's precomputed q-gram auxiliaries
+    (qgram_ops.qgram_row_aux): distinct-gram first-occurrence bitmask,
+    distinct count, squared gram-count norm. Lanes are packed only for the
+    comparison kinds present (jaccard needs mask+count, cosine needs
+    sumsq); absent components are None."""
+
+    __slots__ = ("mask", "count_lane", "sq_lane")
+
+    def __init__(self, mask, count_lane, sq_lane):
+        self.mask = mask  # lane slice, ceil(n_windows/32) uint32 lanes
+        self.count_lane = count_lane
+        self.sq_lane = sq_lane
+
+
+def _qgram_key(name: str, q: int) -> str:
+    return f"\x00qgram:{name}:{q}"
+
+
+def _comparison_input_column(col_settings: dict) -> str | None:
+    """The encoded column a comparison column reads: ``col_name``, else the
+    comparison spec's ``column``, else the first ``custom_columns_used``
+    entry. The single source of truth for this resolution — used by the
+    include-set, the gamma dispatcher and the q-gram aux packing, which must
+    agree or a comparison silently misses its packed lanes."""
+    spec = col_settings.get("comparison") or {}
+    name = col_settings.get("col_name") or spec.get("column")
+    if name is None:
+        name = (col_settings.get("custom_columns_used") or [None])[0]
+    return name
+
+
+def qgram_specs_for(settings: dict) -> tuple[tuple[str, int, bool, bool], ...]:
+    """(column, q, want_jaccard_aux, want_cosine_aux) tuples describing the
+    per-row q-gram aux lanes to pack: one per native qgram_jaccard/
+    qgram_cosine comparison, packing only the components its kind reads
+    (row gathers are the measured bottleneck — unused lanes widen every
+    gather). CASE-compiled expressions keep the self-contained kernels —
+    their argument may be an arbitrary expression, not a packed column."""
+    flags: dict[tuple[str, int], list[bool]] = {}
+    for c in settings["comparison_columns"]:
+        spec = c.get("comparison") or {}
+        kind = spec.get("kind")
+        if kind in ("qgram_jaccard", "qgram_cosine"):
+            name = _comparison_input_column(c)
+            if name:
+                f = flags.setdefault((name, int(spec.get("q", 2))), [False, False])
+                f[0] |= kind == "qgram_jaccard"
+                f[1] |= kind == "qgram_cosine"
+    return tuple((n, q, f[0], f[1]) for (n, q), f in flags.items())
+
+
 def comparison_columns_used(settings: dict) -> set[str] | None:
     """Encoded-column names the gamma program reads, or None for 'all'
     (a registered custom comparison may touch any column)."""
@@ -138,9 +190,7 @@ def comparison_columns_used(settings: dict) -> set[str] | None:
         kind = spec.get("kind")
         if kind == "custom":
             return None
-        name = col.get("col_name") or spec.get("column")
-        if name is None:
-            name = (col.get("custom_columns_used") or [None])[0]
+        name = _comparison_input_column(col)
         if name:
             used.add(name)
             if kind == "dmetaphone":
@@ -153,7 +203,9 @@ def comparison_columns_used(settings: dict) -> set[str] | None:
     return used
 
 
-def pack_table(table: EncodedTable, float_dtype=jnp.float32, include=None):
+def pack_table(
+    table: EncodedTable, float_dtype=jnp.float32, include=None, qgram_specs=()
+):
     """Pack encoded columns into one (n_rows, n_lanes) uint32 matrix.
 
     Layout per string column: chars (width/4 lanes for ASCII, width lanes for
@@ -200,6 +252,18 @@ def pack_table(table: EncodedTable, float_dtype=jnp.float32, include=None):
         len_lane = add(sc.lengths.astype(np.int32).view(np.uint32)).start
         tok_lane = add(sc.token_ids.astype(np.int32).view(np.uint32)).start
         layout[name] = _StringField(kind, sc.width, chars, len_lane, tok_lane)
+
+    for qname, q, want_jac, want_cos in qgram_specs:
+        sc = table.strings.get(qname)
+        if sc is None or (include is not None and qname not in include):
+            continue
+        mask, count, sumsq = qgram_ops.qgram_row_aux(
+            sc.bytes_, sc.lengths, sc.token_ids, q
+        )
+        mslice = add(mask) if want_jac else None
+        count_lane = add(count.view(np.uint32)).start if want_jac else None
+        sq_lane = add(sumsq.view(np.uint32)).start if want_cos else None
+        layout[_qgram_key(qname, q)] = _QgramField(mslice, count_lane, sq_lane)
 
     f64 = float_dtype == jnp.float64
     num_names = [
@@ -266,6 +330,31 @@ class PairContext:
         null = ((word >> np.uint32(f.null_bit)) & np.uint32(1)) == 1
         return val, null
 
+    def qgram_aux(self, name: str, q: int):
+        """Per-side precomputed q-gram aux lanes, or None when the packed
+        table does not carry them (CASE-compiled or custom callers). Each
+        side is (mask, count, sumsq) with None for components the packed
+        kinds did not need."""
+        f = self._layout.get(_qgram_key(name, q))
+        if f is None:
+            return None
+
+        def side(rows):
+            mask = rows[:, f.mask] if f.mask is not None else None
+            count = (
+                jax.lax.bitcast_convert_type(rows[:, f.count_lane], jnp.int32)
+                if f.count_lane is not None
+                else None
+            )
+            sumsq = (
+                jax.lax.bitcast_convert_type(rows[:, f.sq_lane], jnp.float32)
+                if f.sq_lane is not None
+                else None
+            )
+            return mask, count, sumsq
+
+        return side(self._rows_l), side(self._rows_r)
+
     def col(self, name: str) -> PairColumn:
         f = self._layout[name]
         out = PairColumn()
@@ -294,11 +383,7 @@ def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
     spec = col_settings["comparison"]
     kind = spec["kind"]
     levels = col_settings["num_levels"]
-    name = (
-        col_settings["col_name"]
-        if "col_name" in col_settings
-        else spec.get("column", col_settings.get("custom_columns_used", [None])[0])
-    )
+    name = _comparison_input_column(col_settings)
 
     if kind == "custom":
         fn = _CUSTOM_COMPARISONS.get(spec.get("fn", ""))
@@ -369,15 +454,33 @@ def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
         return bucket_difference(diff, thresholds, pc.null)
 
     if kind == "qgram_jaccard":
-        sim = qgram_ops.qgram_jaccard(
-            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, spec.get("q", 2)
-        )
+        q = int(spec.get("q", 2))
+        aux = ctx.qgram_aux(name, q)
+        if aux is not None and aux[0][0] is not None:
+            (m_l, n_l, _), (_, n_r, _) = aux
+            sim = qgram_ops.qgram_jaccard_masked(
+                pc.chars_l, pc.chars_r, pc.len_l, pc.len_r,
+                m_l, n_l, n_r, q,
+            )
+        else:
+            sim = qgram_ops.qgram_jaccard(
+                pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, q
+            )
         return bucket_similarity(sim, thresholds, pc.null)
 
     if kind == "qgram_cosine":
-        sim = 1.0 - qgram_ops.qgram_cosine_distance(
-            pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, spec.get("q", 2)
-        )
+        q = int(spec.get("q", 2))
+        aux = ctx.qgram_aux(name, q)
+        if aux is not None and aux[0][2] is not None:
+            (_, _, x11), (_, _, x22) = aux
+            dist = qgram_ops.qgram_cosine_masked(
+                pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, x11, x22, q
+            )
+        else:
+            dist = qgram_ops.qgram_cosine_distance(
+                pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, q
+            )
+        sim = 1.0 - dist
         return bucket_similarity(sim, thresholds, pc.null)
 
     if kind == "name_inversion":
@@ -426,7 +529,10 @@ class GammaProgram:
         # Pack the compared columns into one uint32 matrix and push it to
         # device once: each pair batch then costs exactly two row gathers.
         packed, layout = pack_table(
-            table, float_dtype, include=comparison_columns_used(settings)
+            table,
+            float_dtype,
+            include=comparison_columns_used(settings),
+            qgram_specs=qgram_specs_for(settings),
         )
         self._packed = jnp.asarray(packed)
         self._layout = layout

@@ -130,10 +130,21 @@ class Splink:
                 (/root/reference/splink/iterate.py:54-55).
             spark: ignored (the reference's SparkSession slot).
         """
-        # before completion fills defaults (in place): did the USER set a
-        # compilation cache dir? An explicit value — even one equal to
-        # the default — opts in on any backend, incl. CPU
-        _cache_explicit = "compilation_cache_dir" in settings
+        # An explicit compilation_cache_dir opts in on any backend, incl.
+        # CPU. Completion never auto-fills this key (settings.py), so on
+        # current models presence == user intent — but models SAVED by
+        # earlier builds had the default auto-filled into their settings,
+        # so a value equal to the schema default is treated as implicit
+        # (users opting into CPU caching pick their own path).
+        from .validate import get_default_value
+
+        _cache_default = get_default_value(
+            "compilation_cache_dir", is_column_setting=False
+        )
+        _cache_explicit = (
+            "compilation_cache_dir" in settings
+            and settings["compilation_cache_dir"] != _cache_default
+        )
         self.settings = complete_settings_dict(settings)
         backend = self.settings["backend"]
         if backend != "jax":  # schema enum also rejects; double-checked here
@@ -155,10 +166,10 @@ class Splink:
         from .utils.profiling import set_trace_dir
 
         set_trace_dir(self.settings.get("profile_dir") or None)
-        _enable_compilation_cache(
-            self.settings.get("compilation_cache_dir"),
-            explicit=_cache_explicit,
-        )
+        _cache_dir = self.settings.get("compilation_cache_dir")
+        if _cache_dir is None:  # resolve the schema default lazily
+            _cache_dir = _cache_default
+        _enable_compilation_cache(_cache_dir, explicit=_cache_explicit)
 
         self._table: EncodedTable | None = None
         self._pairs: PairIndex | None = None

@@ -55,7 +55,13 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "max_resident_pairs",
     "spill_dir",
     "profile_dir",
-    "compilation_cache_dir",
+    # NOTE: compilation_cache_dir is deliberately NOT auto-filled. The
+    # linker must be able to tell a user-set value (opts in on any
+    # backend) from the schema default (accelerator backends only), and
+    # completion mutates the caller's dict in place — auto-filling would
+    # make a reused settings dict look explicitly configured on the
+    # second Splink() construction. The linker resolves the default
+    # lazily instead.
     "float64",
 ]
 

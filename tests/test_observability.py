@@ -304,6 +304,50 @@ def test_profile_dir_captures_traces(tmp_path):
         set_trace_dir(None)  # process-wide flag: do not leak into other tests
 
 
+def test_reused_settings_dict_stays_cache_default_gated():
+    """Reusing ONE settings dict (no compilation_cache_dir) for two
+    linkers must not enable the cache on the CPU backend: completion
+    mutates the dict in place, and an auto-filled default key must not
+    masquerade as a user opt-in on the second construction."""
+    import jax
+    import pandas as pd
+
+    import splink_tpu.linker as linker_mod
+    from splink_tpu import Splink
+
+    prev_applied = linker_mod._compilation_cache_applied
+    df = pd.DataFrame({"unique_id": [0, 1], "name": ["a", "b"]})
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+        "blocking_rules": ["l.name = r.name"],
+    }
+    try:
+        linker_mod._compilation_cache_applied = None
+        if jax.default_backend() != "cpu":
+            pytest.skip("CPU-only gate: not exercisable on an accelerator")
+        Splink(s, df=df)
+        assert linker_mod._compilation_cache_applied is None
+        Splink(s, df=df)  # same (now completed) dict again
+        assert linker_mod._compilation_cache_applied is None
+        assert "compilation_cache_dir" not in s  # completion never fills it
+        # legacy saved models carry the auto-filled DEFAULT value in their
+        # settings (earlier builds completed it in): equal-to-default must
+        # read as implicit, not as a CPU opt-in
+        from splink_tpu.validate import get_default_value
+
+        legacy = {
+            **s,
+            "compilation_cache_dir": get_default_value(
+                "compilation_cache_dir", is_column_setting=False
+            ),
+        }
+        Splink(legacy, df=df)
+        assert linker_mod._compilation_cache_applied is None
+    finally:
+        linker_mod._compilation_cache_applied = prev_applied
+
+
 def test_compilation_cache_dir_applies(tmp_path):
     """settings["compilation_cache_dir"] -> jax persistent compilation
     cache enabled at that path (process-wide, first linker wins); entries
