@@ -186,6 +186,10 @@ def config_4(scale):
 
     t1 = time.perf_counter()
     if linker._use_pattern_pipeline():
+        # the score stream below is part of this config — same hint the
+        # public get_scored_comparisons sets, so the virtual pass keeps
+        # its ids and the stream is LUT-only
+        linker._virtual_want_ids = True
         linker._ensure_pattern_ids()
         t_gamma = time.perf_counter() - t1
         t1 = time.perf_counter()

@@ -586,6 +586,11 @@ class GammaProgram:
                     jnp.arange(pid.shape[0]) < valid, pid, n_patterns
                 )
                 acc = acc + jnp.bincount(masked, length=n_patterns + 1)
+                if n_patterns + 1 <= (1 << 16):
+                    # narrow on device: halves the per-batch D2H (all
+                    # real ids < n_patterns <= 65535; padding-tail pids
+                    # are sliced off host-side before use)
+                    pid = pid.astype(jnp.uint16)
                 return pid, acc
 
             self._pattern_kernel = _pattern_kernel

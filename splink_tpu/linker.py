@@ -33,6 +33,13 @@ from .utils.profiling import StageTimer
 
 logger = logging.getLogger("splink_tpu")
 
+# RAM caps (candidate counts) for keeping the virtual pass's per-candidate
+# pattern ids for a later score stream: 2^32 uint16 ids = 8.6 GB, 2^31
+# int32 ids = 8.6 GB. Above these the stream recomputes ids chunk-wise
+# instead (virtual_materialise_ids="on" overrides).
+_MAX_RESIDENT_IDS_U16 = 1 << 32
+_MAX_RESIDENT_IDS_I32 = 1 << 31
+
 _compilation_cache_applied: str | None = None
 
 
@@ -180,6 +187,11 @@ class Splink:
         self._pattern_program = None
         self._virtual = None  # pairgen.VirtualPlan (device pair generation)
         self._virtual_checked = False
+        # per-candidate pattern ids from the virtual pass (sentinel kept),
+        # materialised when a score stream is known to follow — one kernel
+        # pass instead of two (see _virtual_ids_policy)
+        self._P_virtual: np.ndarray | None = None
+        self._virtual_want_ids = False
         self._pair_bound: int | None = None  # estimate_pair_upper_bound memo
 
     # ------------------------------------------------------------------
@@ -502,6 +514,35 @@ class Splink:
             )
         return self._pattern_program
 
+    def _virtual_ids_policy(self) -> bool:
+        """Should the virtual pattern pass ALSO materialise per-candidate
+        ids? One pass (ids + histogram together) beats two (histogram-only
+        EM pass, then an ids recompute inside the score stream) whenever a
+        score stream is going to happen and the ids fit host RAM: the
+        kernels run once instead of twice, and the downloads overlap the
+        kernels either way. EM-only jobs keep the histogram-only pass —
+        no per-pair bytes ever cross the link (~25x the kernel cost over
+        a tunnelled device; scripts/virtual_breakdown.py)."""
+        mode = self.settings.get("virtual_materialise_ids", "auto")
+        if mode == "on":
+            return True
+        if mode == "off":
+            return False
+        if not self._virtual_want_ids:
+            return False
+        n = self._virtual.n_candidates
+        small = self._ensure_pattern_program().n_patterns + 1 <= (1 << 16)
+        cap = _MAX_RESIDENT_IDS_U16 if small else _MAX_RESIDENT_IDS_I32
+        if n > cap:
+            return False
+        # "fits host RAM" means the RAM actually free right now, not just
+        # the hard cap: claim at most half of it, else stream chunk-wise
+        try:
+            avail = os.sysconf("SC_AVPHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+        except (ValueError, OSError, AttributeError):
+            return True  # no probe on this platform; the cap still bounds
+        return n * (2 if small else 4) <= avail // 2
+
     def _ensure_pattern_ids(self):
         """(pattern_ids, counts, program): ONE device pass over the pair
         index computing gammas, pattern ids and their histogram. The gamma
@@ -514,25 +555,28 @@ class Splink:
             if self._virtual_plan() is not None:
                 # device pair generation: pairs decode on device from the
                 # plan's unit structure; nothing is materialised or
-                # transferred per pair. Histogram-ONLY pass: per-pair ids
-                # are not pulled back — over a tunnelled device that
-                # download costs ~25x the kernel (virtual_breakdown.py);
-                # the score stream recomputes them chunk-wise on demand.
+                # transferred per pair. Default is a histogram-ONLY pass
+                # (EM needs nothing else); when a score stream is known to
+                # follow, _virtual_ids_policy keeps the per-candidate ids
+                # from this same pass so the stream is LUT-only.
                 if self._pattern_counts is not None:
                     return None, self._pattern_counts, self._pattern_program
                 from .pairgen import compute_virtual_pattern_ids
 
                 with StageTimer("gammas_patterns"):
                     self._ensure_pattern_program()
-                    _, self._pattern_counts, n_real = (
+                    want_ids = self._virtual_ids_policy()
+                    pids, self._pattern_counts, n_real = (
                         compute_virtual_pattern_ids(
                             self._pattern_program,
                             self._virtual,
                             int(self.settings["pair_batch_size"]),
                             mesh=self._pattern_mesh(),
-                            return_ids=False,
+                            return_ids=want_ids,
                         )
                     )
+                    if want_ids:
+                        self._P_virtual = pids
                 logger.info(
                     "device pair generation scored %d pairs (%d candidate "
                     "positions)", n_real, self._virtual.n_candidates,
@@ -597,48 +641,61 @@ class Splink:
                 )
 
     def _stream_virtual_chunks(self):
-        """Scored chunks under device pair generation: re-drive the device
-        pass chunk-wise (kernels are cached on the plan — no recompile),
-        pull each chunk's pattern ids, filter the masked sentinel
-        positions, decode (idx_l, idx_r) host-side from the plan's unit
-        structure (f64 is exact on the host), and LUT-score. Recomputing
-        here instead of keeping the EM pass's ids means the EM pass never
-        downloads per-pair bytes at all, and a score stream is the one
-        consumer that inherently materialises per-pair output anyway."""
+        """Scored chunks under device pair generation. Two sources, same
+        output: when the EM pass kept per-candidate ids
+        (_virtual_ids_policy) the stream is host-only — slice the stored
+        ids per batch, decode positions, LUT-score, zero device work.
+        Otherwise re-drive the device pass chunk-wise (kernels are cached
+        on the plan — no recompile) and pull each chunk's ids; then the
+        EM pass never downloaded per-pair bytes at all."""
         from .pairgen import _virtual_pass_iter, decode_positions
 
         plan = self._virtual
         program = self._ensure_pattern_program()
         PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
         sentinel = program.n_patterns
+
+        def emit(Pc, r, p0):
+            keep = Pc != sentinel
+            if not keep.any():
+                return None
+            # batch-relative positions -> rule-relative (batches never
+            # cross a rule boundary)
+            qs = p0 + np.flatnonzero(keep).astype(np.int64)
+            # the kernel's sentinel already filtered masked pairs —
+            # don't re-run residual predicates on the host
+            il, ir, _ = decode_positions(plan, r, qs, compute_masked=False)
+            Pk = Pc[keep]
+            return self._assemble_df_e(
+                PM[Pk],
+                il,
+                ir,
+                p_lut[Pk],
+                pm_lut[Pk] if pm_lut is not None else None,
+                pu_lut[Pk] if pu_lut is not None else None,
+            )
+
+        batch = int(self.settings["pair_batch_size"])
         with StageTimer("score_patterns"):
+            if self._P_virtual is not None:
+                out_base = 0
+                for r, rp in enumerate(plan.rules):
+                    for p0 in range(0, rp.total, batch):
+                        p1 = min(p0 + batch, rp.total)
+                        Pc = self._P_virtual[
+                            out_base + p0 : out_base + p1
+                        ].astype(np.int32, copy=False)
+                        df = emit(Pc, r, p0)
+                        if df is not None:
+                            yield df
+                    out_base += rp.total
+                return
             for r, p0, _, n_valid, chunk in _virtual_pass_iter(
-                program,
-                plan,
-                int(self.settings["pair_batch_size"]),
-                mesh=self._pattern_mesh(),
+                program, plan, batch, mesh=self._pattern_mesh()
             ):
-                Pc = chunk.astype(np.int32, copy=False)
-                keep = Pc != sentinel
-                if not keep.any():
-                    continue
-                # batch-relative positions -> rule-relative (batches never
-                # cross a rule boundary)
-                qs = p0 + np.flatnonzero(keep).astype(np.int64)
-                # the kernel's sentinel already filtered masked pairs —
-                # don't re-run residual predicates on the host
-                il, ir, _ = decode_positions(
-                    plan, r, qs, compute_masked=False
-                )
-                Pk = Pc[keep]
-                yield self._assemble_df_e(
-                    PM[Pk],
-                    il,
-                    ir,
-                    p_lut[Pk],
-                    pm_lut[Pk] if pm_lut is not None else None,
-                    pu_lut[Pk] if pu_lut is not None else None,
-                )
+                df = emit(chunk.astype(np.int32, copy=False), r, p0)
+                if df is not None:
+                    yield df
 
     def _run_em_patterns(self, compute_ll: bool) -> None:
         _, counts, program = self._ensure_pattern_ids()
@@ -703,8 +760,17 @@ class Splink:
         LUT gather — pair data crosses the host<->device link exactly once.
         """
         if self._use_pattern_pipeline():
+            # scoring follows EM here, so the virtual pass may keep its
+            # per-candidate ids and make the stream LUT-only (one kernel
+            # pass instead of two)
+            self._virtual_want_ids = True
             self._run_em_patterns(compute_ll)
-            return self._concat_chunks(self._stream_pattern_chunks())
+            df_e = self._concat_chunks(self._stream_pattern_chunks())
+            # the single-frame output is materialised — release the ids
+            # (same convention as _G_dev below); a later re-stream simply
+            # recomputes them chunk-wise
+            self._P_virtual = None
+            return df_e
         G = self._ensure_gammas()
         self._run_em(G, compute_ll)
         df_e = self._build_df_e(G)
@@ -858,6 +924,9 @@ class Splink:
         single-host equivalent — each chunk can be appended to parquet etc.
         """
         if self._use_pattern_pipeline():
+            # scoring follows EM: let the virtual pass keep its ids (the
+            # auto policy still bounds them against available RAM)
+            self._virtual_want_ids = True
             self._run_em_patterns(compute_ll)
             yield from self._stream_pattern_chunks()
             return

@@ -1082,6 +1082,11 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
         pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
         pid = jnp.where(masked, n_patterns, pid)
         acc = acc + jnp.bincount(pid, length=n_patterns + 1)
+        if n_patterns + 1 <= (1 << 16):
+            # narrow ON DEVICE: the ids pass is download-bound over a
+            # tunnelled link, and every value (sentinel included) fits
+            # uint16 — half the D2H bytes of the int32 it was computed in
+            pid = pid.astype(jnp.uint16)
         return pid, acc
 
     return fn

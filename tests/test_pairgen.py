@@ -229,6 +229,46 @@ def test_linker_virtual_stream_and_inference():
     )
 
 
+def test_virtual_materialised_ids_stream_matches_recompute():
+    """virtual_materialise_ids: the LUT-only stream from stored ids must
+    be bitwise identical to the recompute stream, and the auto policy
+    must engage exactly on the scoring path."""
+    df = _df(240, seed=29)
+    kw = dict(device_pair_generation="on", max_resident_pairs=1024)
+    kept = Splink(_linker_settings(**kw), df=df)
+    out_kept = pd.concat(
+        list(kept.stream_scored_comparisons()), ignore_index=True
+    )
+    assert kept._P_virtual is not None  # auto + scoring path -> one pass
+    assert kept._P_virtual.dtype == np.uint16
+    # the one-frame API releases the ids once the frame is materialised
+    released = Splink(_linker_settings(**kw), df=df)
+    out_frame = released.get_scored_comparisons()
+    assert released._P_virtual is None
+    off = Splink(
+        _linker_settings(virtual_materialise_ids="off", **kw), df=df
+    )
+    out_off = off.get_scored_comparisons()
+    assert off._P_virtual is None  # forced two-pass
+    key = ["unique_id_l", "unique_id_r"]
+    a = out_kept.sort_values(key).reset_index(drop=True)
+    b = out_off.sort_values(key).reset_index(drop=True)
+    c = out_frame.sort_values(key).reset_index(drop=True)
+    np.testing.assert_array_equal(a[key].to_numpy(), b[key].to_numpy())
+    np.testing.assert_array_equal(
+        a["match_probability"].to_numpy(), b["match_probability"].to_numpy()
+    )
+    np.testing.assert_array_equal(a[key].to_numpy(), c[key].to_numpy())
+    np.testing.assert_array_equal(
+        a["match_probability"].to_numpy(), c["match_probability"].to_numpy()
+    )
+    # EM-only entry points keep the histogram-only pass under auto
+    em_only = Splink(_linker_settings(**kw), df=df)
+    assert em_only._virtual_plan() is not None
+    em_only._run_em_patterns(False)
+    assert em_only._P_virtual is None
+
+
 def test_linker_virtual_auto_gate():
     """auto mode only engages above max_resident_pairs."""
     df = _df(200, seed=23)

@@ -359,3 +359,95 @@ class TestRound4OnHardware:
         # exact ties may differ by 0.01 (jar f64 artifact) — allow those
         assert (np.abs(got - jar) < 0.0101).all()
         assert (np.abs(got - jar) < 1e-6).mean() > 0.95
+
+
+class TestMaskedQgramOnHardware:
+    def test_masked_qgram_matches_self_contained_on_device(self):
+        """The precomputed-aux q-gram kernels (packed mask/count/norm
+        lanes, cross-matrix-only per pair) must lower and bit-match the
+        self-contained kernels on real XLA:TPU."""
+        from splink_tpu.data import encode_string_column
+        from splink_tpu.ops import qgram
+
+        rng = np.random.default_rng(13)
+        vals = ["".join(rng.choice(list("abcdef"), rng.integers(1, 18)))
+                for _ in range(300)] + ["", None]
+        col = encode_string_column(
+            np.array(rng.choice(np.array(vals, object), 4096), object),
+            width=24,
+        )
+        q = 2
+        mask, count, sumsq = qgram.qgram_row_aux(
+            col.bytes_, col.lengths, col.token_ids, q
+        )
+        il = rng.integers(0, len(col.lengths), 4096)
+        ir = rng.integers(0, len(col.lengths), 4096)
+        s1, s2, l1, l2 = _dev(
+            col.bytes_[il], col.bytes_[ir], col.lengths[il], col.lengths[ir]
+        )
+        plain = np.asarray(qgram.qgram_jaccard(s1, s2, l1, l2, q))
+        fast = np.asarray(
+            qgram.qgram_jaccard_masked(
+                s1, s2, l1, l2,
+                *_dev(mask[il], count[il], count[ir]), q,
+            )
+        )
+        np.testing.assert_array_equal(plain, fast)
+        plain_c = np.asarray(qgram.qgram_cosine_distance(s1, s2, l1, l2, q))
+        fast_c = np.asarray(
+            qgram.qgram_cosine_masked(
+                s1, s2, l1, l2, *_dev(sumsq[il], sumsq[ir]), q
+            )
+        )
+        np.testing.assert_array_equal(plain_c, fast_c)
+
+    def test_six_column_virtual_histogram_with_masked_qgram(self):
+        """Config-4-shaped program (JW x3, exact x2, masked qgram) through
+        the virtual pair index on device: histogram must match the
+        materialised pattern pass bit-for-bit."""
+        from splink_tpu import Splink
+        from splink_tpu.gammas import _qgram_key
+
+        rng = np.random.default_rng(17)
+        n = 4000
+        firsts = [f"fn{i:03d}" for i in range(60)]
+        surs = [f"sur{i:03d}" for i in range(80)]
+        df = pd.DataFrame(
+            {
+                "unique_id": np.arange(n),
+                "first_name": rng.choice(firsts, n),
+                "surname": rng.choice(surs, n),
+                "dob": rng.choice([f"19{k:02d}-01-01" for k in range(40)], n),
+                "city": rng.choice([f"c{k}" for k in range(12)], n),
+                "postcode": rng.choice([f"p{k:04d}" for k in range(300)], n),
+            }
+        )
+        cols = [
+            {"col_name": "first_name", "num_levels": 3},
+            {"col_name": "surname", "num_levels": 3},
+            {"col_name": "dob", "comparison": {"kind": "exact"}},
+            {"col_name": "city", "comparison": {"kind": "exact"}},
+            {"col_name": "postcode", "num_levels": 2},
+            {"custom_name": "surname_qgram", "custom_columns_used": ["surname"],
+             "num_levels": 2,
+             "comparison": {"kind": "qgram_jaccard", "column": "surname",
+                            "thresholds": [0.6]}},
+        ]
+        base = {
+            "link_type": "dedupe_only",
+            "comparison_columns": cols,
+            "blocking_rules": ["l.dob = r.dob", "l.postcode = r.postcode"],
+            "max_iterations": 3,
+        }
+        lk_virtual = Splink(
+            {**base, "device_pair_generation": "on", "max_resident_pairs": 1024},
+            df=df,
+        )
+        assert lk_virtual._virtual_plan() is not None
+        _, counts_v, prog = lk_virtual._ensure_pattern_ids()
+        assert _qgram_key("surname", 2) in prog._layout
+        lk_host = Splink(
+            {**base, "device_pair_generation": "off"}, df=df
+        )
+        _, counts_h, _ = lk_host._ensure_pattern_ids()
+        np.testing.assert_array_equal(np.asarray(counts_v), np.asarray(counts_h))
