@@ -149,6 +149,53 @@ def _bench_virtual_pipeline(settings, table, prog):
         return {"virtual_error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _bench_virtual_qgram(df):
+    """The heavier gamma program config 4 runs: the 4 flagship comparisons
+    PLUS a q-gram Jaccard on surname (masked precomputed-aux kernel),
+    through the virtual pair index, histogram-only. Quantifies what the
+    masked-qgram packing buys on chip (BENCHMARKS.md round 4b)."""
+    try:
+        from splink_tpu.data import encode_table
+        from splink_tpu.gammas import GammaProgram
+        from splink_tpu.pairgen import (
+            build_virtual_plan,
+            compute_virtual_pattern_ids,
+        )
+        from splink_tpu.settings import complete_settings_dict
+
+        s = dict(SETTINGS)
+        s["comparison_columns"] = list(s["comparison_columns"]) + [
+            {
+                "custom_name": "surname_qgram",
+                "custom_columns_used": ["surname"],
+                "num_levels": 2,
+                "comparison": {
+                    "kind": "qgram_jaccard",
+                    "column": "surname",
+                    "thresholds": [0.6],
+                },
+            }
+        ]
+        s = complete_settings_dict(s)
+        table = encode_table(df, s)
+        prog = GammaProgram(s, table)
+        plan = build_virtual_plan(s, table)
+        if plan is None:
+            return {"virtual_qgram_error": "plan rejected"}
+        compute_virtual_pattern_ids(prog, plan, BATCH, return_ids=False)
+        t0 = time.perf_counter()
+        compute_virtual_pattern_ids(prog, plan, BATCH, return_ids=False)
+        hist_time = time.perf_counter() - t0
+        return {
+            "virtual_hist_qgram5col_pairs_per_sec": round(
+                plan.n_candidates / hist_time
+            ),
+            "virtual_hist_qgram5col_seconds": round(hist_time, 3),
+        }
+    except Exception as e:  # noqa: BLE001 - report, don't die
+        return {"virtual_qgram_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def main():
     _probe_device_init()
     import jax
@@ -241,6 +288,7 @@ def main():
     em_time = time.perf_counter() - t1
 
     extras = _bench_virtual_pipeline(settings, table, prog)
+    extras.update(_bench_virtual_qgram(df))
 
     print(json.dumps({
         "metric": "scored_record_pairs_per_sec_per_chip",

@@ -146,6 +146,15 @@ def _qgram_key(name: str, q: int) -> str:
     return f"\x00qgram:{name}:{q}"
 
 
+def pattern_ids_fit_uint16(n_patterns: int) -> bool:
+    """True when every pattern id AND the mask sentinel (== n_patterns)
+    fit uint16 — the single predicate deciding both the device-side
+    narrowing before D2H and the host-side array dtype. One definition so
+    the sites cannot drift (a host uint16 with a device int32 would
+    silently double the download bytes)."""
+    return n_patterns + 1 <= (1 << 16)
+
+
 def _comparison_input_column(col_settings: dict) -> str | None:
     """The encoded column a comparison column reads: ``col_name``, else the
     comparison spec's ``column``, else the first ``custom_columns_used``
@@ -586,7 +595,7 @@ class GammaProgram:
                     jnp.arange(pid.shape[0]) < valid, pid, n_patterns
                 )
                 acc = acc + jnp.bincount(masked, length=n_patterns + 1)
-                if n_patterns + 1 <= (1 << 16):
+                if pattern_ids_fit_uint16(n_patterns):
                     # narrow on device: halves the per-batch D2H (all
                     # real ids < n_patterns <= 65535; padding-tail pids
                     # are sliced off host-side before use)
@@ -680,7 +689,9 @@ class GammaProgram:
                 f"({MAX_PATTERNS}); use the gamma-matrix paths"
             )
         n = len(idx_l)
-        id_dtype = np.uint16 if self.n_patterns <= (1 << 16) else np.int32
+        id_dtype = (
+            np.uint16 if pattern_ids_fit_uint16(self.n_patterns) else np.int32
+        )
         pids = np.empty(n, id_dtype)
         total = np.zeros(self.n_patterns, np.int64)
         if n == 0:
@@ -941,7 +952,9 @@ class PatternStream(_StreamBatcher):
         super().__init__(batch_size)
         self.program = program
         self.id_dtype = (
-            np.uint16 if program.n_patterns <= (1 << 16) else np.int32
+            np.uint16
+            if pattern_ids_fit_uint16(program.n_patterns)
+            else np.int32
         )
         self._parts: list[np.ndarray] = []
         self._pending: tuple[int, jnp.ndarray] | None = None

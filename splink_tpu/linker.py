@@ -531,7 +531,9 @@ class Splink:
         if not self._virtual_want_ids:
             return False
         n = self._virtual.n_candidates
-        small = self._ensure_pattern_program().n_patterns + 1 <= (1 << 16)
+        from .gammas import pattern_ids_fit_uint16
+
+        small = pattern_ids_fit_uint16(self._ensure_pattern_program().n_patterns)
         cap = _MAX_RESIDENT_IDS_U16 if small else _MAX_RESIDENT_IDS_I32
         if n > cap:
             return False
@@ -676,15 +678,19 @@ class Splink:
             )
 
         batch = int(self.settings["pair_batch_size"])
+        # bind locally: a concurrent release (get_scored_comparisons frees
+        # the ids after materialising its frame) must not crash a
+        # partially-consumed generator
+        P = self._P_virtual
         with StageTimer("score_patterns"):
-            if self._P_virtual is not None:
+            if P is not None:
                 out_base = 0
                 for r, rp in enumerate(plan.rules):
                     for p0 in range(0, rp.total, batch):
                         p1 = min(p0 + batch, rp.total)
-                        Pc = self._P_virtual[
-                            out_base + p0 : out_base + p1
-                        ].astype(np.int32, copy=False)
+                        Pc = P[out_base + p0 : out_base + p1].astype(
+                            np.int32, copy=False
+                        )
                         df = emit(Pc, r, p0)
                         if df is not None:
                             yield df
@@ -929,6 +935,10 @@ class Splink:
             self._virtual_want_ids = True
             self._run_em_patterns(compute_ll)
             yield from self._stream_pattern_chunks()
+            # stream exhausted: release the (potentially multi-GB) ids,
+            # same convention as the one-frame path; a re-stream simply
+            # recomputes chunk-wise
+            self._P_virtual = None
             return
         G = self._ensure_gammas()
         self._run_em(G, compute_ll)

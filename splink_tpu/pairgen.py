@@ -68,6 +68,7 @@ from .blocking import (
     parse_blocking_rule,
 )
 from .data import EncodedTable
+from .gammas import pattern_ids_fit_uint16
 
 # Unit extent bound. 2048 keeps the triangle discriminant (2s-1)^2 < 2^24
 # (f32-exact) and a rectangle's pair count at 2048^2 ~ 4.2M (int32-safe);
@@ -1082,7 +1083,7 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
         pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
         pid = jnp.where(masked, n_patterns, pid)
         acc = acc + jnp.bincount(pid, length=n_patterns + 1)
-        if n_patterns + 1 <= (1 << 16):
+        if pattern_ids_fit_uint16(n_patterns):
             # narrow ON DEVICE: the ids pass is download-bound over a
             # tunnelled link, and every value (sentinel included) fits
             # uint16 — half the D2H bytes of the int32 it was computed in
@@ -1292,7 +1293,7 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
     """
     n_patterns = program.n_patterns
     # sentinel must be representable
-    id_dtype = np.uint16 if n_patterns + 1 <= (1 << 16) else np.int32
+    id_dtype = np.uint16 if pattern_ids_fit_uint16(n_patterns) else np.int32
     counts = np.zeros(n_patterns, np.int64)
     pids = (
         np.empty(plan.n_candidates, id_dtype) if return_ids else None

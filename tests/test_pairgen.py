@@ -236,11 +236,15 @@ def test_virtual_materialised_ids_stream_matches_recompute():
     df = _df(240, seed=29)
     kw = dict(device_pair_generation="on", max_resident_pairs=1024)
     kept = Splink(_linker_settings(**kw), df=df)
-    out_kept = pd.concat(
-        list(kept.stream_scored_comparisons()), ignore_index=True
-    )
-    assert kept._P_virtual is not None  # auto + scoring path -> one pass
+    gen = kept.stream_scored_comparisons()
+    chunks = [next(gen)]
+    # policy engaged: ids kept from the EM pass (checked mid-stream —
+    # exhausting the generator releases them)
+    assert kept._P_virtual is not None
     assert kept._P_virtual.dtype == np.uint16
+    chunks.extend(gen)
+    assert kept._P_virtual is None  # released once the stream is exhausted
+    out_kept = pd.concat(chunks, ignore_index=True)
     # the one-frame API releases the ids once the frame is materialised
     released = Splink(_linker_settings(**kw), df=df)
     out_frame = released.get_scored_comparisons()
