@@ -617,91 +617,186 @@ class Splink:
         return PM, p, pm, pu
 
     def _stream_pattern_chunks(self):
-        """Yield scored chunks from the pattern-id pipeline: pure numpy LUT
-        gathers per chunk, no device round-trips."""
-        if self._virtual_plan() is not None:
-            # scoring needs only the program + one ids pass — not the
-            # histogram pass (skipping it halves device time when EM
-            # never ran, e.g. manual FS weights)
-            yield from self._stream_virtual_chunks()
-            return
-        P, _, _ = self._ensure_pattern_ids()
-        pairs = self._ensure_pairs()
+        """Yield scored chunks from the pattern-id pipeline: one LUT gather
+        + frame assembly per (il, ir, pattern-ids) chunk. The chunk source
+        (stored virtual ids / virtual recompute / materialised pairs) is
+        _iter_pattern_triples — the single definition of the pair stream."""
         PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
-        batch = int(self.settings["pair_batch_size"])
         with StageTimer("score_patterns"):
-            for s in range(0, len(P), batch):
-                rows = slice(s, min(s + batch, len(P)))
-                Pc = P[rows].astype(np.int32, copy=False)
+            for il, ir, Pk in self._iter_pattern_triples():
                 yield self._assemble_df_e(
-                    PM[Pc],
-                    pairs.idx_l[rows],
-                    pairs.idx_r[rows],
-                    p_lut[Pc],
-                    pm_lut[Pc] if pm_lut is not None else None,
-                    pu_lut[Pc] if pu_lut is not None else None,
+                    PM[Pk],
+                    il,
+                    ir,
+                    p_lut[Pk],
+                    pm_lut[Pk] if pm_lut is not None else None,
+                    pu_lut[Pk] if pu_lut is not None else None,
                 )
 
-    def _stream_virtual_chunks(self):
-        """Scored chunks under device pair generation. Two sources, same
-        output: when the EM pass kept per-candidate ids
-        (_virtual_ids_policy) the stream is host-only — slice the stored
-        ids per batch, decode positions, LUT-score, zero device work.
-        Otherwise re-drive the device pass chunk-wise (kernels are cached
-        on the plan — no recompile) and pull each chunk's ids; then the
-        EM pass never downloaded per-pair bytes at all."""
-        from .pairgen import _virtual_pass_iter, decode_positions
-
-        plan = self._virtual
-        program = self._ensure_pattern_program()
-        PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
-        sentinel = program.n_patterns
-
-        def emit(Pc, r, p0):
-            keep = Pc != sentinel
-            if not keep.any():
-                return None
-            # batch-relative positions -> rule-relative (batches never
-            # cross a rule boundary)
-            qs = p0 + np.flatnonzero(keep).astype(np.int64)
-            # the kernel's sentinel already filtered masked pairs —
-            # don't re-run residual predicates on the host
-            il, ir, _ = decode_positions(plan, r, qs, compute_masked=False)
-            Pk = Pc[keep]
-            return self._assemble_df_e(
-                PM[Pk],
-                il,
-                ir,
-                p_lut[Pk],
-                pm_lut[Pk] if pm_lut is not None else None,
-                pu_lut[Pk] if pu_lut is not None else None,
-            )
-
+    def _iter_pattern_triples(self):
+        """Yield (idx_l, idx_r, pattern_ids) per chunk across the pattern
+        regimes — virtual with stored ids (host-only), virtual recompute
+        (device pass), materialised pairs — with masked sentinels already
+        filtered. The SINGLE definition of the pattern pair stream: the
+        score stream assembles frames from it and the streaming TF
+        adjustment drives it twice. (The virtual branch deliberately
+        avoids _ensure_pattern_ids: scoring needs no histogram pass, e.g.
+        under manual FS weights.)"""
         batch = int(self.settings["pair_batch_size"])
-        # bind locally: a concurrent release (get_scored_comparisons frees
-        # the ids after materialising its frame) must not crash a
-        # partially-consumed generator
-        P = self._P_virtual
-        with StageTimer("score_patterns"):
+        if self._virtual_plan() is not None:
+            from .pairgen import _virtual_pass_iter, decode_positions
+
+            plan = self._virtual
+            program = self._ensure_pattern_program()
+            sentinel = program.n_patterns
+
+            def decode(Pc, r, p0):
+                keep = Pc != sentinel
+                if not keep.any():
+                    return None
+                qs = p0 + np.flatnonzero(keep).astype(np.int64)
+                il, ir, _ = decode_positions(
+                    plan, r, qs, compute_masked=False
+                )
+                return il, ir, Pc[keep]
+
+            P = self._P_virtual  # local: immune to concurrent release
             if P is not None:
                 out_base = 0
                 for r, rp in enumerate(plan.rules):
                     for p0 in range(0, rp.total, batch):
                         p1 = min(p0 + batch, rp.total)
-                        Pc = P[out_base + p0 : out_base + p1].astype(
-                            np.int32, copy=False
+                        t = decode(
+                            P[out_base + p0 : out_base + p1].astype(
+                                np.int32, copy=False
+                            ),
+                            r,
+                            p0,
                         )
-                        df = emit(Pc, r, p0)
-                        if df is not None:
-                            yield df
+                        if t is not None:
+                            yield t
                     out_base += rp.total
                 return
-            for r, p0, _, n_valid, chunk in _virtual_pass_iter(
+            for r, p0, _, _n, chunk in _virtual_pass_iter(
                 program, plan, batch, mesh=self._pattern_mesh()
             ):
-                df = emit(chunk.astype(np.int32, copy=False), r, p0)
-                if df is not None:
-                    yield df
+                t = decode(chunk.astype(np.int32, copy=False), r, p0)
+                if t is not None:
+                    yield t
+            return
+        P, _, _ = self._ensure_pattern_ids()
+        pairs = self._ensure_pairs()
+        for s in range(0, len(P), batch):
+            rows = slice(s, min(s + batch, len(P)))
+            yield (
+                pairs.idx_l[rows],
+                pairs.idx_r[rows],
+                P[rows].astype(np.int32, copy=False),
+            )
+
+    def stream_tf_adjusted_comparisons(self, compute_ll: bool = False):
+        """Streaming term-frequency adjustment: the scale-free counterpart
+        of ``get_scored_comparisons() -> make_term_frequency_adjustments``
+        for outputs too large to materialise as one DataFrame.
+
+        Runs EM, then TWO passes over the scored pattern stream: pass 1
+        aggregates each flagged column's per-token mean match probability
+        (the reference's grouped aggregate + broadcast join,
+        /root/reference/splink/term_frequencies.py:49-95 — Spark gave it
+        scale-out for free; here it is a chunked host aggregation over
+        factorised token ids), pass 2 yields scored chunks with the
+        per-column ``<col>_adj`` columns and ``tf_adjusted_match_prob``.
+        Under device pair generation both passes are host-only LUT work
+        when the EM pass kept its per-candidate ids
+        (virtual_materialise_ids)."""
+        from .term_frequencies import bayes_combine, term_frequency_columns
+
+        tf_cols = list(term_frequency_columns(self.settings))
+        if not self._use_pattern_pipeline():
+            # resident regime: the one-frame path already exists
+            df_e = self.get_scored_comparisons(compute_ll)
+            yield self.make_term_frequency_adjustments(df_e)
+            return
+        if not tf_cols:
+            warnings.warn(
+                "No term frequency adjustment columns are specified in "
+                "your settings object. Streaming unadjusted comparisons."
+            )
+            yield from self.stream_scored_comparisons(compute_ll)
+            return
+        self._virtual_want_ids = True
+        self._run_em_patterns(compute_ll)
+        table = self._ensure_encoded()
+        cols: dict[str, tuple[np.ndarray, int]] = {}
+        for name in tf_cols:
+            sc = table.strings.get(name)
+            if sc is not None:
+                cols[name] = (sc.token_ids, sc.n_tokens)
+                continue
+            nc = table.numerics.get(name)
+            if nc is not None:
+                # numeric TF column: factorise values on the fly (token =
+                # distinct value, the same grouping the one-frame host
+                # path applies to raw values); null -> -1
+                codes, uniq = pd.factorize(nc.values_f64)
+                codes = codes.astype(np.int32)
+                codes[nc.null_mask] = -1
+                cols[name] = (codes, len(uniq))
+                continue
+            warnings.warn(
+                f"term-frequency column {name!r} is not an encoded "
+                "column; skipped in the streaming TF pass."
+            )
+        PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
+        base_lambda = float(self.params.params["λ"])
+        sums = {n: np.zeros(nt + 1) for n, (_, nt) in cols.items()}
+        counts = {n: np.zeros(nt + 1) for n, (_, nt) in cols.items()}
+        with StageTimer("tf_aggregate_patterns"):
+            for il, ir, Pk in self._iter_pattern_triples():
+                p = p_lut[Pk]
+                for name, (tid, _nt) in cols.items():
+                    tl = tid[il]
+                    agree = (tl == tid[ir]) & (tl >= 0)
+                    np.add.at(sums[name], tl[agree], p[agree])
+                    np.add.at(counts[name], tl[agree], 1.0)
+        adjusted = {}
+        for name in cols:
+            # token lambda -> Bayes-combined with (1 - base lambda), the
+            # same step as compute_token_adjustment
+            lam_t = sums[name] / np.maximum(counts[name], 1.0)
+            adjusted[name] = bayes_combine(
+                [lam_t, np.full(len(lam_t), 1.0 - base_lambda)]
+            )
+        try:
+            with StageTimer("score_tf_patterns"):
+                for il, ir, Pk in self._iter_pattern_triples():
+                    df = self._assemble_df_e(
+                        PM[Pk],
+                        il,
+                        ir,
+                        p_lut[Pk],
+                        pm_lut[Pk] if pm_lut is not None else None,
+                        pu_lut[Pk] if pu_lut is not None else None,
+                    )
+                    adj_arrays = []
+                    for name, (tid, _nt) in cols.items():
+                        tl = tid[il]
+                        agree = (tl == tid[ir]) & (tl >= 0)
+                        adj = np.where(
+                            agree, adjusted[name][np.where(agree, tl, 0)], 0.5
+                        )
+                        df[f"{name}_adj"] = adj
+                        adj_arrays.append(adj)
+                    df["tf_adjusted_match_prob"] = bayes_combine(
+                        [df["match_probability"].to_numpy()] + adj_arrays
+                    )
+                    lead = ["tf_adjusted_match_prob", "match_probability"]
+                    rest = [c for c in df.columns if c not in lead]
+                    yield df[lead + rest]
+        finally:
+            # release on exhaustion AND on an abandoned/closed generator —
+            # the ids can be multi-GB
+            self._P_virtual = None
 
     def _run_em_patterns(self, compute_ll: bool) -> None:
         _, counts, program = self._ensure_pattern_ids()
@@ -934,11 +1029,13 @@ class Splink:
             # auto policy still bounds them against available RAM)
             self._virtual_want_ids = True
             self._run_em_patterns(compute_ll)
-            yield from self._stream_pattern_chunks()
-            # stream exhausted: release the (potentially multi-GB) ids,
-            # same convention as the one-frame path; a re-stream simply
-            # recomputes chunk-wise
-            self._P_virtual = None
+            try:
+                yield from self._stream_pattern_chunks()
+            finally:
+                # release the (potentially multi-GB) ids on exhaustion AND
+                # on an abandoned/closed generator — same convention as the
+                # one-frame path; a re-stream simply recomputes chunk-wise
+                self._P_virtual = None
             return
         G = self._ensure_gammas()
         self._run_em(G, compute_ll)

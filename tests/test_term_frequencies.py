@@ -313,3 +313,98 @@ def test_tf_with_case_sql_and_custom_multicolumn():
         bayes_combine([p, want["name"], want["city"]]),
         rtol=1e-9,
     )
+
+
+def test_streaming_tf_matches_one_frame_path():
+    """stream_tf_adjusted_comparisons (two chunked passes over the
+    pattern stream) must reproduce the one-frame
+    get_scored_comparisons -> make_term_frequency_adjustments flow."""
+    from splink_tpu import Splink
+
+    rng = np.random.default_rng(31)
+    surnames = ["smith", "jones", "patel", "lee", "garcia", "chen"]
+    n = 400
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "surname": rng.choice(surnames, n, p=[0.5, 0.2, 0.1, 0.1, 0.05, 0.05]),
+            "city": rng.choice([f"c{k}" for k in range(6)], n),
+            "dob": rng.choice([f"d{k}" for k in range(25)], n),
+        }
+    )
+    df.loc[rng.choice(n, 12, replace=False), "surname"] = None
+    df["age"] = rng.choice([20.0, 30.0, 40.0, 55.0], n)
+    df.loc[rng.choice(n, 9, replace=False), "age"] = np.nan
+
+    def settings(**kw):
+        return {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {"col_name": "surname", "num_levels": 2,
+                 "term_frequency_adjustments": True},
+                {"col_name": "city", "num_levels": 2},
+                {"col_name": "age", "data_type": "numeric", "num_levels": 2,
+                 "comparison": {"kind": "numeric_abs", "thresholds": [0.5]},
+                 "term_frequency_adjustments": True},
+            ],
+            "blocking_rules": ["l.dob = r.dob"],
+            "max_iterations": 4,
+            "retain_matching_columns": True,
+            **kw,
+        }
+
+    key = ["unique_id_l", "unique_id_r"]
+    for kw in (
+        dict(device_pair_generation="on", max_resident_pairs=1024),
+        dict(device_pair_generation="off", max_resident_pairs=1024),
+    ):
+        streamed = pd.concat(
+            list(Splink(settings(**kw), df=df).stream_tf_adjusted_comparisons()),
+            ignore_index=True,
+        ).sort_values(key).reset_index(drop=True)
+
+        lk = Splink(settings(**kw), df=df)
+        frame = lk.make_term_frequency_adjustments(
+            lk.get_scored_comparisons()
+        ).sort_values(key).reset_index(drop=True)
+
+        assert list(streamed.columns) == list(frame.columns)
+        np.testing.assert_array_equal(
+            streamed[key].to_numpy(), frame[key].to_numpy()
+        )
+        np.testing.assert_allclose(
+            streamed["tf_adjusted_match_prob"].to_numpy(),
+            frame["tf_adjusted_match_prob"].to_numpy(),
+            rtol=1e-9,
+        )
+        np.testing.assert_allclose(
+            streamed["surname_adj"].to_numpy(),
+            frame["surname_adj"].to_numpy(),
+            rtol=1e-9,
+        )
+        np.testing.assert_allclose(
+            streamed["age_adj"].to_numpy(),
+            frame["age_adj"].to_numpy(),
+            rtol=1e-9,
+        )
+
+
+def test_streaming_tf_no_tf_columns_falls_back():
+    from splink_tpu import Splink
+
+    df = pd.DataFrame(
+        {"unique_id": [0, 1, 2, 3], "name": ["a", "a", "b", "b"],
+         "dob": ["x", "x", "x", "x"]}
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+        "blocking_rules": ["l.dob = r.dob"],
+        "max_iterations": 1,
+        "device_pair_generation": "on",
+        "max_resident_pairs": 1024,
+    }
+    with pytest.warns(UserWarning, match="No term frequency"):
+        chunks = list(Splink(s, df=df).stream_tf_adjusted_comparisons())
+    assert sum(len(c) for c in chunks) == 6
+    assert "tf_adjusted_match_prob" not in pd.concat(chunks).columns
