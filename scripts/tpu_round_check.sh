@@ -36,4 +36,7 @@ python benchmarks/regime_bench.py --rows 60000 || exit 1
 echo "== 7 derived-key blocking example on chip =="
 python examples/derived_key_blocking.py || exit 1
 
+echo "== 8 streaming TF adjustment on chip =="
+python examples/streaming_tf_adjustment.py --rows 100000 || exit 1
+
 echo "ALL GREEN"
