@@ -408,3 +408,62 @@ def test_streaming_tf_no_tf_columns_falls_back():
         chunks = list(Splink(s, df=df).stream_tf_adjusted_comparisons())
     assert sum(len(c) for c in chunks) == 6
     assert "tf_adjusted_match_prob" not in pd.concat(chunks).columns
+
+
+def test_streaming_tf_link_only_and_mesh():
+    """Streaming TF over a link_only virtual plan (rectangle units) and
+    under an 8-virtual-device mesh must both match the one-frame flow."""
+    from splink_tpu import Splink
+
+    rng = np.random.default_rng(41)
+    surnames = ["smith", "jones", "patel", "lee"]
+    def frame(n, base):
+        return pd.DataFrame(
+            {
+                "unique_id": np.arange(base, base + n),
+                "surname": rng.choice(surnames, n, p=[0.5, 0.25, 0.15, 0.1]),
+                "dob": rng.choice([f"d{k}" for k in range(12)], n),
+            }
+        )
+    df_l, df_r = frame(150, 0), frame(170, 1000)
+
+    def settings(**kw):
+        return {
+            "link_type": "link_only",
+            "comparison_columns": [
+                {"col_name": "surname", "num_levels": 2,
+                 "term_frequency_adjustments": True},
+            ],
+            "blocking_rules": ["l.dob = r.dob"],
+            "max_iterations": 3,
+            "retain_matching_columns": True,
+            "max_resident_pairs": 1024,
+            **kw,
+        }
+
+    key = ["unique_id_l", "unique_id_r"]
+    for kw in (
+        dict(device_pair_generation="on"),
+        dict(device_pair_generation="on", mesh={"data": 8},
+             virtual_materialise_ids="off"),  # recompute branch, sharded
+    ):
+        streamed = pd.concat(
+            list(
+                Splink(settings(**kw), df_l=df_l, df_r=df_r)
+                .stream_tf_adjusted_comparisons()
+            ),
+            ignore_index=True,
+        ).sort_values(key).reset_index(drop=True)
+        lk = Splink(settings(**kw), df_l=df_l, df_r=df_r)
+        one = lk.make_term_frequency_adjustments(
+            lk.get_scored_comparisons()
+        ).sort_values(key).reset_index(drop=True)
+        assert len(streamed) and len(streamed) == len(one)
+        np.testing.assert_array_equal(
+            streamed[key].to_numpy(), one[key].to_numpy()
+        )
+        np.testing.assert_allclose(
+            streamed["tf_adjusted_match_prob"].to_numpy(),
+            one["tf_adjusted_match_prob"].to_numpy(),
+            rtol=1e-9,
+        )
