@@ -177,6 +177,63 @@ def test_multi_lane_mask_width_over_32_windows():
     np.testing.assert_array_equal(plain, fast)
 
 
+def test_jaccard_and_cosine_share_one_aux_field():
+    """Both kinds flagged on the same (column, q): ONE aux field packs the
+    union of their components and both fast paths engage."""
+    from splink_tpu.data import encode_table
+    from splink_tpu.gammas import (
+        GammaProgram,
+        _qgram_key,
+        qgram_specs_for,
+    )
+    from splink_tpu.settings import complete_settings_dict
+
+    rng = np.random.default_rng(19)
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(80),
+            "surname": rng.choice(
+                np.array(["banana", "bandana", "panama", None], object), 80
+            ),
+        }
+    )
+    settings = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {"col_name": "surname", "num_levels": 2,
+                 "comparison": {"kind": "qgram_jaccard", "thresholds": [0.5]}},
+                {"custom_name": "surname_cos",
+                 "custom_columns_used": ["surname"], "num_levels": 2,
+                 "comparison": {"kind": "qgram_cosine", "column": "surname",
+                                "thresholds": [0.5]}},
+            ],
+            "blocking_rules": [],
+        }
+    )
+    assert qgram_specs_for(settings) == (("surname", 2, True, True),)
+    table = encode_table(df, settings)
+    prog = GammaProgram(settings, table)
+    f = prog._layout[_qgram_key("surname", 2)]
+    assert f.mask is not None and f.count_lane is not None
+    assert f.sq_lane is not None  # cosine's component rides the same field
+
+    il = jnp.asarray(rng.integers(0, 80, 200, dtype=np.int32))
+    ir = jnp.asarray(rng.integers(0, 80, 200, dtype=np.int32))
+    G = np.asarray(prog._gamma_batch(il, ir))
+    sc = table.strings["surname"]
+    s, ln = jnp.asarray(sc.bytes_), jnp.asarray(sc.lengths)
+    sim_j = np.asarray(qgram.qgram_jaccard(s[il], s[ir], ln[il], ln[ir], 2))
+    sim_c = 1.0 - np.asarray(
+        qgram.qgram_cosine_distance(s[il], s[ir], ln[il], ln[ir], 2)
+    )
+    null = (sc.token_ids[np.asarray(il)] < 0) | (sc.token_ids[np.asarray(ir)] < 0)
+    for col, sim in ((0, sim_j), (1, sim_c)):
+        expect = (sim > 0.5).astype(np.int8)
+        expect[null] = -1
+        np.testing.assert_array_equal(G[:, col], expect)
+
+
 def test_wide_unicode_column_masked_path():
     strings = ["αβγαβ", "βγαβγ", "ααα", None, "αβ", "日本語語語"]
     rng = np.random.default_rng(3)
