@@ -1,0 +1,97 @@
+"""encode_string_column edge cases, pinned against the rewrite that
+derives lengths/width/ascii-ness/factorisation from one str() pass
+(the per-value genexprs were the dominant encode cost at 10M rows)."""
+
+import numpy as np
+import pytest
+
+from splink_tpu.data import encode_string_column
+
+
+def test_ascii_basic_and_truncation():
+    col = encode_string_column(
+        np.array(["abcdefghij", "x", "", None], object), width=8
+    )
+    assert col.bytes_.dtype == np.uint8
+    assert col.width == 8  # observed max 10 capped by the budget 8
+    assert list(col.lengths) == [8, 1, 0, 0]  # truncated to width
+    assert bytes(col.bytes_[0, :8]) == b"abcdefgh"
+    assert col.token_ids[3] == -1  # null
+    assert col.token_ids[2] >= 0  # empty string is a real token
+    # truncation must NOT merge distinct full values' token ids
+    col2 = encode_string_column(
+        np.array(["abcdefghij", "abcdefghiX"], object), width=8
+    )
+    assert col2.token_ids[0] != col2.token_ids[1]
+
+
+def test_width_rounds_up_to_8_and_shrinks_to_observed():
+    col = encode_string_column(np.array(["abc", "de"], object), width=24)
+    assert col.width == 8  # max len 3 -> padded to 8, not the 24 budget
+
+
+def test_all_null_column():
+    col = encode_string_column(np.array([None, None], object), width=24)
+    assert col.width == 8
+    assert list(col.token_ids) == [-1, -1]
+    assert list(col.lengths) == [0, 0]
+    assert col.null_mask.all()
+
+
+def test_wide_unicode_detection_and_lengths():
+    col = encode_string_column(np.array(["αβγ", "ab", None], object), width=8)
+    assert col.bytes_.dtype == np.uint32  # one non-ascii value -> wide
+    assert list(col.lengths) == [3, 2, 0]
+    assert col.bytes_[0, 0] == ord("α")
+    assert col.bytes_[1, 1] == ord("b")
+
+
+def test_non_string_values_stringified():
+    col = encode_string_column(np.array([123, 45.5, None], object), width=8)
+    assert bytes(col.bytes_[0, :3]) == b"123"
+    assert col.lengths[1] == len(str(45.5))
+    assert col.token_ids[2] == -1
+
+
+def test_mixed_type_values_keep_distinct_str_tokens():
+    """123 vs \"123\" vs 123.0 hash-equal under raw factorisation but have
+    distinct str() forms — token ids, chars and values must distinguish
+    them exactly as the stringify-per-row semantics always did."""
+    col = encode_string_column(
+        np.array([123, "123", None, 123.0, "abc"], object), width=8
+    )
+    assert col.token_ids[0] == col.token_ids[1]  # "123" == "123"
+    assert col.token_ids[3] != col.token_ids[0]  # "123.0" != "123"
+    assert bytes(col.bytes_[3, :5]) == b"123.0"
+    assert col.lengths[3] == 5
+    assert col.values[0] == 123 and col.values[1] == "123"
+    assert col.values[3] == 123.0
+    col2 = encode_string_column(np.array([0.0, True, 1, 1.0], object), width=8)
+    # str(): "0.0", "True", "1", "1.0" — all distinct tokens
+    assert len(set(col2.token_ids.tolist())) == 4
+
+
+def test_unhashable_values_stringify():
+    col = encode_string_column(
+        np.array([["a", "b"], ["c"], None], dtype=object), width=16
+    )
+    assert col.token_ids[2] == -1
+    assert col.token_ids[0] != col.token_ids[1]
+    assert bytes(col.bytes_[1, : col.lengths[1]]) == b"['c']"
+
+
+def test_arrow_string_dtype_fast_path():
+    import pandas as pd
+
+    ser = pd.Series(["ann", "bob", None, "ann"], dtype="string")
+    col = encode_string_column(ser, width=8)
+    assert col.token_ids[0] == col.token_ids[3]
+    assert col.token_ids[2] == -1
+    assert col.values[0] == "ann" and col.values[2] is None
+    assert list(col.lengths) == [3, 3, 0, 3]
+
+
+def test_empty_input():
+    col = encode_string_column(np.array([], object), width=24)
+    assert col.bytes_.shape[0] == 0
+    assert col.n_tokens == 0
