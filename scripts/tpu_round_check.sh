@@ -1,42 +1,52 @@
 #!/usr/bin/env bash
 # One-pass hardware validation: run this when the TPU tunnel is up to
-# collect every number the round needs. Prints a summary; does not edit
-# any tracked file — copy results into BENCHMARKS.md / README by hand.
+# collect every number the round needs. Ordered so the MOST important
+# captures land first — tunnel windows have died mid-sweep (rounds 2-4);
+# each step tees to scripts/logs/ so partial sweeps still leave evidence.
+# Does not edit any tracked file — copy results into BENCHMARKS.md by hand.
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
+mkdir -p scripts/logs
+log() { tee "scripts/logs/$1.txt"; }
 
-echo "== 1/4 tpu smoke tier (tests_tpu/) =="
-python -m pytest tests_tpu/ -q || exit 1
+echo "== 1 tpu smoke tier (tests_tpu/) =="
+python -m pytest tests_tpu/ -q 2>&1 | log smoke || exit 1
 
-echo "== 2/4 headline bench (bench.py) =="
-python bench.py || exit 1
+echo "== 2 headline bench (bench.py) =="
+python bench.py 2>&1 | log bench || exit 1
 
-echo "== 2b kernel-only bench (proper per-rep sync) =="
-python benchmarks/kernel_bench.py || exit 1
+echo "== 3 config 4 at scale 0.25 (guaranteed capture) =="
+python benchmarks/run.py --config 4 --scale 0.25 2>&1 | log config4_s025 || exit 1
 
-echo "== 3/4 BASELINE configs 1-3 =="
+echo "== 4 config 4 FULL scale (10M rows; ~how the <60s target reads on one chip) =="
+python benchmarks/run.py --config 4 2>&1 | log config4_full || exit 1
+
+echo "== 5 config 5 at scale 0.25 =="
+python benchmarks/run.py --config 5 --scale 0.25 2>&1 | log config5_s025 || exit 1
+
+echo "== 6 configs 1-3 =="
 for c in 1 2 3; do
   echo "-- config $c"
-  python benchmarks/run.py --config "$c" || exit 1
+  python benchmarks/run.py --config "$c" 2>&1 | log "config$c" || exit 1
 done
 
-echo "== 4/5 BASELINE configs 4-5 (large; streamed regime) =="
-for c in 4 5; do
-  echo "-- config $c"
-  python benchmarks/run.py --config "$c" || exit 1
-done
+echo "== 7 kernel-only bench (proper per-rep sync) =="
+python benchmarks/kernel_bench.py 2>&1 | log kernel_bench || exit 1
 
-echo "== 5/5 device-native example (virtual pair index on chip) =="
-python examples/large_scale_dedupe.py --rows 500000 || exit 1
+echo "== 8 device-native example (virtual pair index on chip) =="
+python examples/large_scale_dedupe.py --rows 500000 2>&1 | log example_large || exit 1
 
-echo "== 6 regime comparison (pattern vs streamed-stats EM) =="
-python benchmarks/regime_bench.py --rows 60000 || exit 1
+echo "== 9 regime comparison (pattern vs streamed-stats EM) =="
+python benchmarks/regime_bench.py --rows 60000 2>&1 | log regime || exit 1
 
-echo "== 7 derived-key blocking example on chip =="
-python examples/derived_key_blocking.py || exit 1
+echo "== 10 derived-key blocking example on chip =="
+python examples/derived_key_blocking.py 2>&1 | log example_derived || exit 1
 
-echo "== 8 streaming TF adjustment on chip =="
-python examples/streaming_tf_adjustment.py --rows 100000 || exit 1
+echo "== 11 streaming TF adjustment on chip =="
+python examples/streaming_tf_adjustment.py --rows 100000 2>&1 | log example_tf || exit 1
+
+echo "== 12 config 5 FULL scale (longest; last) =="
+python benchmarks/run.py --config 5 2>&1 | log config5_full || exit 1
 
 echo "ALL GREEN"
