@@ -174,6 +174,21 @@ def config_4(scale):
     linker.release_input()
     del df
 
+    if os.environ.get("SPLINK_TPU_BENCH_TRAIN_ONLY"):
+        # the BASELINE north-star #2 measurement exactly: EM convergence
+        # on the dedupe, no per-pair output (estimate_parameters is the
+        # histogram-only pass under device pair generation)
+        params = linker.estimate_parameters()
+        elapsed = time.perf_counter() - t0
+        return {
+            "rows": n_rows,
+            "seconds": round(elapsed, 3),
+            "train_only": True,
+            "em_iterations": len(params.param_history),
+            "converged": bool(params.is_converged()),
+            "lambda": round(params.params["λ"], 5),
+        }
+
     t1 = time.perf_counter()
     if linker._virtual_plan() is not None:
         # device pair generation: "blocking" is just the unit-plan build —

@@ -19,7 +19,10 @@ python bench.py 2>&1 | log bench || exit 1
 echo "== 3 config 4 at scale 0.25 (guaranteed capture) =="
 python benchmarks/run.py --config 4 --scale 0.25 2>&1 | log config4_s025 || exit 1
 
-echo "== 4 config 4 FULL scale (10M rows; ~how the <60s target reads on one chip) =="
+echo "== 4 config 4 FULL scale TRAIN-ONLY (the <60s BASELINE target, one chip) =="
+SPLINK_TPU_BENCH_TRAIN_ONLY=1 python benchmarks/run.py --config 4 2>&1 | log config4_train_only || exit 1
+
+echo "== 4b config 4 FULL scale end-to-end (train + score stream) =="
 python benchmarks/run.py --config 4 2>&1 | log config4_full || exit 1
 
 echo "== 5 config 5 at scale 0.25 =="

@@ -850,6 +850,24 @@ class Splink:
         self._G_dev = None  # release the HBM copy once scoring is done
         return df_e
 
+    def estimate_parameters(self, compute_ll: bool = False) -> Params:
+        """Train ONLY: run blocking/gammas/EM and return the fitted
+        Params, producing no per-pair output. An extension beyond the
+        reference (whose EM runs inside get_scored_comparisons,
+        /root/reference/splink/__init__.py:121-145) for jobs where only
+        the model is wanted: under device pair generation the whole run
+        is the histogram-only pattern pass — zero per-pair bytes cross
+        the host<->device link and nothing per-pair lands in host RAM.
+        Score later (or in another process via save/load) with
+        manually_apply_fellegi_sunter_weights or the streaming APIs."""
+        if self._use_pattern_pipeline():
+            self._run_em_patterns(compute_ll)
+        else:
+            G = self._ensure_gammas()
+            self._run_em(G, compute_ll)
+            self._G_dev = None
+        return self.params
+
     def get_scored_comparisons(self, compute_ll: bool = False):
         """Estimate parameters by EM and return scored comparisons
         (/root/reference/splink/__init__.py:121-145).

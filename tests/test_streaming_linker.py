@@ -354,3 +354,55 @@ def test_link_only_spill_release_combination(tmp_path):
     np.testing.assert_allclose(
         m.match_probability_a, m.match_probability_b, rtol=1e-9
     )
+
+
+def test_estimate_parameters_train_only():
+    """estimate_parameters: EM with no per-pair output; the fitted params
+    equal get_scored_comparisons' and scoring afterwards matches."""
+    import numpy as np
+    import pandas as pd
+
+    from splink_tpu import Splink
+
+    rng = np.random.default_rng(47)
+    n = 300
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["ann", "bob", "cat", "dan", None], n),
+            "dob": rng.choice([f"d{k}" for k in range(15)], n),
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "name", "num_levels": 2}],
+        "blocking_rules": ["l.dob = r.dob"],
+        "max_iterations": 6,
+        "device_pair_generation": "on",
+        "max_resident_pairs": 1024,
+    }
+    trained = Splink(dict(s), df=df)
+    params = trained.estimate_parameters()
+    assert trained._P_virtual is None  # histogram-only: no per-pair state
+    scored = pd.concat(
+        list(trained.stream_scored_comparisons_after_em()), ignore_index=True
+    )
+
+    ref = Splink(dict(s), df=df)
+    df_e = ref.get_scored_comparisons()
+    assert abs(params.params["λ"] - ref.params.params["λ"]) < 1e-12
+    assert len(params.param_history) == len(ref.params.param_history)
+    key = ["unique_id_l", "unique_id_r"]
+    a = scored.sort_values(key).reset_index(drop=True)
+    b = df_e.sort_values(key).reset_index(drop=True)
+    np.testing.assert_array_equal(
+        a["match_probability"].to_numpy(), b["match_probability"].to_numpy()
+    )
+
+    # resident regime too
+    s2 = {**s, "device_pair_generation": "off", "max_resident_pairs": 1 << 28}
+    t2 = Splink(dict(s2), df=df)
+    p2 = t2.estimate_parameters()
+    r2 = Splink(dict(s2), df=df)
+    r2.get_scored_comparisons()
+    assert abs(p2.params["λ"] - r2.params.params["λ"]) < 1e-12
