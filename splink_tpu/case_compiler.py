@@ -588,6 +588,59 @@ class _Lit:
         self.value = value
 
 
+def precompute_aux_requirements(expr: str):
+    """(charset_cols, cosine_specs) the packed table should carry for this
+    CASE expression: base columns appearing as plain column references
+    (optionally tokeniser-wrapped) in jaccard_sim calls, and (column, q)
+    pairs likewise for cosine_distance. Parsed statically at settings/
+    program-build time so pack_table can add the aux lanes the evaluator's
+    fast paths consume."""
+    ast = parse_sql_expression(expr)
+    charset: set[str] = set()
+    cosine: set[tuple[str, int]] = set()
+
+    def unwrap(arg):
+        if isinstance(arg, tuple) and arg[0] == "func":
+            m = _TOKENISER_Q.match(arg[1])
+            if m and len(arg[2]) == 1:
+                return arg[2][0], int(m.group(1) or 2)
+        return arg, None
+
+    def walk(node):
+        if isinstance(node, (list,)):
+            for x in node:
+                walk(x)
+            return
+        if not isinstance(node, tuple):
+            return
+        if node and node[0] == "func" and len(node) >= 3:
+            name, args = node[1], node[2]
+            if name in ("jaccard_sim", "cosine_distance"):
+                # register only when EVERY argument is a plain column:
+                # the evaluator fast path needs aux for both sides, so
+                # lanes packed for a mixed call would be dead weight on
+                # every row gather
+                q = 2
+                plain = []
+                for a in args:
+                    u, qq = unwrap(a)
+                    if qq:
+                        q = qq
+                    if isinstance(u, tuple) and u and u[0] == "col":
+                        plain.append(u[1])
+                if len(plain) == len(args) == 2:
+                    if name == "jaccard_sim":
+                        charset.update(plain)
+                    else:
+                        for c in plain:
+                            cosine.add((c, q))
+        for x in node:
+            walk(x)
+
+    walk(ast)
+    return charset, cosine
+
+
 def compile_case_expression(expr: str, num_levels: int):
     """-> fn(ctx) evaluating ``expr`` to an int8 gamma array.
 
@@ -979,7 +1032,9 @@ class _Evaluator:
 
     def _qgram_args(self, args, fname):
         """jaccard_sim(x, y) | jaccard_sim(QNgramTokeniser(x), ...) ->
-        (a, b, q); q is None when no tokeniser wrapped the arguments."""
+        (a, b, q, nodes); q is None when no tokeniser wrapped the
+        arguments; nodes are the unwrapped AST nodes (the fast paths below
+        inspect them for plain column references)."""
         q = None
         unwrapped = []
         for arg in args:
@@ -995,7 +1050,19 @@ class _Evaluator:
                     continue
             unwrapped.append(arg)
         a, b = self._two_strings(unwrapped, fname)
-        return a, b, q
+        return a, b, q, unwrapped
+
+    def _plain_col_aux(self, node, lookup):
+        """For a plain ("col", base, side) node, that side's packed aux
+        from ``lookup(base)`` (a PairContext accessor returning per-side
+        tuples), or None when the node is not a plain column or the table
+        was packed without the aux lanes."""
+        if not (isinstance(node, tuple) and node[0] == "col"):
+            return None
+        aux = lookup(node[1])
+        if aux is None:
+            return None
+        return aux[0] if node[2] == "l" else aux[1]
 
     def _fn_jaccard_sim(self, args):
         """Jar-exact JaccardSimilarity: character-set Jaccard rounded
@@ -1007,8 +1074,22 @@ class _Evaluator:
         'qgram_jaccard'."""
         from .ops import qgram as qgram_ops
 
-        a, b, q = self._qgram_args(args, "jaccard_sim")
+        a, b, q, nodes = self._qgram_args(args, "jaccard_sim")
         ca, cb = self._str_align(a, b)
+        lookup = getattr(self.ctx, "charset_aux", None)
+        if lookup is not None:
+            aux_a = self._plain_col_aux(nodes[0], lookup)
+            aux_b = self._plain_col_aux(nodes[1], lookup)
+            if aux_a is not None and aux_b is not None:
+                # per-row mask/count/space precomputed at pack time: only
+                # the cross character matrix runs per pair (bit-identical;
+                # tests/test_case_charset_masked.py)
+                m_a, da_a, sp_a = aux_a
+                _, da_b, sp_b = aux_b
+                sim = qgram_ops.charset_jaccard_masked(
+                    ca, cb, a.length, b.length, m_a, da_a, sp_a, da_b, sp_b, q
+                )
+                return _Num(sim, a.null | b.null)
         sim = qgram_ops.charset_jaccard(ca, cb, a.length, b.length, q)
         return _Num(sim, a.null | b.null)
 
@@ -1021,9 +1102,25 @@ class _Evaluator:
         float precision (pinned in tests/test_jar_similarity.py)."""
         from .ops import qgram as qgram_ops
 
-        a, b, q = self._qgram_args(args, "cosine_distance")
+        a, b, q, nodes = self._qgram_args(args, "cosine_distance")
         ca, cb = self._str_align(a, b)
-        d = qgram_ops.qgram_cosine_distance(ca, cb, a.length, b.length, q or 2)
+        q = q or 2
+        lookup = getattr(self.ctx, "qgram_aux", None)
+        if lookup is not None:
+            qlookup = lambda base: lookup(base, q)  # noqa: E731
+            aux_a = self._plain_col_aux(nodes[0], qlookup)
+            aux_b = self._plain_col_aux(nodes[1], qlookup)
+            if (
+                aux_a is not None
+                and aux_b is not None
+                and aux_a[2] is not None
+                and aux_b[2] is not None
+            ):
+                d = qgram_ops.qgram_cosine_masked(
+                    ca, cb, a.length, b.length, aux_a[2], aux_b[2], q
+                )
+                return _Num(d, a.null | b.null)
+        d = qgram_ops.qgram_cosine_distance(ca, cb, a.length, b.length, q)
         return _Num(d, a.null | b.null)
 
     def _fn_dmetaphone(self, args):

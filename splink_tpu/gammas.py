@@ -146,6 +146,24 @@ def _qgram_key(name: str, q: int) -> str:
     return f"\x00qgram:{name}:{q}"
 
 
+def _charset_key(name: str) -> str:
+    return f"\x00charset:{name}"
+
+
+class _CharsetField:
+    """Lane layout of one column's precomputed charset auxiliaries
+    (qgram_ops.charset_row_aux) for the CASE compiler's jaccard_sim fast
+    path: first-occurrence-and-non-space bitmask, non-space distinct
+    count, has-space flag."""
+
+    __slots__ = ("mask", "count_lane", "space_lane")
+
+    def __init__(self, mask, count_lane, space_lane):
+        self.mask = mask
+        self.count_lane = count_lane
+        self.space_lane = space_lane
+
+
 def pattern_ids_fit_uint16(n_patterns: int) -> bool:
     """True when every pattern id AND the mask sentinel (== n_patterns)
     fit uint16 — the single predicate deciding both the device-side
@@ -173,8 +191,10 @@ def qgram_specs_for(settings: dict) -> tuple[tuple[str, int, bool, bool], ...]:
     per-row q-gram aux lanes to pack: one per native qgram_jaccard/
     qgram_cosine comparison, packing only the components its kind reads
     (row gathers are the measured bottleneck — unused lanes widen every
-    gather). CASE-compiled expressions keep the self-contained kernels —
-    their argument may be an arbitrary expression, not a packed column."""
+    gather). CASE cosine_distance calls whose arguments are ALL plain
+    column references register their sumsq lanes too (the compiler's fast
+    path); any other CASE argument shape keeps the self-contained
+    kernels."""
     flags: dict[tuple[str, int], list[bool]] = {}
     for c in settings["comparison_columns"]:
         spec = c.get("comparison") or {}
@@ -185,7 +205,33 @@ def qgram_specs_for(settings: dict) -> tuple[tuple[str, int, bool, bool], ...]:
                 f = flags.setdefault((name, int(spec.get("q", 2))), [False, False])
                 f[0] |= kind == "qgram_jaccard"
                 f[1] |= kind == "qgram_cosine"
+        elif kind == "case_sql":
+            # CASE cosine_distance on plain columns reuses the qgram
+            # sumsq lanes (jaccard_sim needs charset aux instead,
+            # charset_specs_for)
+            from .case_compiler import precompute_aux_requirements
+
+            _, cos = precompute_aux_requirements(spec["expr"])
+            for name, q in cos:
+                f = flags.setdefault((name, q), [False, False])
+                f[1] = True
     return tuple((n, q, f[0], f[1]) for (n, q), f in flags.items())
+
+
+def charset_specs_for(settings: dict) -> tuple[str, ...]:
+    """Columns whose per-row charset aux (distinct-char mask/count/space)
+    should ride in the packed table: plain column references in CASE
+    jaccard_sim calls (the CASE compiler's fast path)."""
+    cols: dict[str, None] = {}
+    for c in settings["comparison_columns"]:
+        spec = c.get("comparison") or {}
+        if spec.get("kind") == "case_sql":
+            from .case_compiler import precompute_aux_requirements
+
+            charset, _ = precompute_aux_requirements(spec["expr"])
+            for name in sorted(charset):
+                cols.setdefault(name)
+    return tuple(cols)
 
 
 def comparison_columns_used(settings: dict) -> set[str] | None:
@@ -213,7 +259,11 @@ def comparison_columns_used(settings: dict) -> set[str] | None:
 
 
 def pack_table(
-    table: EncodedTable, float_dtype=jnp.float32, include=None, qgram_specs=()
+    table: EncodedTable,
+    float_dtype=jnp.float32,
+    include=None,
+    qgram_specs=(),
+    charset_specs=(),
 ):
     """Pack encoded columns into one (n_rows, n_lanes) uint32 matrix.
 
@@ -273,6 +323,19 @@ def pack_table(
         count_lane = add(count.view(np.uint32)).start if want_jac else None
         sq_lane = add(sumsq.view(np.uint32)).start if want_cos else None
         layout[_qgram_key(qname, q)] = _QgramField(mslice, count_lane, sq_lane)
+
+    for cname in charset_specs:
+        sc = table.strings.get(cname)
+        if sc is None or (include is not None and cname not in include):
+            continue
+        mask, count, space = qgram_ops.charset_row_aux(
+            sc.bytes_, sc.lengths, sc.token_ids
+        )
+        layout[_charset_key(cname)] = _CharsetField(
+            add(mask),
+            add(count.view(np.uint32)).start,
+            add(space.view(np.uint32)).start,
+        )
 
     f64 = float_dtype == jnp.float64
     num_names = [
@@ -361,6 +424,22 @@ class PairContext:
                 else None
             )
             return mask, count, sumsq
+
+        return side(self._rows_l), side(self._rows_r)
+
+    def charset_aux(self, name: str):
+        """Per-side precomputed charset aux (mask, count, space flag), or
+        None when the packed table does not carry it for this column."""
+        f = self._layout.get(_charset_key(name))
+        if f is None:
+            return None
+
+        def side(rows):
+            return (
+                rows[:, f.mask],
+                jax.lax.bitcast_convert_type(rows[:, f.count_lane], jnp.int32),
+                jax.lax.bitcast_convert_type(rows[:, f.space_lane], jnp.int32),
+            )
 
         return side(self._rows_l), side(self._rows_r)
 
@@ -542,6 +621,7 @@ class GammaProgram:
             float_dtype,
             include=comparison_columns_used(settings),
             qgram_specs=qgram_specs_for(settings),
+            charset_specs=charset_specs_for(settings),
         )
         self._packed = jnp.asarray(packed)
         self._layout = layout

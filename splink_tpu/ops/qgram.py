@@ -127,6 +127,46 @@ qgram_cosine_distance = jax.vmap(
 # ---------------------------------------------------------------------------
 
 
+def _per_unique_aux(bytes_, lengths, token_ids, n_bits, kernel, scalar_dtypes):
+    """Shared scaffolding for per-row aux computed ONCE PER UNIQUE token:
+    dedup rows by token id, run ``kernel(B, L) -> (bits, *scalars)`` over
+    chunks of unique representatives (bits: (v, n_bits) bool), pack bits
+    into uint32 lanes, and scatter results back to all rows. Null rows
+    (token -1) get all-zero aux."""
+    import numpy as np
+
+    n = bytes_.shape[0]
+    n_lanes = (n_bits + 31) // 32
+    mask = np.zeros((n, n_lanes), np.uint32)
+    scalars = [np.zeros(n, dt) for dt in scalar_dtypes]
+    valid_rows = token_ids >= 0
+    if not valid_rows.any():
+        return (mask, *scalars)
+    toks = token_ids[valid_rows]
+    uniq, first_idx = np.unique(toks, return_index=True)
+    reps = np.flatnonzero(valid_rows)[first_idx]  # one row per unique value
+    V = len(reps)
+    umask = np.zeros((V, n_lanes), np.uint32)
+    uscal = [np.zeros(V, dt) for dt in scalar_dtypes]
+    chunk = max(1, 32_000_000 // max(n_bits * n_bits, 1))
+    for s in range(0, V, chunk):
+        r = reps[s : s + chunk]
+        bits, *vals = kernel(bytes_[r], lengths[r])
+        for j in range(n_lanes):
+            bs = bits[:, j * 32 : (j + 1) * 32]
+            shifts = np.arange(bs.shape[1], dtype=np.uint32)
+            umask[s : s + chunk, j] = (
+                bs.astype(np.uint32) << shifts[None, :]
+            ).sum(axis=1, dtype=np.uint32)
+        for k, v in enumerate(vals):
+            uscal[k][s : s + chunk] = v
+    pos = np.searchsorted(uniq, toks)
+    mask[valid_rows] = umask[pos]
+    for k in range(len(scalars)):
+        scalars[k][valid_rows] = uscal[k][pos]
+    return (mask, *scalars)
+
+
 def qgram_row_aux(bytes_, lengths, token_ids, q: int):
     """Host-side per-row q-gram auxiliaries for the masked device kernels.
 
@@ -140,55 +180,28 @@ def qgram_row_aux(bytes_, lengths, token_ids, q: int):
       * sumsq     — (n,) float32 squared L2 norm of the gram count vector
                     (Σ_g cnt(g)^2, cosine's per-side term)
 
-    Work is done once per unique token id (rows sharing a value share the
-    result); null rows (token -1) get all-zero aux, matching a length-0
-    string on the device path.
+    Computed once per unique token id (_per_unique_aux).
     """
     import numpy as np
 
-    n, w = bytes_.shape
+    w = bytes_.shape[1]
     nw = max(w - q + 1, 1)
-    n_lanes = (nw + 31) // 32
-    mask = np.zeros((n, n_lanes), np.uint32)
-    count = np.zeros(n, np.int32)
-    sumsq = np.zeros(n, np.float32)
-    valid_rows = token_ids >= 0
-    if not valid_rows.any():
-        return mask, count, sumsq
-    toks = token_ids[valid_rows]
-    uniq, first_idx = np.unique(toks, return_index=True)
-    reps = np.flatnonzero(valid_rows)[first_idx]  # one row per unique value
-    V = len(reps)
     t_idx = np.arange(nw)
     earlier = t_idx[None, :] < t_idx[:, None]  # [t, t'] iff t' before t
-    umask = np.zeros((V, n_lanes), np.uint32)
-    ucount = np.zeros(V, np.int32)
-    usumsq = np.zeros(V, np.float32)
-    chunk = max(1, 32_000_000 // (nw * nw))
-    for s in range(0, V, chunk):
-        r = reps[s : s + chunk]
-        B = bytes_[r]
-        L = lengths[r].astype(np.int64)
-        v = t_idx[None, :] < np.maximum(L - q + 1, 0)[:, None]  # (v, nw)
-        eq = np.ones((len(r), nw, nw), bool)
+
+    def kernel(B, L):
+        v = t_idx[None, :] < np.maximum(L.astype(np.int64) - q + 1, 0)[:, None]
+        eq = np.ones((len(B), nw, nw), bool)
         for k in range(q):
             col = B[:, np.minimum(t_idx + k, w - 1)]
             eq &= col[:, :, None] == col[:, None, :]
         eq &= v[:, :, None] & v[:, None, :]
         first = v & ~(eq & earlier[None]).any(axis=2)
-        ucount[s : s + chunk] = first.sum(axis=1)
-        usumsq[s : s + chunk] = eq.sum(axis=(1, 2))
-        for j in range(n_lanes):
-            bits = first[:, j * 32 : (j + 1) * 32]
-            shifts = np.arange(bits.shape[1], dtype=np.uint32)
-            umask[s : s + chunk, j] = (
-                bits.astype(np.uint32) << shifts[None, :]
-            ).sum(axis=1, dtype=np.uint32)
-    pos = np.searchsorted(uniq, toks)
-    mask[valid_rows] = umask[pos]
-    count[valid_rows] = ucount[pos]
-    sumsq[valid_rows] = usumsq[pos]
-    return mask, count, sumsq
+        return first, first.sum(axis=1), eq.sum(axis=(1, 2))
+
+    return _per_unique_aux(
+        bytes_, lengths, token_ids, nw, kernel, (np.int32, np.float32)
+    )
 
 
 def _cross_eq(s1, s2, l1, l2, q: int):
@@ -293,6 +306,71 @@ def charset_jaccard_single(s1, s2, l1, l2, q: int | None = None):
 
 
 charset_jaccard = jax.vmap(charset_jaccard_single, in_axes=(0, 0, 0, 0, None))
+
+
+def charset_row_aux(bytes_, lengths, token_ids):
+    """Host-side per-row auxiliaries for charset_jaccard_masked: the
+    first-occurrence-AND-non-space character bitmask, the non-space
+    distinct-char count, and a has-space flag — charset_jaccard_single's
+    per-side quantities, computed once per unique token value
+    (_per_unique_aux). The tokeniser q adjustment (space |= length > q)
+    stays per-pair: it needs only lengths, so ONE aux per column serves
+    every q."""
+    import numpy as np
+
+    w = bytes_.shape[1]
+    t_idx = np.arange(w)
+    earlier = t_idx[None, :] < t_idx[:, None]
+    sp_code = ord(" ")
+
+    def kernel(B, L):
+        v = t_idx[None, :] < L.astype(np.int64)[:, None]
+        eq = (B[:, :, None] == B[:, None, :]) & v[:, :, None] & v[:, None, :]
+        first = v & ~(eq & earlier[None]).any(axis=2)
+        fns = first & (B != sp_code)
+        return fns, fns.sum(axis=1), ((B == sp_code) & v).any(axis=1)
+
+    return _per_unique_aux(
+        bytes_, lengths, token_ids, w, kernel, (np.int32, np.int32)
+    )
+
+
+def charset_jaccard_masked_single(
+    s1, s2, l1, l2, m1, da1, sp1, da2, sp2, q: int | None = None
+):
+    """charset_jaccard_single with the per-side distinct-char mask/count/
+    space flag precomputed (charset_row_aux): only the cross character
+    matrix runs per pair. Bit-identical results. s1/s2 may be padded wider
+    than the widths the masks were built at — bits beyond the mask are
+    absent and those positions are invalid anyway."""
+    L1 = s1.shape[0]
+    idx = jnp.arange(L1)
+    lane = jnp.minimum(idx // 32, m1.shape[0] - 1)
+    fns = (
+        (((m1[lane] >> (idx % 32).astype(jnp.uint32)) & 1) == 1)
+        & (idx < m1.shape[0] * 32)
+    )
+    vb = jnp.arange(s2.shape[0]) < l2
+    present_in_b = ((s1[:, None] == s2[None, :]) & vb[None, :]).any(axis=1)
+    inter_ns = jnp.sum(fns & present_in_b)
+    space_a = sp1 > 0
+    space_b = sp2 > 0
+    if q is not None:
+        space_a = space_a | (l1 > q)
+        space_b = space_b | (l2 > q)
+    inter = inter_ns + (space_a & space_b)
+    union = jnp.maximum(
+        da1 + da2 + space_a.astype(da1.dtype) + space_b.astype(da1.dtype) - inter,
+        1,
+    )
+    num = (200 * inter + union).astype(jnp.float32)
+    rounded = jnp.floor(num / (2 * union).astype(jnp.float32)) / 100.0
+    return jnp.where((l1 == 0) | (l2 == 0), 0.0, rounded).astype(jnp.float32)
+
+
+charset_jaccard_masked = jax.vmap(
+    charset_jaccard_masked_single, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, None)
+)
 
 
 def qgram_tokenise(value: str, q: int) -> list[str]:

@@ -1,0 +1,195 @@
+"""CASE-compiler fast paths for jaccard_sim / cosine_distance on plain
+column references: pack-time aux discovery (precompute_aux_requirements),
+the charset_row_aux host precompute, and bit-identity of the masked
+kernels with the self-contained ones through a full GammaProgram."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from splink_tpu.case_compiler import precompute_aux_requirements
+from splink_tpu.data import encode_string_column, encode_table
+from splink_tpu.gammas import (
+    GammaProgram,
+    _charset_key,
+    _qgram_key,
+    charset_specs_for,
+    qgram_specs_for,
+)
+from splink_tpu.ops import qgram
+from splink_tpu.settings import complete_settings_dict
+
+CASE_JACCARD = """
+CASE
+WHEN surname_l IS NULL OR surname_r IS NULL THEN -1
+WHEN jaccard_sim(surname_l, surname_r) > 0.79 THEN 2
+WHEN jaccard_sim(Q3gramTokeniser(surname_l), Q3gramTokeniser(surname_r)) > 0.4 THEN 1
+ELSE 0
+END as gamma_surname
+"""
+
+CASE_COSINE = """
+CASE
+WHEN surname_l IS NULL OR surname_r IS NULL THEN -1
+WHEN cosine_distance(surname_l, surname_r) < 0.3 THEN 1
+ELSE 0
+END as gamma_surname
+"""
+
+
+def test_precompute_aux_requirements_parses_plain_columns():
+    charset, cosine = precompute_aux_requirements(CASE_JACCARD)
+    assert charset == {"surname"}
+    assert cosine == set()
+    charset, cosine = precompute_aux_requirements(CASE_COSINE)
+    assert charset == set()
+    assert cosine == {("surname", 2)}
+    # a mixed call (derived expression on one side) must NOT register:
+    # the fast path needs aux for BOTH sides, so the lanes would be dead
+    # weight widening every row gather
+    charset, _ = precompute_aux_requirements(
+        "CASE WHEN jaccard_sim(substr(surname_l, 1, 3), surname_r) > 0.5 "
+        "THEN 1 ELSE 0 END"
+    )
+    assert charset == set()
+
+
+def test_charset_row_aux_matches_python_derivation():
+    strings = ["banana boat", "  ", "a b a", None, "", "xyz"]
+    col = encode_string_column(np.array(strings, object), width=16)
+    mask, count, space = qgram.charset_row_aux(
+        col.bytes_, col.lengths, col.token_ids
+    )
+    for i, s in enumerate(strings):
+        if s is None:
+            assert count[i] == 0 and space[i] == 0 and not mask[i].any()
+            continue
+        distinct_ns = []
+        bits = []
+        for t, ch in enumerate(s[: col.width]):
+            first = ch not in s[:t]
+            bits.append(first and ch != " ")
+            if first and ch != " ":
+                distinct_ns.append(ch)
+        assert count[i] == len(distinct_ns)
+        assert space[i] == int(" " in s[: col.width])
+        got = [(int(mask[i, t // 32]) >> (t % 32)) & 1 for t in range(len(bits))]
+        assert got == [int(b) for b in bits]
+
+
+@pytest.mark.parametrize("q", [None, 2, 4])
+def test_masked_charset_kernel_bit_matches_plain(q):
+    rng = np.random.default_rng(23)
+    pool = ["bob smith", "bobsmith", "  lead", "a", "", None, "ab ba",
+            "aaaa  bbbb", "the quick brown fox"]
+    pool += ["".join(rng.choice(list("abc "), rng.integers(1, 14)))
+             for _ in range(40)]
+    left = rng.choice(np.array(pool, object), 250)
+    right = rng.choice(np.array(pool, object), 250)
+    ca = encode_string_column(left, width=24)
+    cb = encode_string_column(right, width=24)
+    w = max(ca.bytes_.shape[1], cb.bytes_.shape[1])
+    pa = np.pad(ca.bytes_, ((0, 0), (0, w - ca.bytes_.shape[1])))
+    pb = np.pad(cb.bytes_, ((0, 0), (0, w - cb.bytes_.shape[1])))
+    ma, da, sa = qgram.charset_row_aux(ca.bytes_, ca.lengths, ca.token_ids)
+    _, db, sb = qgram.charset_row_aux(cb.bytes_, cb.lengths, cb.token_ids)
+    plain = np.asarray(
+        qgram.charset_jaccard(
+            jnp.asarray(pa), jnp.asarray(pb),
+            jnp.asarray(ca.lengths), jnp.asarray(cb.lengths), q,
+        )
+    )
+    fast = np.asarray(
+        qgram.charset_jaccard_masked(
+            jnp.asarray(pa), jnp.asarray(pb),
+            jnp.asarray(ca.lengths), jnp.asarray(cb.lengths),
+            jnp.asarray(ma), jnp.asarray(da), jnp.asarray(sa),
+            jnp.asarray(db), jnp.asarray(sb), q,
+        )
+    )
+    np.testing.assert_array_equal(plain, fast)
+
+
+def _program_and_oracle(case_expr):
+    rng = np.random.default_rng(29)
+    vals = ["smith", "smyth", "smith jones", "jones", " ", "", None,
+            "banana", "ananab", "a b c"]
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(150),
+            "surname": rng.choice(np.array(vals, object), 150),
+        }
+    )
+    settings = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {
+                    "custom_name": "surname_case",
+                    "custom_columns_used": ["surname"],
+                    "num_levels": 3,
+                    "case_expression": case_expr,
+                }
+            ],
+            "blocking_rules": [],
+        }
+    )
+    table = encode_table(df, settings)
+    prog = GammaProgram(settings, table)
+    il = rng.integers(0, 150, 400, dtype=np.int32)
+    ir = rng.integers(0, 150, 400, dtype=np.int32)
+    return prog, table, il, ir
+
+
+def test_case_jaccard_fast_path_end_to_end():
+    prog, table, il, ir = _program_and_oracle(CASE_JACCARD)
+    assert _charset_key("surname") in prog._layout  # fast path engaged
+    G = np.asarray(prog._gamma_batch(jnp.asarray(il), jnp.asarray(ir)))
+    sc = table.strings["surname"]
+    s, ln = jnp.asarray(sc.bytes_), jnp.asarray(sc.lengths)
+    sim = np.asarray(qgram.charset_jaccard(s[il], s[ir], ln[il], ln[ir], None))
+    sim3 = np.asarray(qgram.charset_jaccard(s[il], s[ir], ln[il], ln[ir], 3))
+    null = (sc.token_ids[il] < 0) | (sc.token_ids[ir] < 0)
+    expect = np.where(sim > 0.79, 2, np.where(sim3 > 0.4, 1, 0)).astype(np.int8)
+    expect[null] = -1
+    np.testing.assert_array_equal(G[:, 0], expect)
+
+
+def test_case_cosine_fast_path_end_to_end():
+    prog, table, il, ir = _program_and_oracle(CASE_COSINE)
+    assert _qgram_key("surname", 2) in prog._layout
+    G = np.asarray(prog._gamma_batch(jnp.asarray(il), jnp.asarray(ir)))
+    sc = table.strings["surname"]
+    s, ln = jnp.asarray(sc.bytes_), jnp.asarray(sc.lengths)
+    d = np.asarray(qgram.qgram_cosine_distance(s[il], s[ir], ln[il], ln[ir], 2))
+    null = (sc.token_ids[il] < 0) | (sc.token_ids[ir] < 0)
+    expect = np.where(d < 0.3, 1, 0).astype(np.int8)
+    expect[null] = -1
+    np.testing.assert_array_equal(G[:, 0], expect)
+
+
+def test_specs_discovery_from_settings():
+    s = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {
+                    "custom_name": "c1",
+                    "custom_columns_used": ["surname"],
+                    "num_levels": 3,
+                    "case_expression": CASE_JACCARD,
+                },
+                {
+                    "custom_name": "c2",
+                    "custom_columns_used": ["surname"],
+                    "num_levels": 2,
+                    "case_expression": CASE_COSINE,
+                },
+            ],
+            "blocking_rules": [],
+        }
+    )
+    assert charset_specs_for(s) == ("surname",)
+    assert (("surname", 2, False, True)) in qgram_specs_for(s)
