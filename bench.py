@@ -14,6 +14,7 @@ publishes no numbers — BASELINE.md: "None exist").
 """
 
 import json
+import os
 import sys
 import time
 
@@ -25,29 +26,80 @@ TARGET_PAIRS_PER_SEC_PER_CHIP = 50e6 / 8  # north star: 50M/s on a v5e-8
 # inside a C-level call (no Python signal delivery), which reads as a stalled
 # benchmark. Probe device init in a killable subprocess first and fail fast
 # and loud if it never comes up (shared helper, also used by the smoke tier).
+#
+# The tunnel demonstrably comes and goes within a round (BENCHMARKS.md round-4
+# availability timeline), so one long wait is the WRONG shape: probe in short
+# attempts and retry for the whole budget — a 60-second window that opens at
+# minute 7 of a 10-minute budget still yields a number.
 from _device_probe import probe_device_init
+
+PROBE_BUDGET_S = float(os.environ.get("SPLINK_TPU_BENCH_PROBE_BUDGET", "600"))
+# 90s per attempt: `import jax` alone was observed stalling for tens of
+# seconds on a network hiccup even for the CPU backend, so a 60s attempt
+# can kill a probe that was about to succeed.
+PROBE_ATTEMPT_S = float(os.environ.get("SPLINK_TPU_BENCH_PROBE_ATTEMPT", "90"))
 
 
 def _probe_device_init():
-    ok, detail = probe_device_init()
-    if not ok:
+    deadline = time.monotonic() + PROBE_BUDGET_S
+    attempts = 0
+    fast_failures = 0  # consecutive deterministic (non-timeout) failures
+    detail = "no probe attempts ran"
+    while True:
+        remaining = deadline - time.monotonic()
+        if attempts and remaining <= 5:
+            break
+        attempts += 1
+        ok, detail = probe_device_init(
+            timeout_s=min(PROBE_ATTEMPT_S, max(remaining, 10))
+        )
+        if ok:
+            if attempts > 1:
+                print(
+                    f"bench: device up after {attempts} probe attempts",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            return
+        # A probe that FAILED (nonzero rc) rather than timed out is usually
+        # deterministic (broken install, bad env) — retrying it for the
+        # whole budget wastes the capture window. Three in a row ends it;
+        # fewer could still be a flapping tunnel connection.
+        if "failed (rc=" in detail:
+            fast_failures += 1
+            if fast_failures >= 3:
+                break
+        else:
+            fast_failures = 0
         print(
-            json.dumps(
-                {
-                    "metric": "scored_record_pairs_per_sec_per_chip",
-                    "value": 0,
-                    "unit": "pairs/sec",
-                    "vs_baseline": 0.0,
-                    "error": detail,
-                }
-            ),
+            f"bench: probe attempt {attempts} failed ({detail}); "
+            f"{max(remaining, 0):.0f}s of budget left",
+            file=sys.stderr,
             flush=True,
         )
-        sys.exit(2)
+        time.sleep(min(15, max(deadline - time.monotonic(), 0)))
+    print(
+        json.dumps(
+            {
+                "metric": "scored_record_pairs_per_sec_per_chip",
+                "value": 0,
+                "unit": "pairs/sec",
+                "vs_baseline": 0.0,
+                "error": detail,
+                "probe_attempts": attempts,
+                "probe_budget_seconds": PROBE_BUDGET_S,
+            }
+        ),
+        flush=True,
+    )
+    sys.exit(2)
 
-N_ROWS = 1_000_000
-N_PAIRS = 8 * (1 << 20)  # ~8.4M pairs
-BATCH = 1 << 20
+N_ROWS = int(os.environ.get("SPLINK_TPU_BENCH_ROWS", 1_000_000))
+N_PAIRS = int(os.environ.get("SPLINK_TPU_BENCH_PAIRS", 8 * (1 << 20)))  # ~8.4M
+BATCH = min(1 << 20, N_PAIRS)
+# whole batches only: the batch loop, the throughput division and the
+# warmup-tail reservation all assume BATCH | N_PAIRS
+N_PAIRS = max(BATCH, (N_PAIRS // BATCH) * BATCH)
 
 SETTINGS = {
     "link_type": "dedupe_only",
@@ -201,6 +253,22 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    # Persistent XLA compile cache, same default dir as the linker
+    # (settings_jsonschema.json compilation_cache_dir): a pre-warmed cache
+    # turns the ~20-40s-per-program cold compile into a reload, so a short
+    # tunnel window is enough for a full capture. bench.py never builds a
+    # Splink facade, so it must opt in itself. Accelerator backends only —
+    # the same CPU-AOT caveat as linker._enable_compilation_cache.
+    from splink_tpu.linker import _enable_compilation_cache
+
+    # no-op on the CPU backend (the helper gates that itself)
+    _enable_compilation_cache(
+        os.environ.get(
+            "SPLINK_TPU_BENCH_CACHE_DIR",
+            os.path.expanduser("~/.cache/splink_tpu/xla"),
+        )
+    )
+
     from splink_tpu.data import encode_table
     from splink_tpu.em import run_em
     from splink_tpu.gammas import GammaProgram
@@ -260,15 +328,38 @@ def main():
     float(s0)
     float(psum_fn(*([s0] * len(batches))))
 
+    # First measured batch alone, value-fetch barrier: a headline lands
+    # within seconds of compile finishing. The driver records the stdout
+    # TAIL, so if the tunnel dies mid-run this partial line is still the
+    # recorded result; the full-run line below overwrites it on success.
     t0 = time.perf_counter()
-    Gs = []
-    psums = []
-    for bl, br in batches:
+    G1, p1, s1 = score_batch(*batches[0], params)
+    float(s1)
+    first_batch_time = time.perf_counter() - t0
+    first_rate = BATCH / first_batch_time
+    print(
+        json.dumps(
+            {
+                "metric": "scored_record_pairs_per_sec_per_chip",
+                "value": round(first_rate),
+                "unit": "pairs/sec",
+                "vs_baseline": round(first_rate / TARGET_PAIRS_PER_SEC_PER_CHIP, 3),
+                "partial": "first measured batch only",
+                "n_pairs": BATCH,
+            }
+        ),
+        flush=True,
+    )
+
+    t0 = time.perf_counter()
+    Gs = [G1]
+    psums = [s1]
+    for bl, br in batches[1:]:
         G, p, s = score_batch(bl, br, params)
         Gs.append(G)
         psums.append(s)
     float(psum_fn(*psums))
-    score_time = time.perf_counter() - t0
+    score_time = first_batch_time + (time.perf_counter() - t0)
     pairs_per_sec = N_PAIRS / score_time
 
     # EM convergence on the full gamma matrix (kept in HBM)

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Tunnel availability probe loop: logs one line per probe so the round
+# leaves an availability timeline regardless of when the driver captures.
+LOG=/root/repo/benchmarks/logs_r5_probe.txt
+while true; do
+  TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  OUT=$(timeout 120 python -c "
+from _device_probe import probe_device_init
+ok, detail = probe_device_init(timeout_s=90)
+print('UP' if ok else 'DOWN', detail)
+" 2>&1 | tail -1)
+  echo "$TS $OUT" >> "$LOG"
+  sleep 240
+done

@@ -110,6 +110,93 @@ def jaro_winkler_single(
     return jnp.where(jaro < boost_threshold, jaro, boosted)
 
 
+def jaro_winkler_bitmask_single(
+    s1, s2, l1, l2, prefix_scale: float = 0.1, boost_threshold: float = 0.7
+):
+    """Jaro-Winkler via packed uint32 position bitmasks — bit-identical to
+    :func:`jaro_winkler_single` (same greedy first-eligible assignment, same
+    jar semantics) but with the sequential matching pass reduced to ~4 SCALAR
+    word ops per step instead of (L,) vector ops, and the two order-preserving
+    compaction matmuls replaced by one fused (L, L) boolean reduction.
+
+    Requires L <= 32 (candidate sets fit one uint32 word). The dispatcher
+    falls back to the vector formulation for wider columns.
+
+    Structure:
+      * eligibility masks: E[i] = bitmask over j of (b[j] == a[i] and j in
+        the Jaro window of i) — built once as a fused (L, L) compare + pow2
+        reduction;
+      * greedy pass: ``first = avail & (~avail + 1)`` extracts the lowest
+        eligible j (== the first-true cumsum trick, cheaper by L);
+      * transpositions: matched pair (i, j) aligns rank1[i] with rank2[j];
+        mismatches are counted with one (L, L) masked reduction instead of
+        materialising both compacted sequences.
+    """
+    L = s1.shape[0]
+    idx = jnp.arange(L)
+    l1 = l1.astype(jnp.int32)
+    l2 = l2.astype(jnp.int32)
+    swap = l1 > l2
+    a = jnp.where(swap, s2, s1)
+    b = jnp.where(swap, s1, s2)
+    la = jnp.minimum(l1, l2)
+    lb = jnp.maximum(l1, l2)
+    window = jnp.maximum(lb // 2 - 1, 0)
+
+    eq = a[:, None] == b[None, :]  # (L, L)
+    valid_b = idx < lb
+    pow2 = (jnp.uint32(1) << idx.astype(jnp.uint32))[None, :]
+    E = jnp.sum(
+        jnp.where(eq & valid_b[None, :], pow2, jnp.uint32(0)),
+        axis=1,
+        dtype=jnp.uint32,
+    )
+
+    def upto(k):  # bits [0, k) set; k in [0, 32]
+        k = k.astype(jnp.uint32)
+        return jnp.where(
+            k >= 32,
+            jnp.uint32(0xFFFFFFFF),
+            (jnp.uint32(1) << k) - jnp.uint32(1),
+        )
+
+    win_mask = upto(idx + window + 1) & ~upto(jnp.maximum(idx - window, 0))
+    masks = jnp.where(idx < la, E & win_mask, jnp.uint32(0))
+
+    def step(used, mask_i):
+        avail = mask_i & ~used
+        first = avail & (~avail + jnp.uint32(1))  # lowest set bit
+        return used | first, first
+
+    used, firsts = lax.scan(step, jnp.uint32(0), masks)
+    matched_a = firsts != 0
+    m = jnp.sum(matched_a).astype(jnp.int32)
+
+    used_j = ((used >> idx.astype(jnp.uint32)) & 1).astype(jnp.int32)
+    rank1 = jnp.cumsum(matched_a.astype(jnp.int32)) - 1
+    rank2 = jnp.cumsum(used_j) - 1
+    aligned = (
+        (rank1[:, None] == rank2[None, :])
+        & matched_a[:, None]
+        & (used_j[None, :] == 1)
+    )
+    mismatched = jnp.sum(aligned & ~eq).astype(jnp.int32)
+
+    mf = _f(m)
+    t = _f(mismatched // 2)  # Java integer division
+    jaro = jnp.where(
+        m > 0,
+        (mf / _f(l1) + mf / _f(l2) + (mf - t) / mf) / 3.0,
+        0.0,
+    )
+
+    prefix_run = jnp.cumprod(((s1 == s2) & (idx < la)).astype(jnp.int32))
+    ell = jnp.sum(prefix_run).astype(jnp.float32)  # NOT capped (jar)
+    scale = jnp.minimum(prefix_scale, 1.0 / jnp.maximum(_f(lb), 1.0))
+    boosted = jaro + ell * scale * (1.0 - jaro)
+    return jnp.where(jaro < boost_threshold, jaro, boosted)
+
+
 def levenshtein_single(s1, s2, l1, l2):
     """Levenshtein edit distance between two fixed-width byte strings.
 
@@ -152,7 +239,23 @@ def exact_equal_single(s1, s2, l1, l2):
 
 
 # Batched versions: vmap over the leading pair axis.
-jaro_winkler_vmapped = jax.vmap(jaro_winkler_single, in_axes=(0, 0, 0, 0, None, None))
+_jaro_winkler_vector_vmapped = jax.vmap(
+    jaro_winkler_single, in_axes=(0, 0, 0, 0, None, None)
+)
+_jaro_winkler_bitmask_vmapped = jax.vmap(
+    jaro_winkler_bitmask_single, in_axes=(0, 0, 0, 0, None, None)
+)
+
+
+def jaro_winkler_vmapped(s1, s2, l1, l2, prefix_scale=0.1, boost_threshold=0.7):
+    """Batched JW: packed-bitmask formulation when the width fits one uint32
+    (all practical columns; benchmarks/kernel_bench.py measures the gap),
+    vector formulation beyond."""
+    if s1.shape[1] <= 32:
+        return _jaro_winkler_bitmask_vmapped(
+            s1, s2, l1, l2, prefix_scale, boost_threshold
+        )
+    return _jaro_winkler_vector_vmapped(s1, s2, l1, l2, prefix_scale, boost_threshold)
 levenshtein_vmapped = jax.vmap(levenshtein_single)
 levenshtein_ratio_vmapped = jax.vmap(levenshtein_ratio_single)
 exact_equal = jax.vmap(exact_equal_single)
