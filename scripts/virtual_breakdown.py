@@ -105,7 +105,7 @@ def main():
     def k_nodl(pc_slice, u0, valid, acc):
         i, j = decode(pc_slice, u0)
         masked = (pos >= valid) | (uid[i] == uid[j])
-        G = gamma_fn(packed, i, j).astype(jnp.int32)
+        G = gamma_fn(packed, i, j)[0].astype(jnp.int32)
         pid = jnp.sum((G + 1) * strides[None, :], axis=1)
         pid = jnp.where(masked, n_patterns, pid)
         return pid, acc + jnp.bincount(pid, length=n_patterns + 1)
@@ -114,7 +114,7 @@ def main():
     def k_nobin(pc_slice, u0, valid):
         i, j = decode(pc_slice, u0)
         masked = (pos >= valid) | (uid[i] == uid[j])
-        G = gamma_fn(packed, i, j).astype(jnp.int32)
+        G = gamma_fn(packed, i, j)[0].astype(jnp.int32)
         pid = jnp.sum((G + 1) * strides[None, :], axis=1)
         return jnp.where(masked, n_patterns, pid)
 

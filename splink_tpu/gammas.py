@@ -150,6 +150,23 @@ def _charset_key(name: str) -> str:
     return f"\x00charset:{name}"
 
 
+def _jw_key(name: str) -> str:
+    return f"\x00jwbound:{name}"
+
+
+class _JwBoundField:
+    """Lane layout of one column's Jaro-Winkler bound auxiliaries
+    (jw_bound.jw_bound_row_aux): 4 lanes of 32x 4-bit hashed-class counts
+    + 1 prefix/overflow lane. Packed only for columns the two-phase JW
+    path covers."""
+
+    __slots__ = ("counts", "pref_lane")
+
+    def __init__(self, counts, pref_lane):
+        self.counts = counts  # lane slice, 4 uint32 lanes
+        self.pref_lane = pref_lane
+
+
 class _CharsetField:
     """Lane layout of one column's precomputed charset auxiliaries
     (qgram_ops.charset_row_aux) for the CASE compiler's jaccard_sim fast
@@ -234,6 +251,21 @@ def charset_specs_for(settings: dict) -> tuple[str, ...]:
     return tuple(cols)
 
 
+def jw_specs_for(settings: dict) -> tuple[str, ...]:
+    """Columns whose JW-bound aux lanes should ride in the packed table:
+    every thresholded jaro_winkler comparison's input column. (Empty
+    thresholds mean every pair lands in level 0 — nothing to prune;
+    name_inversion's cross-column sims keep the exact kernel.)"""
+    cols: dict[str, None] = {}
+    for c in settings["comparison_columns"]:
+        spec = c.get("comparison") or {}
+        if spec.get("kind") == "jaro_winkler" and spec.get("thresholds"):
+            name = _comparison_input_column(c)
+            if name:
+                cols.setdefault(name)
+    return tuple(cols)
+
+
 def comparison_columns_used(settings: dict) -> set[str] | None:
     """Encoded-column names the gamma program reads, or None for 'all'
     (a registered custom comparison may touch any column)."""
@@ -264,6 +296,7 @@ def pack_table(
     include=None,
     qgram_specs=(),
     charset_specs=(),
+    jw_specs=(),
 ):
     """Pack encoded columns into one (n_rows, n_lanes) uint32 matrix.
 
@@ -337,6 +370,17 @@ def pack_table(
             add(space.view(np.uint32)).start,
         )
 
+    for jname in jw_specs:
+        sc = table.strings.get(jname)
+        if sc is None or (include is not None and jname not in include):
+            continue
+        from .ops import jw_bound
+
+        cnt, pref = jw_bound.jw_bound_row_aux(
+            sc.bytes_, sc.lengths, sc.token_ids
+        )
+        layout[_jw_key(jname)] = _JwBoundField(add(cnt), add(pref).start)
+
     f64 = float_dtype == jnp.float64
     num_names = [
         c for c in table.numerics if include is None or c in include
@@ -371,11 +415,38 @@ class PairContext:
     gathers happen after construction.
     """
 
-    def __init__(self, layout: dict, rows_l, rows_r, reverse_bytes: bool):
+    def __init__(
+        self,
+        layout: dict,
+        rows_l,
+        rows_r,
+        reverse_bytes: bool,
+        two_phase_div: int | None = None,
+    ):
         self._layout = layout
         self._rows_l = rows_l
         self._rows_r = rows_r
         self._reverse = reverse_bytes
+        # Two-phase JW: survivor capacity = batch // two_phase_div (None =
+        # exact kernels everywhere). Each two-phase column records a
+        # did-its-survivors-overflow flag here; the kernel returns their
+        # sum so the driver can redo the batch with the exact twin.
+        self.two_phase_div = two_phase_div
+        self.overflow: list = []
+
+    def survivor_capacity(self, b: int) -> int:
+        return min(b, max(1024, b // self.two_phase_div))
+
+    def record_overflow(self, flag) -> None:
+        self.overflow.append(flag)
+
+    def overflow_count(self):
+        if not self.overflow:
+            return jnp.int32(0)
+        total = self.overflow[0].astype(jnp.int32)
+        for f in self.overflow[1:]:
+            total = total + f.astype(jnp.int32)
+        return total
 
     def _string_side(self, f: _StringField, rows):
         lanes = rows[:, f.chars]
@@ -427,6 +498,18 @@ class PairContext:
 
         return side(self._rows_l), side(self._rows_r)
 
+    def jw_aux(self, name: str):
+        """Per-side JW-bound aux ((counts, prefix) each side), or None when
+        the packed table does not carry it for this column."""
+        f = self._layout.get(_jw_key(name))
+        if f is None:
+            return None
+
+        def side(rows):
+            return rows[:, f.counts], rows[:, f.pref_lane]
+
+        return side(self._rows_l), side(self._rows_r)
+
     def charset_aux(self, name: str):
         """Per-side precomputed charset aux (mask, count, space flag), or
         None when the packed table does not carry it for this column."""
@@ -464,6 +547,44 @@ def _pad_chars(chars, width: int):
     if out.shape[1] < width:
         out = jnp.pad(out, ((0, 0), (0, width - out.shape[1])))
     return out
+
+
+def _jw_two_phase(ctx: PairContext, pc: PairColumn, aux, thresholds):
+    """Two-phase Jaro-Winkler gamma: cheap upper bound excludes the bulk of
+    below-lowest-threshold pairs (ops/jw_bound), token-equal pairs take
+    their level from sim == 1.0 without any kernel, and the exact O(L^2)
+    kernel runs only on the compacted survivors (capacity B //
+    two_phase_div; an overflowing batch is flagged for the exact twin).
+    Bit-identical to the exact branch: excluded pairs provably sit below
+    every threshold, survivors get the same kernel + bucketing
+    (tests/test_jw_two_phase.py property-checks this)."""
+    from .ops import jw_bound
+
+    (cl, pl), (cr, pr) = aux
+    ub = jw_bound.jw_upper_bound(cl, pl, cr, pr, pc.len_l, pc.len_r, 0.1, 0.7)
+    lowest = min(thresholds)
+    # bucket_similarity is strict (sim > t): a token-equal pair's level is
+    # the count of thresholds strictly below 1.0 — static, so computed here
+    equal_level = sum(1 for t in thresholds if 1.0 > t)
+    equal = (pc.tok_l == pc.tok_r) & (pc.len_l > 0)
+    surv = (ub >= lowest - jw_bound.BOUND_MARGIN) & ~equal & ~pc.null
+    b = surv.shape[0]
+    cap = ctx.survivor_capacity(b)
+    pos = jnp.nonzero(surv, size=cap, fill_value=b)[0]
+    ctx.record_overflow(jnp.sum(surv) > cap)
+    posc = jnp.minimum(pos, b - 1)
+    sim = string_ops.jaro_winkler(
+        pc.chars_l[posc], pc.chars_r[posc],
+        pc.len_l[posc], pc.len_r[posc], 0.1, 0.7,
+    )
+    lvl_s = bucket_similarity(sim, thresholds, None)
+    base = jnp.where(
+        equal,
+        jnp.asarray(equal_level, GAMMA_DTYPE),
+        jnp.asarray(0, GAMMA_DTYPE),
+    )
+    lvl = base.at[pos].set(lvl_s, mode="drop")
+    return apply_null(lvl, pc.null)
 
 
 def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
@@ -523,6 +644,9 @@ def _spec_gamma(col_settings: dict, ctx: PairContext) -> jnp.ndarray:
         return apply_null(gamma, pc.null)
 
     if kind == "jaro_winkler":
+        aux = ctx.jw_aux(name) if thresholds else None
+        if aux is not None and ctx.two_phase_div:
+            return _jw_two_phase(ctx, pc, aux, thresholds)
         sim = string_ops.jaro_winkler(
             pc.chars_l, pc.chars_r, pc.len_l, pc.len_r, 0.1, 0.7
         )
@@ -614,6 +738,15 @@ class GammaProgram:
         self.max_levels = max(
             c["num_levels"] for c in settings["comparison_columns"]
         )
+        # Two-phase JW scoring (ops/jw_bound): on unless the settings switch
+        # it off or no column qualifies. The divisor sets the survivor
+        # capacity (batch // div); measured survivor rates on config-4
+        # shapes are 2.9-3.7% so 8 leaves ~3x headroom, with the exact-twin
+        # redo protocol guaranteeing correctness beyond it.
+        self.two_phase_div = None
+        if settings.get("two_phase_jw", "on") != "off" and jw_specs_for(settings):
+            self.two_phase_div = int(settings.get("jw_survivor_divisor", 8))
+
         # Pack the compared columns into one uint32 matrix and push it to
         # device once: each pair batch then costs exactly two row gathers.
         packed, layout = pack_table(
@@ -622,6 +755,7 @@ class GammaProgram:
             include=comparison_columns_used(settings),
             qgram_specs=qgram_specs_for(settings),
             charset_specs=charset_specs_for(settings),
+            jw_specs=jw_specs_for(settings) if self.two_phase_div else (),
         )
         self._packed = jnp.asarray(packed)
         self._layout = layout
@@ -629,23 +763,58 @@ class GammaProgram:
 
         cols = settings["comparison_columns"]
 
+        # ONE body template, instantiated twice: the two-phase body (primary
+        # on a single device) and the exact body (mesh sharding — survivor
+        # compaction does not partition trivially — and the overflow-redo
+        # twin). Both return (G, overflow_count); the property tests pin
+        # them bit-identical on the gamma output.
+        def _make_gamma_body(two_phase_div):
+            def _gamma_body(packed, idx_l, idx_r):
+                rows_l = packed[idx_l]
+                rows_r = packed[idx_r]
+                ctx = PairContext(layout, rows_l, rows_r, reverse, two_phase_div)
+                gammas = [_spec_gamma(c, ctx) for c in cols]
+                return jnp.stack(gammas, axis=1), ctx.overflow_count()
+
+            return _gamma_body
+
+        self._make_gamma_body = _make_gamma_body
+        _gamma_body = _make_gamma_body(self.two_phase_div)
+
         # The packed table is an explicit argument, NOT a closure capture: a
         # captured device array becomes a jaxpr constant, and at millions of
         # rows that constant is serialised into the compile request (observed
         # as HTTP 413 from the tunnelled TPU's remote-compile at ~4M rows).
-        @jax.jit
-        def _gamma_batch_p(packed, idx_l, idx_r):
-            rows_l = packed[idx_l]
-            rows_r = packed[idx_r]
-            ctx = PairContext(layout, rows_l, rows_r, reverse)
-            gammas = [_spec_gamma(c, ctx) for c in cols]
-            return jnp.stack(gammas, axis=1)
+        _gamma_batch_p = jax.jit(_gamma_body)
 
-        self._gamma_batch = lambda il, ir: _gamma_batch_p(self._packed, il, ir)
+        self._gamma_batch = lambda il, ir: _gamma_batch_p(self._packed, il, ir)[0]
         # the pure (packed-explicit) jitted fn, for composition into larger
         # jitted programs (pairgen's virtual pair kernels) without turning
-        # the packed table into a jaxpr constant
+        # the packed table into a jaxpr constant; returns (G, overflow)
         self._gamma_batch_fn = _gamma_batch_p
+
+        # Host-batched G paths read back one array per batch; the overflow
+        # flag rides as one extra G row (int8 flag at [-1, 0]) so detecting
+        # it costs no second device fetch (a scalar read is a full tunnel
+        # round trip).
+        def _flagged(body):
+            def fn(packed, idx_l, idx_r):
+                G, ovf = body(packed, idx_l, idx_r)
+                flag_row = (
+                    jnp.zeros((1, G.shape[1]), G.dtype)
+                    .at[0, 0]
+                    .set((ovf > 0).astype(G.dtype))
+                )
+                return jnp.concatenate([G, flag_row])
+
+            return jax.jit(fn)
+
+        _gamma_flagged_p = _flagged(_gamma_body)
+        self._gamma_batch_flagged = lambda il, ir: _gamma_flagged_p(
+            self._packed, il, ir
+        )
+        self._flagged_factory = _flagged
+        self._gamma_flagged_exact_p = None
 
         # The compiled-artifact analogue of the reference logging its
         # generated SQL at debug level (/root/reference/splink/gammas.py:120).
@@ -665,35 +834,94 @@ class GammaProgram:
             strides_dev = jnp.asarray(strides, jnp.int32)
             n_patterns = self.n_patterns
 
-            # ONE kernel body, jitted twice (plain, and per-mesh with
-            # out_shardings): the documented mesh/single-device bit parity
-            # rests on these being the same computation
-            def _pattern_kernel(packed, idx_l, idx_r, valid, acc):
-                G = _gamma_batch_p(packed, idx_l, idx_r).astype(jnp.int32)
-                pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
-                masked = jnp.where(
-                    jnp.arange(pid.shape[0]) < valid, pid, n_patterns
-                )
-                acc = acc + jnp.bincount(masked, length=n_patterns + 1)
-                if pattern_ids_fit_uint16(n_patterns):
-                    # narrow on device: halves the per-batch D2H (all
-                    # real ids < n_patterns <= 65535; padding-tail pids
-                    # are sliced off host-side before use)
-                    pid = pid.astype(jnp.uint16)
-                return pid, acc
+            # ONE kernel template over a gamma body. The returned pid array
+            # carries one extra trailing element: the batch's overflow flag
+            # (0/1), so the per-batch host read that fetches the ids anyway
+            # also learns whether the two-phase survivor capacity blew. An
+            # overflowed batch contributes NOTHING to the histogram — the
+            # driver redoes it through the exact twin, and int32 addition
+            # commuting makes the late redo bit-identical.
+            def _make_pattern_kernel(gamma_body, append_flag=True):
+                def _pattern_kernel(packed, idx_l, idx_r, valid, acc):
+                    G, ovf = gamma_body(packed, idx_l, idx_r)
+                    G = G.astype(jnp.int32)
+                    pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
+                    masked = jnp.where(
+                        jnp.arange(pid.shape[0]) < valid, pid, n_patterns
+                    )
+                    ovf_flag = (ovf > 0).astype(jnp.int32)
+                    acc = acc + jnp.bincount(
+                        masked, length=n_patterns + 1
+                    ) * (1 - ovf_flag)
+                    if pattern_ids_fit_uint16(n_patterns):
+                        # narrow on device: halves the per-batch D2H (all
+                        # real ids < n_patterns <= 65535; padding-tail pids
+                        # are sliced off host-side before use)
+                        pid = pid.astype(jnp.uint16)
+                    if append_flag:
+                        # overflow flag rides as pid[-1]; mesh kernels skip
+                        # it (a B+1 output cannot shard evenly, and the
+                        # exact body they compose never overflows)
+                        pid = jnp.concatenate(
+                            [pid, ovf_flag.astype(pid.dtype)[None]]
+                        )
+                    return pid, acc
 
-            self._pattern_kernel = _pattern_kernel
-            _pattern_batch = jax.jit(_pattern_kernel)
+                return _pattern_kernel
+
+            self._make_pattern_kernel = _make_pattern_kernel
+            self._pattern_kernel = _make_pattern_kernel(_gamma_body)
+            # overflow-redo twin: exact body, flagged like the primary so
+            # the host read path is uniform; with two-phase off the primary
+            # IS exact and nothing builds twice
+            if self.two_phase_div:
+                self._pattern_kernel_exact = _make_pattern_kernel(
+                    self._exact_gamma_body()
+                )
+            else:
+                self._pattern_kernel_exact = self._pattern_kernel
+            _pattern_batch = jax.jit(self._pattern_kernel)
             self._pattern_batch = lambda il, ir, v, acc: _pattern_batch(
                 self._packed, il, ir, v, acc
             )
+            self._pattern_batch_exact_jit = None
         else:
             # pattern space too large (strides overflow int32 well before the
             # dense histogram would OOM); callers must use the gamma-matrix
             # paths
             self._pattern_batch = None
             self._pattern_kernel = None
+            self._pattern_kernel_exact = None
         self._pattern_batch_mesh_cache: dict = {}
+
+    def _exact_gamma_body(self):
+        """The exact (no two-phase) gamma body — what mesh-sharded kernels
+        compose and what the overflow redo runs. (G, overflow) signature,
+        overflow always 0. One cached instance so every exact consumer
+        shares jit caches keyed on it."""
+        body = getattr(self, "_exact_body_cache", None)
+        if body is None:
+            body = self._exact_body_cache = self._make_gamma_body(None)
+        return body
+
+    def _gamma_batch_flagged_exact(self, il, ir):
+        """Exact-twin flagged batch (for redoing an overflowed G batch)."""
+        if self.two_phase_div is None:
+            return self._gamma_batch_flagged(il, ir)
+        if self._gamma_flagged_exact_p is None:
+            self._gamma_flagged_exact_p = self._flagged_factory(
+                self._exact_gamma_body()
+            )
+        return self._gamma_flagged_exact_p(self._packed, il, ir)
+
+    def _pattern_batch_exact(self, il, ir, valid, acc):
+        """Exact-twin pattern batch (overflow redo). Jitted lazily: it only
+        compiles if a two-phase batch ever overflows."""
+        if self.two_phase_div is None:
+            return self._pattern_batch(il, ir, valid, acc)
+        if self._pattern_batch_exact_jit is None:
+            self._pattern_batch_exact_jit = jax.jit(self._pattern_kernel_exact)
+        return self._pattern_batch_exact_jit(self._packed, il, ir, valid, acc)
 
     def _pattern_batch_for_mesh(self, mesh):
         """Mesh-sharded twin of the pattern-batch kernel (same
@@ -704,7 +932,13 @@ class GammaProgram:
         pairgen.make_virtual_pattern_fn's sharding layout so materialised
         pattern jobs compose with multi-chip EM the same way virtual ones
         do. Cached per Mesh VALUE (Mesh is hashable), so equal meshes from
-        repeated mesh_from_settings calls share one compile."""
+        repeated mesh_from_settings calls share one compile.
+
+        Mesh kernels use the EXACT gamma body: two-phase survivor
+        compaction (jnp.nonzero along the sharded pair axis) would need a
+        cross-device prefix sum, so the pruning stays a single-device
+        optimisation; tests/test_jw_two_phase.py pins the two bodies
+        bit-identical."""
         if mesh not in self._pattern_batch_mesh_cache:
             import functools
 
@@ -713,7 +947,14 @@ class GammaProgram:
             self._pattern_batch_mesh_cache[mesh] = functools.partial(
                 jax.jit,
                 out_shardings=(pair_sharding(mesh), replicated(mesh)),
-            )(self._pattern_kernel)
+            )(
+                self._make_pattern_kernel(
+                    self._exact_gamma_body()
+                    if self.two_phase_div
+                    else self._gamma_batch_fn,
+                    append_flag=False,
+                )
+            )
         return self._pattern_batch_mesh_cache[mesh]
 
     def _mesh_pattern_context(self, mesh):
@@ -791,6 +1032,24 @@ class GammaProgram:
         acc = zero_acc()
         in_acc = 0
         pending = None
+
+        has_flag = mesh is None  # mesh kernels are exact and unflagged
+
+        def read_pending(pending, acc):
+            """Fetch a batch's ids; an overflow flag (pid[-1], two-phase
+            survivor capacity blown) redoes it through the exact twin —
+            the flagged batch skipped the histogram, so the late redo's
+            acc addition commutes into an identical total."""
+            ps, pe, prev, pbl, pbr = pending
+            arr = np.asarray(prev)
+            if has_flag and arr[-1]:
+                pid2, acc = self._pattern_batch_exact(
+                    jnp.asarray(pbl), jnp.asarray(pbr), pe - ps, acc
+                )
+                arr = np.asarray(pid2)
+            pids[ps:pe] = arr[: pe - ps].astype(id_dtype)
+            return acc
+
         for start in range(0, n, batch_size):
             stop = min(start + batch_size, n)
             bl = idx_l[start:stop]
@@ -801,16 +1060,17 @@ class GammaProgram:
                 br = np.concatenate([br, np.zeros(pad, br.dtype)])
             pid, acc = run_batch(bl, br, stop - start, acc)
             if pending is not None:
-                ps, pe, prev = pending
-                pids[ps:pe] = np.asarray(prev)[: pe - ps].astype(id_dtype)
-            pending = (start, stop, pid)
+                acc = read_pending(pending, acc)
+            pending = (start, stop, pid, bl, br)
             in_acc += 1
             if in_acc >= flush_every:
+                acc = read_pending(pending, acc)
+                pending = None
                 total += np.asarray(acc[:-1], np.int64)
                 acc = zero_acc()
                 in_acc = 0
-        ps, pe, prev = pending
-        pids[ps:pe] = np.asarray(prev)[: pe - ps].astype(id_dtype)
+        if pending is not None:
+            acc = read_pending(pending, acc)
         if in_acc:
             total += np.asarray(acc[:-1], np.int64)
         return pids, total
@@ -853,8 +1113,24 @@ class GammaProgram:
         device_batches = []
         # Double-buffered: batch k+1 is dispatched before batch k's result is
         # pulled to the host, so device compute overlaps the D2H transfer
-        # (JAX dispatch is async; np.asarray is the only sync point).
-        pending = None  # (start, stop, device result)
+        # (JAX dispatch is async; np.asarray is the only sync point). The
+        # flagged kernel carries the two-phase overflow flag as an extra G
+        # row ([-1, 0]); a flagged batch is redone through the exact twin at
+        # its read point, before anything consumes it.
+        pending = None  # (start, stop, device result, bl, br)
+
+        def read_pending(pending):
+            ps, pe, pG, pbl, pbr = pending
+            arr = np.asarray(pG)
+            if arr[-1, 0]:
+                pG = self._gamma_batch_flagged_exact(
+                    jnp.asarray(pbl), jnp.asarray(pbr)
+                )
+                arr = np.asarray(pG)
+            out[ps:pe] = arr[: pe - ps]
+            if keep_device:
+                device_batches.append(pG[: pe - ps])
+
         for start in range(0, n, batch_size):
             stop = min(start + batch_size, n)
             bl = idx_l[start:stop]
@@ -863,15 +1139,11 @@ class GammaProgram:
                 pad = batch_size - (stop - start)
                 bl = np.concatenate([bl, np.zeros(pad, bl.dtype)])
                 br = np.concatenate([br, np.zeros(pad, br.dtype)])
-            G = self._gamma_batch(jnp.asarray(bl), jnp.asarray(br))[: stop - start]
-            if keep_device:
-                device_batches.append(G)
+            G = self._gamma_batch_flagged(jnp.asarray(bl), jnp.asarray(br))
             if pending is not None:
-                ps, pe, pG = pending
-                out[ps:pe] = np.asarray(pG)
-            pending = (start, stop, G)
-        ps, pe, pG = pending
-        out[ps:pe] = np.asarray(pG)
+                read_pending(pending)
+            pending = (start, stop, G, bl, br)
+        read_pending(pending)
         dev = None
         if keep_device:
             dev = (
@@ -960,32 +1232,39 @@ class GammaStream(_StreamBatcher):
         super().__init__(batch_size)
         self.program = program
         self.keep_limit = keep_device_limit
-        self._pending: tuple[int, jnp.ndarray] | None = None
+        self._pending = None
         self._out_parts: list[np.ndarray] = []
         self._device_batches: list[jnp.ndarray] | None = (
             [] if keep_device_limit > 0 else None
         )
 
-    def _emit(self, bl, br, valid):
-        G = self.program._gamma_batch(jnp.asarray(bl), jnp.asarray(br))[:valid]
+    def _read_pending(self):
+        v, prev, pbl, pbr = self._pending
+        arr = np.asarray(prev)
+        if arr[-1, 0]:  # two-phase overflow: redo through the exact twin
+            prev = self.program._gamma_batch_flagged_exact(
+                jnp.asarray(pbl), jnp.asarray(pbr)
+            )
+            arr = np.asarray(prev)
+        self._out_parts.append(arr[:v])
         if self._device_batches is not None:
-            if self.total <= self.keep_limit:
-                self._device_batches.append(G)
-            else:
-                self._device_batches = None  # too big: free HBM
+            self._device_batches.append(prev[:v])
+        self._pending = None
+
+    def _emit(self, bl, br, valid):
+        G = self.program._gamma_batch_flagged(jnp.asarray(bl), jnp.asarray(br))
+        if self._device_batches is not None and self.total > self.keep_limit:
+            self._device_batches = None  # too big: free HBM
         # double buffer: read back the PREVIOUS batch (it has finished by
         # the time the next one is dispatched), keeping dispatch async
         if self._pending is not None:
-            v, prev = self._pending
-            self._out_parts.append(np.asarray(prev)[:v])
-        self._pending = (valid, G)
+            self._read_pending()
+        self._pending = (valid, G, bl, br)
 
     def finish(self):
         self._flush_tail()
         if self._pending is not None:
-            v, prev = self._pending
-            self._out_parts.append(np.asarray(prev)[:v])
-            self._pending = None
+            self._read_pending()
         n_cols = self.program.n_cols
         if not self._out_parts:
             host = np.zeros((0, n_cols), np.int8)
@@ -1037,13 +1316,29 @@ class PatternStream(_StreamBatcher):
             else np.int32
         )
         self._parts: list[np.ndarray] = []
-        self._pending: tuple[int, jnp.ndarray] | None = None
+        self._pending = None
         self._acc = self._zero_acc()
+        self._acc_dirty = False
         self._in_acc = 0
         self._flush_every = max(
             min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1
         )
         self._total_counts = np.zeros(program.n_patterns, np.int64)
+
+    def _read_pending(self):
+        v, prev, pbl, pbr = self._pending
+        arr = np.asarray(prev)
+        if self.mesh is None and arr[-1]:
+            # two-phase overflow: the flagged batch skipped the histogram;
+            # redo through the exact twin (any acc generation works — the
+            # int64 total sums every generation, so addition commutes)
+            pid2, self._acc = self.program._pattern_batch_exact(
+                jnp.asarray(pbl), jnp.asarray(pbr), v, self._acc
+            )
+            arr = np.asarray(pid2)
+            self._acc_dirty = True  # a redo may land after the last flush
+        self._parts.append(arr[:v].astype(self.id_dtype))
+        self._pending = None
 
     def _emit(self, bl, br, valid):
         if self.mesh is not None:
@@ -1053,9 +1348,8 @@ class PatternStream(_StreamBatcher):
                 jnp.asarray(bl), jnp.asarray(br), valid, self._acc
             )
         if self._pending is not None:
-            v, prev = self._pending
-            self._parts.append(np.asarray(prev)[:v].astype(self.id_dtype))
-        self._pending = (valid, pid)
+            self._read_pending()
+        self._pending = (valid, pid, bl, br)
         self._in_acc += 1
         if self._in_acc >= self._flush_every:
             self._total_counts += np.asarray(self._acc[:-1], np.int64)
@@ -1065,12 +1359,11 @@ class PatternStream(_StreamBatcher):
     def finish(self):
         self._flush_tail()
         if self._pending is not None:
-            v, prev = self._pending
-            self._parts.append(np.asarray(prev)[:v].astype(self.id_dtype))
-            self._pending = None
-        if self._in_acc:
+            self._read_pending()
+        if self._in_acc or self._acc_dirty:
             self._total_counts += np.asarray(self._acc[:-1], np.int64)
             self._in_acc = 0
+            self._acc_dirty = False
         pids = np.empty(self.total, self.id_dtype)
         parts = self._parts
         self._parts = []

@@ -945,7 +945,7 @@ def decode_positions(plan: VirtualPlan, rule: int, q: np.ndarray,
 
 def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
                             has_uid_mask: bool, own_res=None,
-                            prev_res=(), mesh=None):
+                            prev_res=(), mesh=None, two_phase=True):
     """Jitted (pid, acc) kernel decoding + scoring one batch of virtual
     pair positions. Shapes of the plan arrays vary per rule, so XLA
     compiles one executable per (rule shape, kpad bucket) — a handful per
@@ -965,7 +965,21 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
 
     n_patterns = program.n_patterns
     strides_dev = jnp.asarray(program._pattern_strides, jnp.int32)
-    gamma_fn = program._gamma_batch_fn
+    # Mesh kernels and the overflow-redo twin compose the EXACT gamma body
+    # (two-phase survivor compaction does not partition along a sharded
+    # pair axis); the single-device primary composes the two-phase body.
+    # acc layout: [patterns 0..n_patterns-1, masked sentinel, overflow
+    # count] — an overflowed batch contributes nothing to the histogram
+    # and bumps the overflow slot instead; non-mesh kernels also append
+    # the flag to pid so the ids path can redo per batch.
+    if mesh is not None or not two_phase:
+        gamma_fn = (
+            program._exact_gamma_body()
+            if program.two_phase_div
+            else program._gamma_batch_fn
+        )
+    else:
+        gamma_fn = program._gamma_batch_fn
 
     jit_kwargs = {}
     if mesh is not None:
@@ -1079,22 +1093,31 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
                 holds = holds & v & ~unk
             masked = masked | holds
 
-        G = gamma_fn(packed, i, j).astype(jnp.int32)
+        G, ovf = gamma_fn(packed, i, j)
+        G = G.astype(jnp.int32)
         pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
         pid = jnp.where(masked, n_patterns, pid)
-        acc = acc + jnp.bincount(pid, length=n_patterns + 1)
+        ovf_flag = (ovf > 0).astype(jnp.int32)
+        hist = jnp.bincount(pid, length=n_patterns + 1)
+        acc = acc.at[: n_patterns + 1].add(hist * (1 - ovf_flag))
+        acc = acc.at[n_patterns + 1].add(ovf_flag)
         if pattern_ids_fit_uint16(n_patterns):
             # narrow ON DEVICE: the ids pass is download-bound over a
             # tunnelled link, and every value (sentinel included) fits
             # uint16 — half the D2H bytes of the int32 it was computed in
             pid = pid.astype(jnp.uint16)
+        if mesh is None:
+            # overflow flag rides as pid[-1] (a B+1 output cannot shard
+            # evenly, and mesh kernels are exact anyway)
+            pid = jnp.concatenate([pid, ovf_flag.astype(pid.dtype)[None]])
         return pid, acc
 
     return fn
 
 
 def _virtual_pass_iter(program, plan: VirtualPlan, batch_size: int,
-                       mesh=None, want_ids: bool = True, counts_out=None):
+                       mesh=None, want_ids: bool = True, counts_out=None,
+                       two_phase: bool = True, overflow_out=None):
     """Drive one device pass over the virtual pair stream, yielding
     ``(rule, rule_p0, out_pos, n_valid, pid_host)`` per batch.
     With ``want_ids``, pattern-id downloads run on a small thread pool a
@@ -1160,8 +1183,16 @@ def _virtual_pass_iter(program, plan: VirtualPlan, batch_size: int,
     # per-bucket iota cache: rules sharing a rule_bs bucket share one array
     pos_cache: dict = {}
     flush_every = max(min(_HIST_FLUSH_BATCHES, (1 << 30) // batch_size), 1)
-    acc = put(np.zeros(n_patterns + 1, np.int32))
+    # acc carries [histogram, masked sentinel, two-phase overflow count]
+    acc = put(np.zeros(n_patterns + 2, np.int32))
     in_acc = 0
+    ovf_total = 0
+
+    def flush_acc(acc_dev):
+        nonlocal ovf_total
+        acc_host = np.asarray(acc_dev)
+        counts[:] += acc_host[:n_patterns]
+        ovf_total += int(acc_host[n_patterns + 1])
     pool = ThreadPoolExecutor(max_workers=_D2H_DEPTH) if want_ids else None
     inflight: deque = deque()  # (rule, rule_p0, out_pos, n_valid, future)
     try:
@@ -1203,7 +1234,10 @@ def _virtual_pass_iter(program, plan: VirtualPlan, batch_size: int,
                 pos_cache[rule_bs] = pos_rule
             order_dev = put(rp.order)
             units_dev = tuple(put(a) for a in (rp.ua, rp.la, rp.ub, rp.lb))
-            kkey = (id(program), rule_bs, None if mesh is None else id(mesh))
+            kkey = (
+                id(program), rule_bs,
+                None if mesh is None else id(mesh), two_phase,
+            )
             fn = rp.kernel_cache.get(kkey)
             if fn is None:
                 fn = rp.kernel_cache[kkey] = make_virtual_pattern_fn(
@@ -1211,8 +1245,26 @@ def _virtual_pass_iter(program, plan: VirtualPlan, batch_size: int,
                     has_uid_mask=plan.uid_codes is not None,
                     own_res=rp.residual_fn,
                     prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
-                    mesh=mesh,
+                    mesh=mesh, two_phase=two_phase,
                 )
+
+            def exact_fn(r=r, rp=rp, rule_bs=rule_bs):
+                """The rule's exact-twin kernel for overflow redos, built
+                on first use (it only ever compiles if a batch overflows
+                the two-phase survivor capacity)."""
+                ekey = (id(program), rule_bs, None, False)
+                efn = rp.kernel_cache.get(ekey)
+                if efn is None:
+                    efn = rp.kernel_cache[ekey] = make_virtual_pattern_fn(
+                        program, rule_bs, n_prev=r,
+                        has_uid_mask=plan.uid_codes is not None,
+                        own_res=rp.residual_fn,
+                        prev_res=tuple(
+                            p.residual_fn for p in plan.rules[:r]
+                        ),
+                        mesh=None, two_phase=False,
+                    )
+                return efn
             # One metadata row [u0, valid, pc_rel...] per batch, padded to ONE
             # power-of-two kpad for the whole rule (one kernel specialisation
             # per rule). Uploaded per batch with device_put — uploads are
@@ -1237,33 +1289,60 @@ def _virtual_pass_iter(program, plan: VirtualPlan, batch_size: int,
                 meta[0] = u0
                 meta[1] = p1 - p0
                 meta[2 : u1 - u0 + 4] = np.clip(pc_rel, -(1 << 31) + 1, imax)
+                meta_dev = put(meta)
                 pid, acc = fn(
                     pos_rule, packed, order_dev, *units_dev, codes_dev,
-                    uid_dev, res_ops_dev, put(meta), acc,
+                    uid_dev, res_ops_dev, meta_dev, acc,
                 )
                 if want_ids:
+                    redo_args = (
+                        exact_fn, pos_rule, order_dev, units_dev, meta_dev,
+                    ) if mesh is None else None
                     inflight.append(
-                        (r, p0, out_pos, p1 - p0, pool.submit(np.asarray, pid))
+                        (r, p0, out_pos, p1 - p0,
+                         pool.submit(np.asarray, pid), redo_args)
                     )
                     while len(inflight) > _D2H_DEPTH:
-                        pr, pp0, ps, n_valid, fut = inflight.popleft()
-                        yield pr, pp0, ps, n_valid, fut.result()[:n_valid]
+                        pr, pp0, ps, n_valid, fut, rd = inflight.popleft()
+                        arr = fut.result()
+                        if rd is not None and arr[-1]:
+                            # two-phase overflow: the flagged batch skipped
+                            # the histogram; redo through the exact twin
+                            # (acc addition commutes, late redo identical)
+                            efn, e_pos, e_ord, e_units, e_meta = rd
+                            pid2, acc = efn()(
+                                e_pos, packed, e_ord, *e_units, codes_dev,
+                                uid_dev, res_ops_dev, e_meta, acc,
+                            )
+                            arr = np.asarray(pid2)
+                        yield pr, pp0, ps, n_valid, arr[:n_valid]
                 else:
                     yield r, p0, out_pos, p1 - p0, None
                 out_pos += p1 - p0
                 in_acc += 1
                 if in_acc >= flush_every:
-                    counts += np.asarray(acc[:-1], np.int64)
+                    flush_acc(acc)
                     # reset through put(): a plain jnp.zeros would drop the
                     # replicated sharding under a mesh and force a reshard /
                     # second executable on the next batch
-                    acc = put(np.zeros(n_patterns + 1, np.int32))
+                    acc = put(np.zeros(n_patterns + 2, np.int32))
                     in_acc = 0
         while inflight:
-            pr, pp0, ps, n_valid, fut = inflight.popleft()
-            yield pr, pp0, ps, n_valid, fut.result()[:n_valid]
-        if in_acc:
-            counts += np.asarray(acc[:-1], np.int64)
+            pr, pp0, ps, n_valid, fut, rd = inflight.popleft()
+            arr = fut.result()
+            if rd is not None and arr[-1]:
+                efn, e_pos, e_ord, e_units, e_meta = rd
+                pid2, acc = efn()(
+                    e_pos, packed, e_ord, *e_units, codes_dev,
+                    uid_dev, res_ops_dev, e_meta, acc,
+                )
+                arr = np.asarray(pid2)
+            yield pr, pp0, ps, n_valid, arr[:n_valid]
+        # unconditional: an overflow redo during the tail drain can land
+        # in acc after the last scheduled flush
+        flush_acc(acc)
+        if overflow_out is not None:
+            overflow_out.append(ovf_total)
     finally:
         # consumer may abandon the generator mid-stream (exception in
         # a scoring chunk): do not leak pool threads or pinned buffers
@@ -1298,10 +1377,29 @@ def compute_virtual_pattern_ids(program, plan: VirtualPlan,
     pids = (
         np.empty(plan.n_candidates, id_dtype) if return_ids else None
     )
+    overflow: list = []
     for _, _, ps, n_valid, chunk in _virtual_pass_iter(
         program, plan, batch_size, mesh=mesh, want_ids=return_ids,
-        counts_out=counts,
+        counts_out=counts, overflow_out=overflow,
     ):
         if return_ids:
             pids[ps : ps + n_valid] = chunk.astype(id_dtype)
+    if not return_ids and overflow and overflow[0]:
+        # Histogram-only mode has no per-batch reads, so overflowed
+        # batches (which contributed nothing) are only visible here:
+        # rerun the whole pass through the exact kernels. Rare — the
+        # survivor capacity carries ~3x headroom over measured rates.
+        import logging
+
+        logging.getLogger("splink_tpu").warning(
+            "two-phase JW survivor capacity overflowed in %d batch(es); "
+            "recomputing the histogram pass with exact kernels",
+            overflow[0],
+        )
+        counts[:] = 0
+        for _ in _virtual_pass_iter(
+            program, plan, batch_size, mesh=mesh, want_ids=False,
+            counts_out=counts, two_phase=False,
+        ):
+            pass
     return pids, counts, int(counts.sum())
