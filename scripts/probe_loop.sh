@@ -1,7 +1,14 @@
 #!/bin/bash
 # Tunnel availability probe loop: logs one line per probe so the round
 # leaves an availability timeline regardless of when the driver captures.
-LOG=/root/repo/benchmarks/logs_r5_probe.txt
+#
+# _device_probe lives at the repo root, so resolve the root from this
+# script's own location and run from there — launching the loop from any
+# cwd must log UP/DOWN lines, not ImportError tails.
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT" || exit 1
+export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
+LOG="$REPO_ROOT/benchmarks/logs_r5_probe.txt"
 while true; do
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   OUT=$(timeout 120 python -c "

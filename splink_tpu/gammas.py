@@ -787,7 +787,31 @@ class GammaProgram:
         # as HTTP 413 from the tunnelled TPU's remote-compile at ~4M rows).
         _gamma_batch_p = jax.jit(_gamma_body)
 
-        self._gamma_batch = lambda il, ir: _gamma_batch_p(self._packed, il, ir)[0]
+        # _gamma_batch is the convenience path (bench.py's jitted score
+        # loop, ad-hoc scoring) and it must be IMPOSSIBLE to misuse: when
+        # the two-phase survivor capacity blows, it redoes the batch
+        # through the exact body ON DEVICE (lax.cond — jit-composable, so
+        # no caller can drop the overflow flag the tuple-returning fns
+        # carry). The double-buffered host paths keep using the flagged
+        # variants below, whose host-side redo overlaps transfers.
+        if self.two_phase_div:
+            _exact_body = self._exact_gamma_body()
+
+            def _safe_body(packed, idx_l, idx_r):
+                G, ovf = _gamma_body(packed, idx_l, idx_r)
+                return jax.lax.cond(
+                    ovf > 0,
+                    lambda ops: _exact_body(*ops)[0],
+                    lambda ops: G,
+                    (packed, idx_l, idx_r),
+                )
+
+            _gamma_safe_p = jax.jit(_safe_body)
+        else:
+            _gamma_safe_p = lambda packed, il, ir: _gamma_batch_p(  # noqa: E731
+                packed, il, ir
+            )[0]
+        self._gamma_batch = lambda il, ir: _gamma_safe_p(self._packed, il, ir)
         # the pure (packed-explicit) jitted fn, for composition into larger
         # jitted programs (pairgen's virtual pair kernels) without turning
         # the packed table into a jaxpr constant; returns (G, overflow)

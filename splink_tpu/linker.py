@@ -12,6 +12,7 @@ Spark SQL.
 
 from __future__ import annotations
 
+import copy
 import logging
 import os
 import warnings
@@ -23,7 +24,12 @@ import numpy as np
 from .blocking import PairIndex, block_using_rules
 from .check_types import check_types
 from .data import EncodedTable, concat_tables, encode_table
-from .em import run_em, score_pairs, score_pairs_with_intermediates
+from .em import (
+    run_em,
+    run_em_checkpointed,
+    score_pairs,
+    score_pairs_with_intermediates,
+)
 from .gammas import GammaProgram, register_comparison  # noqa: F401 (re-export)
 from .models.fellegi_sunter import FSParams
 from .params import Params, load_params_from_json
@@ -193,6 +199,10 @@ class Splink:
         self._P_virtual: np.ndarray | None = None
         self._virtual_want_ids = False
         self._pair_bound: int | None = None  # estimate_pair_upper_bound memo
+        # checkpoint/resume state for the current estimate_parameters call
+        # (argument overrides; the settings keys are the fallback)
+        self._ckpt_dir_arg: str | None = None
+        self._ckpt_resume = False
 
     # ------------------------------------------------------------------
 
@@ -277,8 +287,65 @@ class Splink:
         self.df_l = None
         self.df_r = None
 
+    def _checkpoint_config(self):
+        """(checkpoint_dir | None, resume, interval): the argument to
+        estimate_parameters wins, else the settings keys."""
+        ckpt_dir = self._ckpt_dir_arg or self.settings.get("checkpoint_dir") or None
+        return (
+            ckpt_dir,
+            self._ckpt_resume,
+            int(self.settings.get("checkpoint_interval", 5) or 5),
+        )
+
+    def _load_validated_checkpoint(self, ckpt_dir, state_hash, resume):
+        """Resume's load/validate dance, shared by the fused and streamed
+        paths: hash-checked load, cross-process presence agreement, then
+        topology validation. Returns the checkpoint or None. Resume with
+        no checkpoint on disk yet is the normal FIRST launch of a
+        relaunch-loop harness, so it warns and trains fresh rather than
+        raising."""
+        if not resume:
+            return None
+        from .parallel.distributed import (
+            validate_resume_presence,
+            validate_resume_topology,
+        )
+        from .resilience.checkpoint import load_checkpoint
+
+        ckpt = load_checkpoint(ckpt_dir, expect_hash=state_hash)
+        validate_resume_presence(ckpt is not None)
+        if ckpt is None:
+            logger.warning(
+                "resume=True but no checkpoint exists in %s yet; training "
+                "from scratch (first launch of a relaunch loop?)",
+                ckpt_dir,
+            )
+            return None
+        validate_resume_topology(ckpt.process_count, state_hash, ckpt.iteration)
+        return ckpt
+
+    def _em_state_hash(self) -> str:
+        from .resilience.checkpoint import settings_state_hash
+
+        # bind the checkpoint to the input data as well as the settings:
+        # identical settings over a different dataframe must NOT resume
+        # (the histories would describe someone else's trajectory). The
+        # encoded row count is a cheap fingerprint that catches the
+        # common cases (new extract, different table) without hashing
+        # multi-GB column data.
+        table = self._ensure_encoded()
+        return settings_state_hash(
+            self.settings, extra={"n_rows": int(table.n_rows)}
+        )
+
     def _ensure_encoded(self) -> EncodedTable:
         if self._table is None:
+            # last rung of the degradation ladder: a dead accelerator
+            # falls back to CPU (with a structured warning) before any
+            # device work is attempted
+            from .resilience.retry import ensure_devices
+
+            ensure_devices()
             with StageTimer("encode"):
                 if self.settings["link_type"] == "dedupe_only":
                     self._table = encode_table(self.df, self.settings)
@@ -725,49 +792,53 @@ class Splink:
             yield from self.stream_scored_comparisons(compute_ll)
             return
         self._virtual_want_ids = True
-        self._run_em_patterns(compute_ll)
-        table = self._ensure_encoded()
-        cols: dict[str, tuple[np.ndarray, int]] = {}
-        for name in tf_cols:
-            sc = table.strings.get(name)
-            if sc is not None:
-                cols[name] = (sc.token_ids, sc.n_tokens)
-                continue
-            nc = table.numerics.get(name)
-            if nc is not None:
-                # numeric TF column: factorise values on the fly (token =
-                # distinct value, the same grouping the one-frame host
-                # path applies to raw values); null -> -1
-                codes, uniq = pd.factorize(nc.values_f64)
-                codes = codes.astype(np.int32)
-                codes[nc.null_mask] = -1
-                cols[name] = (codes, len(uniq))
-                continue
-            warnings.warn(
-                f"term-frequency column {name!r} is not an encoded "
-                "column; skipped in the streaming TF pass."
-            )
-        PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
-        base_lambda = float(self.params.params["λ"])
-        sums = {n: np.zeros(nt + 1) for n, (_, nt) in cols.items()}
-        counts = {n: np.zeros(nt + 1) for n, (_, nt) in cols.items()}
-        with StageTimer("tf_aggregate_patterns"):
-            for il, ir, Pk in self._iter_pattern_triples():
-                p = p_lut[Pk]
-                for name, (tid, _nt) in cols.items():
-                    tl = tid[il]
-                    agree = (tl == tid[ir]) & (tl >= 0)
-                    np.add.at(sums[name], tl[agree], p[agree])
-                    np.add.at(counts[name], tl[agree], 1.0)
-        adjusted = {}
-        for name in cols:
-            # token lambda -> Bayes-combined with (1 - base lambda), the
-            # same step as compute_token_adjustment
-            lam_t = sums[name] / np.maximum(counts[name], 1.0)
-            adjusted[name] = bayes_combine(
-                [lam_t, np.full(len(lam_t), 1.0 - base_lambda)]
-            )
+        # the try spans EVERYTHING from EM (which materialises the
+        # potentially multi-GB per-candidate ids) onward: an exception in
+        # the aggregation pass or a consumer abandoning/closing the
+        # generator anywhere must not leak the ids
         try:
+            self._run_em_patterns(compute_ll)
+            table = self._ensure_encoded()
+            cols: dict[str, tuple[np.ndarray, int]] = {}
+            for name in tf_cols:
+                sc = table.strings.get(name)
+                if sc is not None:
+                    cols[name] = (sc.token_ids, sc.n_tokens)
+                    continue
+                nc = table.numerics.get(name)
+                if nc is not None:
+                    # numeric TF column: factorise values on the fly (token =
+                    # distinct value, the same grouping the one-frame host
+                    # path applies to raw values); null -> -1
+                    codes, uniq = pd.factorize(nc.values_f64)
+                    codes = codes.astype(np.int32)
+                    codes[nc.null_mask] = -1
+                    cols[name] = (codes, len(uniq))
+                    continue
+                warnings.warn(
+                    f"term-frequency column {name!r} is not an encoded "
+                    "column; skipped in the streaming TF pass."
+                )
+            PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
+            base_lambda = float(self.params.params["λ"])
+            sums = {n: np.zeros(nt + 1) for n, (_, nt) in cols.items()}
+            counts = {n: np.zeros(nt + 1) for n, (_, nt) in cols.items()}
+            with StageTimer("tf_aggregate_patterns"):
+                for il, ir, Pk in self._iter_pattern_triples():
+                    p = p_lut[Pk]
+                    for name, (tid, _nt) in cols.items():
+                        tl = tid[il]
+                        agree = (tl == tid[ir]) & (tl >= 0)
+                        np.add.at(sums[name], tl[agree], p[agree])
+                        np.add.at(counts[name], tl[agree], 1.0)
+            adjusted = {}
+            for name in cols:
+                # token lambda -> Bayes-combined with (1 - base lambda), the
+                # same step as compute_token_adjustment
+                lam_t = sums[name] / np.maximum(counts[name], 1.0)
+                adjusted[name] = bayes_combine(
+                    [lam_t, np.full(len(lam_t), 1.0 - base_lambda)]
+                )
             with StageTimer("score_tf_patterns"):
                 for il, ir, Pk in self._iter_pattern_triples():
                     df = self._assemble_df_e(
@@ -850,7 +921,13 @@ class Splink:
         self._G_dev = None  # release the HBM copy once scoring is done
         return df_e
 
-    def estimate_parameters(self, compute_ll: bool = False) -> Params:
+    def estimate_parameters(
+        self,
+        compute_ll: bool = False,
+        *,
+        checkpoint_dir: str | os.PathLike | None = None,
+        resume: bool = False,
+    ) -> Params:
         """Train ONLY: run blocking/gammas/EM and return the fitted
         Params, producing no per-pair output. An extension beyond the
         reference (whose EM runs inside get_scored_comparisons,
@@ -859,13 +936,39 @@ class Splink:
         is the histogram-only pattern pass — zero per-pair bytes cross
         the host<->device link and nothing per-pair lands in host RAM.
         Score later (or in another process via save/load) with
-        manually_apply_fellegi_sunter_weights or the streaming APIs."""
-        if self._use_pattern_pipeline():
-            self._run_em_patterns(compute_ll)
-        else:
-            G = self._ensure_gammas()
-            self._run_em(G, compute_ll)
-            self._G_dev = None
+        manually_apply_fellegi_sunter_weights or the streaming APIs.
+
+        Args:
+            compute_ll: archive the log likelihood per iteration.
+            checkpoint_dir: snapshot EM state here every
+                ``checkpoint_interval`` updates (atomic, versioned, bound
+                to a settings hash — docs/resilience.md). Overrides the
+                ``checkpoint_dir`` settings key.
+            resume: continue from the checkpoint in ``checkpoint_dir``
+                instead of training from the settings priors. A checkpoint
+                written for different settings (hash mismatch) is rejected
+                with CheckpointMismatchError; multi-controller runs also
+                validate process-count/checkpoint agreement before
+                continuing.
+        """
+        self._ckpt_dir_arg = os.fspath(checkpoint_dir) if checkpoint_dir else None
+        self._ckpt_resume = bool(resume)
+        if self._ckpt_resume and self._checkpoint_config()[0] is None:
+            self._ckpt_resume = False
+            raise ValueError(
+                "resume=True requires a checkpoint directory: pass "
+                "checkpoint_dir= or set the checkpoint_dir settings key."
+            )
+        try:
+            if self._use_pattern_pipeline():
+                self._run_em_patterns(compute_ll)
+            else:
+                G = self._ensure_gammas()
+                self._run_em(G, compute_ll)
+                self._G_dev = None
+        finally:
+            self._ckpt_dir_arg = None
+            self._ckpt_resume = False
         return self.params
 
     def get_scored_comparisons(self, compute_ll: bool = False):
@@ -897,11 +1000,35 @@ class Splink:
         return df_e
 
     def _run_em(self, G: np.ndarray, compute_ll: bool) -> None:
-        """Dispatch EM to the resident or streamed regime by pair count."""
+        """Dispatch EM to the resident or streamed regime by pair count.
+
+        A device OOM on the resident path (the gamma matrix plus EM
+        workspace outgrew HBM) degrades to the streamed regime — same
+        update math over host-batched uploads — instead of crashing the
+        run (docs/resilience.md degradation ladder)."""
+        from .resilience import active_plan, is_oom
+        from .utils.logging_utils import warn_degraded
+
         if len(G) > int(self.settings["max_resident_pairs"]):
             self._run_em_streamed(G, compute_ll)
-        else:
+            return
+        # the resident attempt may replay completed updates into
+        # self.params (checkpoint boundaries / save_state_fn) before it
+        # OOMs; the fallback must restart from the PRE-attempt state or
+        # those updates would be applied twice
+        params_snapshot = copy.deepcopy(self.params)
+        try:
+            active_plan(self.settings).fire("resident_em", pairs=len(G))
             self._run_em_resident(G, compute_ll)
+        except Exception as e:  # noqa: BLE001 - is_oom() decides
+            if not is_oom(e):
+                raise
+            self.params = params_snapshot
+            warn_degraded(
+                "resident_em", "streamed_em", f"{type(e).__name__}: {e}",
+                pairs=len(G),
+            )
+            self._run_em_streamed(G, compute_ll)
 
     def _run_em_resident(self, G: np.ndarray, compute_ll: bool) -> None:
         """Fused on-device EM with the gamma matrix resident in HBM."""
@@ -931,8 +1058,14 @@ class Splink:
             compute_ll=compute_ll,
         )
 
+        ckpt_dir, resume, interval = self._checkpoint_config()
         with StageTimer("em"):
-            if self.save_state_fn is None:
+            if ckpt_dir is not None:
+                converged = self._run_em_fused_checkpointed(
+                    G_dev, init, max_iterations, em_kwargs, ckpt_dir,
+                    resume, interval, compute_ll,
+                )
+            elif self.save_state_fn is None:
                 result = run_em(
                     G_dev, init, max_iterations=max_iterations, **em_kwargs
                 )
@@ -951,6 +1084,78 @@ class Splink:
                         break
         if converged:
             logger.info("EM algorithm has converged")
+
+    def _run_em_fused_checkpointed(
+        self, G_dev, init, max_iterations, em_kwargs, ckpt_dir, resume,
+        interval, compute_ll,
+    ) -> bool:
+        """Checkpointed resident EM: em.run_em_checkpointed runs the ONE
+        compiled while_loop with an in-loop host hook that writes an
+        atomic checkpoint every ``interval`` updates — bit-identical
+        trajectory, plus durable resume. History replays into the Params
+        object incrementally at each boundary (so save_state_fn sees the
+        same per-update cadence as the stepped driver, at boundary
+        granularity; both run on the callback thread and must stay
+        host-side) and resumed iterations replay from the checkpoint's
+        histories."""
+        from .resilience import active_plan
+
+        state_hash = self._em_state_hash()
+        ckpt = self._load_validated_checkpoint(ckpt_dir, state_hash, resume)
+        if self.save_state_fn is not None:
+            logger.warning(
+                "checkpoint_dir moves save_state_fn onto the compiled "
+                "loop's host-callback thread (called at checkpoint "
+                "boundaries, mid-program): the hook must stay host-side "
+                "work — dispatching jax computation from it can deadlock "
+                "the running program."
+            )
+        replayed = 0
+
+        def replay(done, hist):
+            nonlocal replayed
+            self._replay_em_history(
+                hist["lam"], hist["m"], hist["u"], hist["ll"],
+                replayed, done, compute_ll,
+            )
+            replayed = done
+
+        def on_segment(done, hist, _converged):
+            replay(done, hist)
+            if self.save_state_fn is not None:
+                self.save_state_fn(self.params, self.settings)
+
+        result = run_em_checkpointed(
+            G_dev,
+            init,
+            max_iterations=max_iterations,
+            checkpoint_dir=ckpt_dir,
+            state_hash=state_hash,
+            checkpoint_every=interval,
+            resume=resume,
+            resume_checkpoint=ckpt,
+            fault_plan=active_plan(self.settings),
+            on_segment=on_segment,
+            **em_kwargs,
+        )
+        # a resume that was already complete runs zero segments; catch up
+        # from the result's (checkpoint-restored) histories
+        n_updates = int(result.n_updates)
+        replay(
+            n_updates,
+            {
+                "lam": result.lam_history,
+                "m": result.m_history,
+                "u": result.u_history,
+                "ll": result.ll_history,
+            },
+        )
+        if compute_ll and not np.isnan(result.ll_history[n_updates]):
+            self.params.params["log_likelihood"] = float(
+                result.ll_history[n_updates]
+            )
+            self.params.log_likelihood_exists = True
+        return bool(result.converged)
 
     def _run_em_streamed(self, G: np.ndarray, compute_ll: bool) -> None:
         """Streaming EM over host-resident gamma micro-batches.
@@ -985,6 +1190,8 @@ class Splink:
 
         from .parallel.distributed import global_pair_slice
         from .parallel.streaming import run_em_streamed
+        from .resilience import RetryPolicy, active_plan
+        from .resilience.checkpoint import EMCheckpointer
 
         dtype = self._float_dtype
         lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
@@ -1002,11 +1209,55 @@ class Splink:
             mesh = None
             stats_reduce = all_sum_stats
 
+        # checkpoint/resume plumbing (docs/resilience.md): the streamed
+        # driver exposes progress through on_iteration, so checkpointing
+        # is a hook — and resume is (restored init params, start_iteration)
+        ckpt_dir, resume, interval = self._checkpoint_config()
+        start_iteration = 0
+        checkpointer = None
+        if ckpt_dir is not None:
+            state_hash = self._em_state_hash()
+            ckpt = self._load_validated_checkpoint(ckpt_dir, state_hash, resume)
+            if ckpt is not None:
+                lam_r, m_r, u_r = ckpt.params_arrays()
+                init = FSParams(
+                    lam=jnp.asarray(lam_r.astype(dtype)),
+                    m=jnp.asarray(m_r.astype(dtype)),
+                    u=jnp.asarray(u_r.astype(dtype)),
+                )
+                start_iteration = min(
+                    ckpt.iteration, int(self.settings["max_iterations"])
+                )
+                # replay the pre-interruption history into the Params
+                # object so the final state is indistinguishable from an
+                # uninterrupted run's
+                h = ckpt.history_arrays()
+                self._replay_em_history(
+                    h["lam"], h["m"], h["u"], h["ll"],
+                    0, start_iteration, compute_ll,
+                )
+            checkpointer = EMCheckpointer(
+                ckpt_dir,
+                state_hash,
+                interval=interval,
+                process_count=jax.process_count(),
+                write=jax.process_index() == 0,
+                dtype=np.dtype(dtype).name,
+            ).start(init, from_checkpoint=ckpt)
+            if ckpt is not None and ckpt.converged:
+                # training already completed before the interruption —
+                # resuming would append a spurious extra update
+                logger.info(
+                    "checkpoint at iteration %d is already converged; "
+                    "nothing to resume", ckpt.iteration,
+                )
+                return
+
         def batches():
             for s in range(0, len(G), batch):
                 yield G[s : s + batch]
 
-        def on_iteration(it, params_dev, ll):
+        def on_iteration(it, params_dev, ll, converged_now=False):
             if compute_ll and ll is not None:
                 self.params.params["log_likelihood"] = float(ll)
                 self.params.log_likelihood_exists = True
@@ -1015,6 +1266,13 @@ class Splink:
                 np.asarray(params_dev.m),
                 np.asarray(params_dev.u),
             )
+            # checkpoint BEFORE save_state_fn and the em_iteration fault
+            # site: an injected kill at iteration N must find update N
+            # already durable (the kill-and-resume contract)
+            if checkpointer is not None:
+                checkpointer.on_iteration(
+                    it, params_dev, ll, converged=converged_now
+                )
             if self.save_state_fn is not None:
                 self.save_state_fn(self.params, self.settings)
 
@@ -1029,7 +1287,12 @@ class Splink:
                 compute_ll=compute_ll,
                 on_iteration=on_iteration,
                 stats_reduce=stats_reduce,
+                start_iteration=start_iteration,
+                retry_policy=RetryPolicy(),
+                fault_plan=active_plan(self.settings),
             )
+        if checkpointer is not None:
+            checkpointer.finish(converged)
         if converged:
             logger.info("EM algorithm has converged")
 
@@ -1071,21 +1334,43 @@ class Splink:
         for s in range(0, len(G), batch):
             yield self._build_df_e(G, slice(s, min(s + batch, len(G))))
 
+    def _replay_em_history(
+        self, lam_h, m_h, u_h, ll_h, from_k: int, to_k: int, compute_ll: bool
+    ) -> None:
+        """Apply history updates ``from_k+1 .. to_k`` into the Params
+        object (per update: archive the pre-update log likelihood at
+        index k-1, then update_from_arrays) — the ONE replay loop behind
+        plain-result installation, checkpoint-boundary replay and resume
+        (history layout: index i = params before update i+1; ll index i =
+        log likelihood under params i, NaN = not computed)."""
+        for k in range(from_k + 1, to_k + 1):
+            if (
+                compute_ll
+                and ll_h is not None
+                and not np.isnan(ll_h[k - 1])
+            ):
+                self.params.params["log_likelihood"] = float(ll_h[k - 1])
+                self.params.log_likelihood_exists = True
+            self.params.update_from_arrays(
+                float(lam_h[k]), np.asarray(m_h[k]), np.asarray(u_h[k])
+            )
+
     def _replay_history(self, result, compute_ll: bool) -> None:
         """Install a run_em result's device-side history into the Params
         object so history, convergence logging, charts and save/load match
         the reference's per-iteration bookkeeping."""
         n_updates = int(result.n_updates)
         ll_hist = np.asarray(result.ll_history)
-        for k in range(1, n_updates + 1):
-            if compute_ll:
-                self.params.params["log_likelihood"] = float(ll_hist[k - 1])
-            self.params.update_from_arrays(
-                float(result.lam_history[k]),
-                np.asarray(result.m_history[k]),
-                np.asarray(result.u_history[k]),
-            )
-        if compute_ll and n_updates >= 0:
+        self._replay_em_history(
+            result.lam_history,
+            result.m_history,
+            result.u_history,
+            ll_hist,
+            0,
+            n_updates,
+            compute_ll,
+        )
+        if compute_ll and not np.isnan(ll_hist[n_updates]):
             self.params.params["log_likelihood"] = float(ll_hist[n_updates])
             self.params.log_likelihood_exists = True
 

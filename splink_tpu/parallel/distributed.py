@@ -21,11 +21,28 @@ code path with auto-detected coordinator arguments.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 
 import jax
 
 logger = logging.getLogger("splink_tpu")
+
+
+def distributed_is_initialized() -> bool:
+    """Whether the multi-controller runtime is up. jax < 0.5 has no
+    ``jax.distributed.is_initialized``; fall back to the client object the
+    initialize call installs (reading it does NOT initialise the XLA
+    backend, unlike jax.process_count())."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 - conservative: assume not initialised
+        return False
 
 
 def initialize_multihost(
@@ -45,7 +62,7 @@ def initialize_multihost(
     # backend, after which jax.distributed.initialize refuses to run (it
     # must precede any backend use). is_initialized() only inspects the
     # distributed-runtime state.
-    if jax.distributed.is_initialized():
+    if distributed_is_initialized():
         return  # already initialised
     explicit = coordinator_address is not None
     try:
@@ -86,6 +103,69 @@ def all_sum_stats(stats):
         jax.tree.map(jnp.asarray, stats)
     )
     return jax.tree.map(lambda leaf: jnp.sum(leaf, axis=0), gathered)
+
+
+def validate_resume_presence(found: bool) -> bool:
+    """All processes must agree whether the checkpoint exists BEFORE any
+    loader-only work happens: validate_resume_topology is a collective,
+    and a resumed process also starts from a different iteration than a
+    fresh one — either divergence deadlocks or corrupts the run. Mixed
+    found-flags mean checkpoint_dir is per-host storage (only process 0
+    writes); raise with that diagnosis instead of hanging. Every process
+    must call this when resuming under multi-controller. Returns
+    ``found`` unchanged for the single-process case and for agreement."""
+    if jax.process_count() == 1:
+        return found
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    local = np.array([1 if found else 0], np.int64)
+    gathered = np.asarray(multihost_utils.process_allgather(local)).ravel()
+    if gathered.min() != gathered.max():
+        raise RuntimeError(
+            "processes disagree on checkpoint presence (found flags "
+            f"{gathered.tolist()}): only process 0 writes checkpoints, so "
+            "checkpoint_dir must be on storage shared by every controller "
+            "process."
+        )
+    return found
+
+
+def validate_resume_topology(
+    checkpoint_process_count: int, state_hash: str, iteration: int
+) -> None:
+    """Gate a multi-controller checkpoint resume on topology agreement.
+
+    A resumed run must (a) have the SAME process count the checkpoint was
+    written under — global_pair_slice partitions by process count, so a
+    different topology would stream different slices than the histories
+    assume — and (b) agree ACROSS processes on which checkpoint it is
+    resuming (same settings hash, same iteration). Disagreement raises
+    before any training continues; the single-process case checks only (a).
+    """
+    if jax.process_count() != checkpoint_process_count:
+        raise RuntimeError(
+            f"checkpoint was written by {checkpoint_process_count} "
+            f"process(es) but this run has {jax.process_count()}: the "
+            "global pair slices would not line up. Resume with the same "
+            "topology, or train fresh with resume=False."
+        )
+    if jax.process_count() == 1:
+        return
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    digest = np.frombuffer(
+        hashlib.sha256(state_hash.encode()).digest()[:8], np.int64
+    )[0]
+    local = np.array([digest, iteration], np.int64)
+    gathered = np.asarray(multihost_utils.process_allgather(local))
+    if not (gathered == local[None, :]).all():
+        raise RuntimeError(
+            "processes disagree on the checkpoint being resumed "
+            f"(hash-digest/iteration rows: {gathered.tolist()}); refusing "
+            "to continue from inconsistent state."
+        )
 
 
 def global_pair_slice(n_pairs_global: int) -> slice:

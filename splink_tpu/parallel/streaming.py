@@ -48,6 +48,9 @@ def run_em_streamed(
     compute_ll: bool = False,
     on_iteration=None,
     stats_reduce=None,
+    start_iteration: int = 0,
+    retry_policy=None,
+    fault_plan=None,
 ):
     """EM over a re-iterable stream of gamma batches.
 
@@ -64,11 +67,36 @@ def run_em_streamed(
             process updates from the GLOBAL aggregate while streaming only
             its own ``global_pair_slice`` (the reference gets this from
             Spark's global shuffle, maximisation_step.py:54-57).
-        on_iteration: optional callback(iteration_index, FSParams, ll) run
-            after each update — the save_state_fn hook's internal analogue.
+        on_iteration: optional callback(iteration_index, FSParams, ll,
+            converged) run after each update — the save_state_fn hook's
+            internal analogue (and where resilience.EMCheckpointer plugs
+            in); ``converged`` is True on the update that met
+            em_convergence.
+        start_iteration: resume support — the number of EM updates ``init``
+            already embodies (from a checkpoint); iteration indices
+            reported to on_iteration continue from here, and at most
+            ``max_iterations - start_iteration`` further updates run.
+            Histories still start at index 0 = ``init`` (the caller merges
+            with pre-resume history).
+        retry_policy: optional resilience.RetryPolicy. A transient failure
+            anywhere in a pass (batch fetch, device put, execute) restarts
+            that WHOLE pass with bounded exponential backoff — partial
+            sufficient statistics are never reused, so a retried pass is
+            bit-identical to an undisturbed one. Deterministic failures
+            propagate immediately. None disables retry.
+        fault_plan: optional resilience.FaultPlan consulted at the
+            ``batch_fetch`` (per batch) and ``em_iteration`` (per update)
+            injection sites; None resolves the process's active plan
+            (SPLINK_TPU_FAULTS).
 
     Returns (params, histories, n_updates, converged) mirroring run_em.
     """
+    from ..resilience import faults as _faults
+    from ..resilience.retry import retry_call
+
+    if fault_plan is None:
+        fault_plan = _faults.active_plan()
+
     params = init
     C, L = init.m.shape
     lam_hist = [float(init.lam)]
@@ -76,16 +104,18 @@ def run_em_streamed(
     u_hist = [np.asarray(init.u)]
     ll_hist = []
     converged = False
-    it = 0
+    it = start_iteration
 
-    for it in range(1, max_iterations + 1):
+    def one_pass(it, params):
+        """One full pass over the stream: (accumulated stats, ll parts)."""
         acc = SufficientStats.zeros(C, L, dtype=init.m.dtype)
         # Per-batch log-likelihoods stay on device (a host-side float(ll)
         # here would sync every micro-batch and serialise the stream) and
         # reduce pairwise at the end of the pass, which keeps f32 error
         # O(log n_batches) instead of O(n_batches) for sequential adds.
         ll_parts = []
-        for batch in batch_iter_factory():
+        for bi, batch in enumerate(batch_iter_factory()):
+            fault_plan.fire("batch_fetch", iter=it, batch=bi)
             if isinstance(batch, tuple):
                 G, w = batch
             else:
@@ -104,6 +134,17 @@ def run_em_streamed(
             acc = acc + stats
             if compute_ll:
                 ll_parts.append(ll)
+        return acc, ll_parts
+
+    for it in range(start_iteration + 1, max_iterations + 1):
+        if retry_policy is not None:
+            acc, ll_parts = retry_call(
+                lambda: one_pass(it, params),
+                policy=retry_policy,
+                label=f"EM pass {it}",
+            )
+        else:
+            acc, ll_parts = one_pass(it, params)
         ll_total = float(jnp.sum(jnp.stack(ll_parts))) if ll_parts else 0.0
 
         if stats_reduce is not None:
@@ -126,9 +167,18 @@ def run_em_streamed(
         u_hist.append(np.asarray(params.u))
         if compute_ll:
             ll_hist.append(ll_total)
+        converged_now = delta < em_convergence
         if on_iteration is not None:
-            on_iteration(it, params, ll_total if compute_ll else None)
-        if delta < em_convergence:
+            # the convergence flag rides along so a checkpoint written at
+            # the converging iteration records converged=True — a resume
+            # must not append a spurious extra update
+            on_iteration(
+                it, params, ll_total if compute_ll else None, converged_now
+            )
+        # after on_iteration so a checkpoint hook persists this update
+        # before an injected process death (the kill-and-resume tests)
+        fault_plan.fire("em_iteration", iter=it)
+        if converged_now:
             converged = True
             break
 

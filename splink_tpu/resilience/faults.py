@@ -1,0 +1,154 @@
+"""Deterministic fault injection for the EM execution stack.
+
+Every recovery path in this package (pass retry, checkpoint resume, OOM
+degradation) exists because a specific failure was observed on the real
+tunnelled TPU platform — and every one of them must have a test that
+actually exercises it. Real device losses are not reproducible in CI, so
+the execution stack carries explicit, deterministic injection points that
+fire according to a plan parsed from the ``SPLINK_TPU_FAULTS`` environment
+variable or the ``fault_plan`` settings key.
+
+Plan grammar (comma-separated events)::
+
+    <site>@key=value[:key=value...]
+
+    batch_fetch@iter=2:batch=3            transient stream error (default kind)
+    batch_fetch@iter=1:batch=0:kind=oom   simulated RESOURCE_EXHAUSTED
+    em_iteration@iter=4:kind=kill         SIGKILL own process at iteration 4
+    resident_em@kind=oom                  device OOM entering the resident path
+    segment@iter=10:kind=transient        error at a segmented-EM boundary
+
+Sites are the hook names the execution stack calls (`fire`); ``iter`` /
+``batch`` constrain when the event matches (omitted = any). ``times``
+bounds how often an event fires (default 1), so a retried pass sees the
+fault exactly once and then succeeds — which is what makes bit-identical
+recovery assertions possible.
+
+The kill kind uses SIGKILL (no atexit, no finally blocks), faithfully
+modelling host death for the checkpoint/resume tests; the relaunching
+parent controls the environment, so a resumed process does not re-fire.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+
+logger = logging.getLogger("splink_tpu")
+
+ENV_VAR = "SPLINK_TPU_FAULTS"
+
+_KINDS = ("transient", "oom", "kill")
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected failure.
+
+    The message embeds the marker string the retry classifier keys on for
+    the requested kind, so injected faults exercise the SAME classification
+    code path as real ones (``RESOURCE_EXHAUSTED`` for oom, a tunnel-drop
+    message for transient).
+    """
+
+    def __init__(self, site: str, kind: str, coords: dict):
+        self.site = site
+        self.kind = kind
+        self.coords = dict(coords)
+        marker = (
+            "RESOURCE_EXHAUSTED: injected device OOM"
+            if kind == "oom"
+            else "UNAVAILABLE: Socket closed (injected tunnel drop)"
+        )
+        super().__init__(f"injected fault at {site} {coords}: {marker}")
+
+
+class _Event:
+    __slots__ = ("site", "kind", "match", "times")
+
+    def __init__(self, site: str, kind: str, match: dict, times: int):
+        self.site = site
+        self.kind = kind
+        self.match = match  # {"iter": int, "batch": int, ...}
+        self.times = times
+
+    def matches(self, site: str, coords: dict) -> bool:
+        if self.times <= 0 or site != self.site:
+            return False
+        return all(coords.get(k) == v for k, v in self.match.items())
+
+
+class FaultPlan:
+    """A parsed, stateful fault plan. ``fire(site, **coords)`` is called at
+    each injection point; matching events decrement their budget and then
+    raise (or kill). An empty plan is a no-op, so the hooks cost one
+    attribute check on the production path."""
+
+    def __init__(self, events: list[_Event] | None = None, spec: str = ""):
+        self.events = events or []
+        self.spec = spec
+
+    def __bool__(self) -> bool:
+        return bool(self.events)
+
+    @classmethod
+    def from_spec(cls, spec: str | None) -> "FaultPlan":
+        spec = (spec or "").strip()
+        if not spec:
+            return cls()
+        events = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            site, _, argstr = part.partition("@")
+            kind, times, match = "transient", 1, {}
+            for kv in filter(None, argstr.split(":")):
+                key, _, value = kv.partition("=")
+                key = key.strip()
+                if key == "kind":
+                    if value not in _KINDS:
+                        raise ValueError(
+                            f"fault plan {part!r}: kind must be one of {_KINDS}"
+                        )
+                    kind = value
+                elif key == "times":
+                    times = int(value)
+                else:
+                    match[key] = int(value)
+            events.append(_Event(site.strip(), kind, match, times))
+        return cls(events, spec)
+
+    def fire(self, site: str, **coords) -> None:
+        """Raise/kill if an event matches this (site, coords); else no-op."""
+        if not self.events:
+            return
+        for ev in self.events:
+            if ev.matches(site, coords):
+                ev.times -= 1
+                if ev.kind == "kill":
+                    logger.warning(
+                        "fault injection: SIGKILL self at %s %s", site, coords
+                    )
+                    os.kill(os.getpid(), signal.SIGKILL)
+                raise InjectedFault(site, ev.kind, coords)
+
+
+# One live plan per spec string: event budgets (``times``) must be shared
+# by every hook in the process or a once-only fault would re-fire at each
+# injection site that consults the plan.
+_PLAN_CACHE: dict[str, FaultPlan] = {}
+
+
+def active_plan(settings: dict | None = None) -> FaultPlan:
+    """The process's active fault plan: ``SPLINK_TPU_FAULTS`` env var first,
+    else the ``fault_plan`` settings key, else an empty (no-op) plan."""
+    spec = os.environ.get(ENV_VAR) or (settings or {}).get("fault_plan") or ""
+    if spec not in _PLAN_CACHE:
+        _PLAN_CACHE[spec] = FaultPlan.from_spec(spec)
+    return _PLAN_CACHE[spec]
+
+
+def reset_plans() -> None:
+    """Forget fired-event state (tests only)."""
+    _PLAN_CACHE.clear()

@@ -1,0 +1,174 @@
+"""Retry with bounded exponential backoff + failure classification.
+
+Rounds 1-5 on the tunnelled TPU platform produced a taxonomy of failures
+worth retrying (the tunnel "comes and goes within a round" —
+BENCHMARKS.md round-4 availability timeline) and failures that never heal
+(broken install, shape bug, schema error). The classifier below encodes
+it: gRPC/XLA status markers and connection errors are transient;
+everything else is deterministic and propagates immediately. The abort
+policy mirrors ``bench.py``'s probe loop: three consecutive IDENTICAL
+failures end the retry budget early, because an error that reproduces
+byte-for-byte three times is deterministic no matter what its class says.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+
+logger = logging.getLogger("splink_tpu")
+
+# Substrings marking a transient platform failure (gRPC status names XLA
+# embeds in RuntimeError text, plus tunnel-drop phrasing observed in
+# rounds 1-5). RESOURCE_EXHAUSTED is transient HERE (device memory often
+# frees after in-flight buffers drain); the resident EM path additionally
+# treats it as a degradation trigger via is_oom().
+TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "INTERNAL",
+    "Socket closed",
+    "connection reset",
+    "Connection reset",
+    "tunnel",
+    "failed to connect",
+)
+
+OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory", "OOM")
+
+TRANSIENT_TYPES = (ConnectionError, TimeoutError, BrokenPipeError)
+
+
+class RetryError(RuntimeError):
+    """Retry budget exhausted (the original failure rides as __cause__)."""
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded exponential backoff: delay_k = min(base * mult^k, max)."""
+
+    max_retries: int = 4  # retries, i.e. up to 1 + max_retries attempts
+    base_delay: float = 0.5
+    max_delay: float = 30.0
+    multiplier: float = 2.0
+    max_identical_failures: int = 3  # bench.py's probe abort policy
+
+    def delay(self, attempt: int) -> float:
+        return min(self.base_delay * self.multiplier**attempt, self.max_delay)
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Whether an exception is a device out-of-memory condition — the
+    trigger for resident -> streamed degradation (linker._run_em)."""
+    from .faults import InjectedFault
+
+    if isinstance(exc, InjectedFault):
+        return exc.kind == "oom"
+    text = f"{type(exc).__name__}: {exc}"
+    return any(m in text for m in OOM_MARKERS)
+
+
+def classify_error(exc: BaseException) -> str:
+    """'transient' (worth retrying) or 'deterministic' (propagate now)."""
+    from .faults import InjectedFault
+
+    if isinstance(exc, InjectedFault):
+        return "deterministic" if exc.kind == "kill" else "transient"
+    if isinstance(exc, TRANSIENT_TYPES):
+        return "transient"
+    text = f"{type(exc).__name__}: {exc}"
+    if any(m in text for m in TRANSIENT_MARKERS):
+        return "transient"
+    return "deterministic"
+
+
+def retry_call(
+    fn,
+    *,
+    policy: RetryPolicy | None = None,
+    classify=classify_error,
+    label: str = "",
+    sleep=time.sleep,
+    on_retry=None,
+):
+    """Call ``fn()`` with bounded-backoff retry on transient failures.
+
+    Deterministic failures propagate immediately; so does the
+    ``max_identical_failures``-th consecutive byte-identical failure
+    (wrapped in RetryError so callers can tell budget exhaustion from the
+    first occurrence). ``sleep`` is injectable so tests run at full speed.
+    """
+    policy = policy or RetryPolicy()
+    last_repr = None
+    identical = 0
+    for attempt in range(policy.max_retries + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - classification decides
+            kind = classify(e)
+            this_repr = f"{type(e).__name__}: {e}"
+            identical = identical + 1 if this_repr == last_repr else 1
+            last_repr = this_repr
+            if kind != "transient":
+                raise
+            if identical >= policy.max_identical_failures:
+                raise RetryError(
+                    f"{label or 'operation'}: {identical} consecutive "
+                    f"identical failures, aborting as deterministic: "
+                    f"{this_repr}"
+                ) from e
+            if attempt >= policy.max_retries:
+                raise RetryError(
+                    f"{label or 'operation'}: retry budget exhausted after "
+                    f"{attempt + 1} attempts: {this_repr}"
+                ) from e
+            delay = policy.delay(attempt)
+            logger.warning(
+                "%s: transient failure (attempt %d/%d), retrying in %.1fs: %s",
+                label or "operation",
+                attempt + 1,
+                policy.max_retries + 1,
+                delay,
+                this_repr,
+            )
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+_devices_checked = False
+
+
+def ensure_devices() -> str:
+    """Probe accelerator availability once per process; degrade to CPU.
+
+    The last rung of the degradation ladder (resident -> streamed -> CPU):
+    when the configured accelerator backend cannot initialise (dead
+    tunnel, no TPU on this host), switch jax to the CPU backend with a
+    structured warning instead of crashing the job. Returns the backend
+    name that will execute.
+    """
+    global _devices_checked
+    import jax
+
+    if _devices_checked:
+        return jax.default_backend()
+    try:
+        jax.devices()
+        _devices_checked = True
+        return jax.default_backend()
+    except RuntimeError as e:
+        from ..utils.logging_utils import warn_degraded
+
+        # switch the platform list FIRST: with JAX_PLATFORMS pinned to an
+        # accelerator, jax.devices("cpu") would re-raise the same backend
+        # failure (cpu is excluded from the pinned list)
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices("cpu")  # raises (propagating) if even CPU is broken
+        warn_degraded("accelerator", "cpu", str(e))
+        _devices_checked = True
+        return "cpu"

@@ -63,6 +63,9 @@ _NON_COLUMN_DEFAULT_KEYS = [
     # second Splink() construction. The linker resolves the default
     # lazily instead.
     "float64",
+    "checkpoint_dir",
+    "checkpoint_interval",
+    "fault_plan",
 ]
 
 

@@ -10,6 +10,7 @@ purpose.
 from __future__ import annotations
 
 import logging
+import warnings
 
 logger = logging.getLogger("splink_tpu")
 
@@ -17,6 +18,27 @@ logger = logging.getLogger("splink_tpu")
 def format_stage_log(stage: str, **info) -> str:
     parts = ", ".join(f"{k}={v}" for k, v in info.items())
     return f"[{stage}] {parts}"
+
+
+class DegradationWarning(UserWarning):
+    """An execution path degraded to a slower but working alternative
+    (resident EM -> streamed EM, accelerator -> CPU). The job still
+    completes with the same results; the warning records why it was
+    slower than expected."""
+
+
+def warn_degraded(from_mode: str, to_mode: str, reason: str, **info) -> None:
+    """Emit the structured degradation record: one parseable log line plus
+    a DegradationWarning (so tests and callers can assert on it)."""
+    line = format_stage_log(
+        "degrade", **{"from": from_mode, "to": to_mode, "reason": reason}, **info
+    )
+    logger.warning("%s", line)
+    warnings.warn(
+        f"execution degraded from {from_mode} to {to_mode}: {reason}",
+        DegradationWarning,
+        stacklevel=2,
+    )
 
 
 def log_jaxpr(stage: str, fn, *example_args) -> None:
