@@ -1,8 +1,14 @@
-.PHONY: test tpu-smoke bench bench-blocking all
+.PHONY: test lint tpu-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
 	python -m pytest tests/ -x -q
+
+# Static analysis gate: jaxlint AST pass over the package + jaxpr audit of
+# the kernel registry (splink_tpu/analysis/). Exit 1 on any unsuppressed
+# finding; tests/test_codebase_clean.py enforces the same gate in tier-1.
+lint:
+	python -m splink_tpu.analysis splink_tpu/ --audit
 
 # Hardware smoke tier: real TPU lowering of Pallas kernels + pipeline.
 # Separate invocation because tests/conftest.py pins its process to CPU.
@@ -18,4 +24,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: test tpu-smoke bench
+all: lint test tpu-smoke bench

@@ -1,0 +1,34 @@
+"""Static-analysis + jaxpr-audit framework gating CI.
+
+Two layers, one finding model:
+
+  * :mod:`.jaxlint` — AST lint pass over JAX hazard classes (host calls and
+    syncs on traced values, Python branches on tracers, unpinned dtypes,
+    float64 leaks, import-time device work, recompile hazards, donated
+    buffer reuse), with ``# jaxlint: disable=RULE`` suppressions.
+  * :mod:`.trace_audit` — traces every kernel in the declared registry and
+    asserts jaxpr-level invariants (const budget, dtype width, callback
+    allowlist, trace determinism).
+
+CLI: ``python -m splink_tpu.analysis splink_tpu/ [--audit] [--json]``;
+``make lint`` runs both layers, and tests/test_codebase_clean.py gates
+tier-1 on a clean run.
+"""
+
+from .findings import Finding, Report
+from .jaxlint import lint_paths, lint_source
+from .rules import RULES, rule
+from .trace_audit import REGISTRY, audit_kernel, register_kernel, run_audit
+
+__all__ = [
+    "Finding",
+    "Report",
+    "lint_paths",
+    "lint_source",
+    "RULES",
+    "rule",
+    "REGISTRY",
+    "audit_kernel",
+    "register_kernel",
+    "run_audit",
+]

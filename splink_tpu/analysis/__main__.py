@@ -1,0 +1,95 @@
+"""CLI: ``python -m splink_tpu.analysis [paths...] [--audit] [--json]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error. The lint layer itself is
+pure stdlib AST work (no tracing, no device); the jaxpr audit (``--audit``)
+traces the kernel registry and needs a working jax backend (CPU suffices).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .findings import Report
+from .jaxlint import lint_paths
+from .rules import RULES
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m splink_tpu.analysis",
+        description="JAX-aware static analysis (jaxlint) + jaxpr audit",
+    )
+    parser.add_argument(
+        "paths", nargs="*", help="files or directories to lint"
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable JSON output"
+    )
+    parser.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--audit",
+        action="store_true",
+        help="also run the jaxpr trace audit over the kernel registry",
+    )
+    parser.add_argument(
+        "--audit-kernels",
+        help="comma-separated kernel names to audit (implies --audit)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for spec in sorted(RULES.values(), key=lambda s: s.id):
+            print(f"{spec.id}  {spec.title}\n       {spec.doc}")
+        return 0
+
+    if not args.paths and not (args.audit or args.audit_kernels):
+        parser.print_usage(sys.stderr)
+        print(
+            "error: give at least one path to lint, or --audit",
+            file=sys.stderr,
+        )
+        return 2
+
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    try:
+        report = lint_paths(args.paths, rules) if args.paths else Report()
+    except (FileNotFoundError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.audit or args.audit_kernels:
+        from .trace_audit import run_audit
+
+        kernels = (
+            [k.strip() for k in args.audit_kernels.split(",") if k.strip()]
+            if args.audit_kernels
+            else None
+        )
+        try:
+            audit_findings, audited = run_audit(kernels)
+        except KeyError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        report.extend(audit_findings)
+        report.kernels_audited = audited
+
+    print(report.format_json() if args.json else report.format_text())
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `... | head` closed the pipe: not an error
+        sys.exit(0)

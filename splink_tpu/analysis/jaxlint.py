@@ -1,0 +1,541 @@
+"""jaxlint: AST lint pass over JAX hazard classes (layer 1 of the analysis
+framework; layer 2 is the jaxpr-level :mod:`trace_audit`).
+
+The pipeline is a compiler — settings compile into jitted programs — and the
+hazards that break compiled pipelines are not syntax errors but *silent*
+performance/correctness leaks: a ``float()`` that syncs the device inside a
+hot loop, an unpinned ``jnp.arange`` that becomes int64 under x64, a
+``jax.jit`` constructed per loop iteration that recompiles every time. Each
+rule in :mod:`.rules` targets one such class and reports structured
+:class:`~.findings.Finding` objects.
+
+The engine builds one :class:`ModuleLint` per source file:
+
+  * import-alias resolution, so ``jnp.zeros`` / ``jax.numpy.zeros`` /
+    ``from jax.numpy import zeros`` all canonicalise to ``jax.numpy.zeros``;
+  * traced-context analysis: which functions execute under JAX tracing
+    (jit-decorated, ``jax.jit(f)`` wrapped, passed to ``lax.while_loop`` /
+    ``scan`` / ``cond`` / ``vmap`` / ``pallas_call``, or transitively called
+    from those), and which of their names hold traced values (non-static
+    parameters, closure parameters of an enclosing jit root, and locals
+    assigned from ``jnp.``/``lax.`` expressions);
+  * suppression handling: ``# jaxlint: disable=JL001[,JL002]`` on the
+    offending line or the line above, ``# jaxlint: disable-file=JL001`` (or
+    ``all``) in the file's first 10 lines.
+
+Rules stay out of the engine: they are plain functions registered in
+:mod:`.rules` that read a ModuleLint and yield findings, so adding a rule
+never touches this file.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+from .findings import Finding, Report
+
+# Callables whose function-valued arguments execute under tracing. Values are
+# the argument positions that are functions (None = every positional arg).
+_TRACING_CONSUMERS: dict[str, tuple[int, ...] | None] = {
+    "jax.jit": (0,),
+    "jax.pmap": (0,),
+    "jax.vmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": None,
+    "jax.lax.associative_scan": (0,),
+    "jax.experimental.pallas.pallas_call": (0,),
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*jaxlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass
+class FnInfo:
+    """Traced-context facts about one function definition."""
+
+    node: ast.AST
+    qualname: str
+    params: tuple[str, ...]
+    static_params: frozenset[str] = frozenset()
+    donated: tuple[str, ...] = ()  # donated parameter names, call-site order
+    traced: bool = False  # body executes under JAX tracing
+    params_traced: bool = False  # parameters are traced values (jit root /
+    # lax body), not just host config threaded through a traced call chain
+    traced_names: frozenset[str] = frozenset()  # names holding traced values
+
+    @property
+    def jitted(self) -> bool:
+        return self.params_traced
+
+
+def _decorator_parts(dec: ast.expr):
+    """(canonical callee, call node | None) for one decorator expression."""
+    if isinstance(dec, ast.Call):
+        return dec.func, dec
+    return dec, None
+
+
+def _const_str_items(node: ast.expr | None) -> tuple[str, ...]:
+    """String constants inside a tuple/list/str constant AST node."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _const_int_items(node: ast.expr | None) -> tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+def _bound_names(target: ast.expr):
+    """Names an assignment target actually (re)binds. ``words[w] = x``
+    mutates ``words`` — ``w`` is an index read, not a binding."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _bound_names(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _bound_names(target.value)
+    elif isinstance(target, (ast.Subscript, ast.Attribute)):
+        yield from _bound_names(target.value)
+
+
+class ModuleLint:
+    """One parsed module plus the shared analyses every rule reads."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.aliases = self._collect_aliases()
+        self.fns: dict[ast.AST, FnInfo] = {}
+        self._collect_functions()
+        self._mark_traced_roots()
+        self._propagate_traced()
+        self._compute_traced_names()
+        self.file_suppressed = self._file_suppressions()
+
+    # -- imports / name canonicalisation ----------------------------------
+
+    def _collect_aliases(self) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def canonical(self, node: ast.expr) -> str | None:
+        """Dotted canonical name of a Name/Attribute chain, alias-resolved
+        (``jnp.zeros`` -> ``jax.numpy.zeros``), or None for other shapes."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.aliases.get(node.id, node.id)
+        return ".".join([head, *reversed(parts)])
+
+    def is_jnp(self, canon: str | None) -> bool:
+        return bool(canon) and canon.startswith("jax.numpy.")
+
+    def is_device_ns(self, canon: str | None) -> bool:
+        """Namespaces whose calls dispatch/trace on device values."""
+        return bool(canon) and (
+            canon.startswith("jax.numpy.")
+            or canon.startswith("jax.lax.")
+            or canon.startswith("jax.nn.")
+            or canon.startswith("jax.ops.")
+        )
+
+    # -- function collection ----------------------------------------------
+
+    def _collect_functions(self) -> None:
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    args = child.args
+                    params = tuple(
+                        a.arg
+                        for a in (
+                            *args.posonlyargs,
+                            *args.args,
+                            *args.kwonlyargs,
+                        )
+                    )
+                    self.fns[child] = FnInfo(child, qual, params)
+                    visit(child, f"{qual}.")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.")
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+
+    def enclosing_fn(self, node: ast.AST) -> ast.AST | None:
+        """Nearest enclosing FunctionDef, or None at module/class level."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def _fn_by_name(self) -> dict[str, list[FnInfo]]:
+        by_name: dict[str, list[FnInfo]] = {}
+        for info in self.fns.values():
+            by_name.setdefault(info.node.name, []).append(info)
+        return by_name
+
+    # -- traced-context analysis ------------------------------------------
+
+    def _mark_root(self, info: FnInfo, statics=(), donated=()) -> None:
+        info.traced = True
+        info.params_traced = True
+        info.static_params = info.static_params | frozenset(statics)
+        if donated:
+            info.donated = tuple(donated)
+
+    def _jit_statics_from_call(self, call: ast.Call, info: FnInfo):
+        """static/donated parameter names from a jax.jit(...) call's kwargs."""
+        statics: list[str] = []
+        donated: list[str] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                statics += _const_str_items(kw.value)
+            elif kw.arg == "static_argnums":
+                statics += [
+                    info.params[i]
+                    for i in _const_int_items(kw.value)
+                    if i < len(info.params)
+                ]
+            elif kw.arg == "donate_argnames":
+                donated += _const_str_items(kw.value)
+            elif kw.arg == "donate_argnums":
+                donated += [
+                    info.params[i]
+                    for i in _const_int_items(kw.value)
+                    if i < len(info.params)
+                ]
+        return statics, donated
+
+    def _mark_traced_roots(self) -> None:
+        by_name = self._fn_by_name()
+
+        # decorator form: @jax.jit / @partial(jax.jit, static_argnames=...)
+        for info in self.fns.values():
+            for dec in getattr(info.node, "decorator_list", []):
+                callee, call = _decorator_parts(dec)
+                canon = self.canonical(callee)
+                if canon == "jax.jit":
+                    statics, donated = (
+                        self._jit_statics_from_call(call, info)
+                        if call
+                        else ((), ())
+                    )
+                    self._mark_root(info, statics, donated)
+                elif canon == "functools.partial" and call and call.args:
+                    if self.canonical(call.args[0]) == "jax.jit":
+                        statics, donated = self._jit_statics_from_call(
+                            call, info
+                        )
+                        self._mark_root(info, statics, donated)
+
+        # call form: jax.jit(f, ...), lax.while_loop(cond, body, ...), ...
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = self.canonical(node.func)
+            if canon not in _TRACING_CONSUMERS:
+                continue
+            positions = _TRACING_CONSUMERS[canon]
+            for i, arg in enumerate(node.args):
+                if positions is not None and i not in positions:
+                    continue
+                if not isinstance(arg, ast.Name):
+                    continue
+                for info in by_name.get(arg.id, []):
+                    statics, donated = (
+                        self._jit_statics_from_call(node, info)
+                        if canon == "jax.jit"
+                        else ((), ())
+                    )
+                    self._mark_root(info, statics, donated)
+
+    def _called_names(self, fn_node: ast.AST):
+        """Simple/attribute callee names invoked inside a function body."""
+        names: set[str] = set()
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name):
+                    names.add(node.func.id)
+                elif isinstance(node.func, ast.Attribute):
+                    # only bare-receiver method calls (self.f(), ctx.f()) —
+                    # a dotted module call resolves via canonical() instead
+                    if isinstance(node.func.value, ast.Name):
+                        recv = self.aliases.get(
+                            node.func.value.id, node.func.value.id
+                        )
+                        if "." not in recv and recv not in ("jax", "numpy", "math"):
+                            names.add(node.func.attr)
+        return names
+
+    def _propagate_traced(self) -> None:
+        """Intra-module transitive closure: a function called (by name) from
+        a traced function is itself traced. Name-based and therefore
+        approximate — rules that need certainty about *parameters* being
+        traced check ``params_traced``, which only roots get."""
+        by_name = self._fn_by_name()
+        work = [info for info in self.fns.values() if info.traced]
+        while work:
+            info = work.pop()
+            for name in self._called_names(info.node):
+                for callee in by_name.get(name, []):
+                    if not callee.traced:
+                        callee.traced = True
+                        work.append(callee)
+
+    def _compute_traced_names(self) -> None:
+        for info in self.fns.values():
+            if not info.traced:
+                continue
+            names: set[str] = set()
+            if info.params_traced:
+                names |= set(info.params) - set(info.static_params)
+            # closure params of an enclosing jit root are traced too
+            # (static ones excluded), e.g. a while_loop body closing over
+            # the jitted driver's array arguments
+            outer = self.enclosing_fn(info.node)
+            while outer is not None:
+                oinfo = self.fns.get(outer)
+                if oinfo is not None and oinfo.params_traced:
+                    names |= set(oinfo.params) - set(oinfo.static_params)
+                outer = self.enclosing_fn(outer)
+            # locals assigned from device-namespace expressions, to a
+            # fixpoint so chains (a = jnp.f(); b = a + 1) resolve
+            own_stmts = [
+                n
+                for n in ast.walk(info.node)
+                if self.enclosing_fn(n) is info.node
+                and isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+            ]
+            for _ in range(8):
+                added = False
+                for stmt in own_stmts:
+                    value = stmt.value
+                    if value is None:
+                        continue
+                    if not self._mentions_traced(value, names):
+                        continue
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for t in targets:
+                        for n in _bound_names(t):
+                            if n not in names:
+                                names.add(n)
+                                added = True
+                if not added:
+                    break
+            info.traced_names = frozenset(names)
+
+    def _mentions_traced(self, node: ast.expr, traced: set[str]) -> bool:
+        """Whether an expression references a traced name or calls into a
+        device namespace (jnp/lax/jax.nn). A reference through ``.shape`` /
+        ``.dtype`` / ``.ndim`` / ``.size`` does not count: those are static
+        Python facts under tracing, so values derived from them are host
+        scalars even when the array itself is traced."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in traced:
+                parent = self.parents.get(n)
+                if isinstance(parent, ast.Attribute) and parent.attr in (
+                    "shape",
+                    "dtype",
+                    "ndim",
+                    "size",
+                    "weak_type",
+                ):
+                    continue
+                return True
+            if isinstance(n, ast.Call) and self.is_device_ns(
+                self.canonical(n.func)
+            ):
+                return True
+        return False
+
+    # -- shared rule helpers ----------------------------------------------
+
+    def x64_gated(self, node: ast.AST) -> bool:
+        """Whether a node sits under a conditional that switches on the x64
+        / float64 mode (``if jax.config.jax_enable_x64``, ``if f.f64``,
+        ``float64 if ... else float32``) — explicit float64 there is the
+        deliberate f64 tier, not a leak."""
+        cur: ast.AST | None = node
+        while cur is not None:
+            test = None
+            if isinstance(cur, (ast.If, ast.IfExp, ast.While)):
+                test = cur.test
+            if test is not None:
+                src = ast.get_source_segment(self.source, test) or ""
+                if re.search(r"x64|f64|float64", src):
+                    return True
+            cur = self.parents.get(cur)
+        return False
+
+    def in_loop(self, node: ast.AST) -> ast.AST | None:
+        """Nearest enclosing for/while loop within the same function."""
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            if isinstance(cur, (ast.For, ast.While)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def finding(
+        self, rule: str, node: ast.AST, message: str, hint: str = ""
+    ) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            hint=hint,
+        )
+
+    # -- suppressions ------------------------------------------------------
+
+    def _file_suppressions(self) -> frozenset[str]:
+        ids: set[str] = set()
+        for line in self.lines[:10]:
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                ids |= {s.strip() for s in m.group(1).split(",") if s.strip()}
+        return frozenset(ids)
+
+    def suppressed(self, finding: Finding) -> bool:
+        if "all" in self.file_suppressed or finding.rule in self.file_suppressed:
+            return True
+        for lineno in (finding.line, finding.line - 1):
+            if 1 <= lineno <= len(self.lines):
+                m = _SUPPRESS_RE.search(self.lines[lineno - 1])
+                if m:
+                    ids = {s.strip() for s in m.group(1).split(",")}
+                    if finding.rule in ids or "all" in ids:
+                        return True
+        return False
+
+
+def lint_source(path: str, source: str, rules=None) -> list[Finding]:
+    """Lint one module's source; returns unsuppressed findings."""
+    from .rules import iter_rules
+
+    try:
+        mod = ModuleLint(path, source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="JL000",
+                path=path,
+                line=e.lineno or 0,
+                message=f"syntax error: {e.msg}",
+            )
+        ]
+    except ValueError as e:  # e.g. null bytes: unparseable, not a crash
+        return [
+            Finding(rule="JL000", path=path, line=0, message=str(e))
+        ]
+    out: list[Finding] = []
+    for rule_id, check in iter_rules(rules):
+        for f in check(mod):
+            if not mod.suppressed(f):
+                out.append(f)
+    return out
+
+
+def iter_python_files(paths):
+    """Expand files/directories into .py files (skipping caches)."""
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs if d not in ("__pycache__", ".git")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            raise FileNotFoundError(path)
+
+
+def lint_paths(paths, rules=None) -> Report:
+    """Lint every .py file under the given paths into one Report."""
+    report = Report()
+    for file_path in iter_python_files(paths):
+        try:
+            with open(file_path, encoding="utf-8") as fh:
+                source = fh.read()
+        except UnicodeDecodeError as e:
+            report.extend(
+                [
+                    Finding(
+                        rule="JL000",
+                        path=file_path,
+                        line=0,
+                        message=f"not valid UTF-8: {e.reason}",
+                    )
+                ]
+            )
+            report.files_checked += 1
+            continue
+        report.extend(lint_source(file_path, source, rules))
+        report.files_checked += 1
+    return report

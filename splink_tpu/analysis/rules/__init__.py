@@ -1,0 +1,63 @@
+"""jaxlint rule registry.
+
+A rule is a plain function ``check(mod: ModuleLint) -> Iterable[Finding]``
+registered under a stable id with :func:`rule`. The registry is the single
+catalog — the CLI's ``--list-rules``, the docs table and the fixture tests
+all enumerate it, so a rule cannot exist without an id, a title and a doc
+line. Mirrors the comparison-kernel registry pattern in
+:mod:`splink_tpu.gammas` (register_comparison): extension without touching
+the engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator
+
+
+@dataclass(frozen=True)
+class RuleSpec:
+    id: str
+    title: str
+    check: Callable
+    doc: str  # one-line hazard description for --list-rules / docs
+
+
+RULES: dict[str, RuleSpec] = {}
+
+
+def rule(rule_id: str, title: str, doc: str):
+    """Register a rule function under a stable id."""
+
+    def deco(check: Callable) -> Callable:
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULES[rule_id] = RuleSpec(rule_id, title, check, doc)
+        return check
+
+    return deco
+
+
+def iter_rules(only: Iterable[str] | None = None) -> Iterator[tuple[str, Callable]]:
+    """(id, check) pairs, optionally restricted to the given ids."""
+    if only is not None:
+        only = list(only)
+        unknown = [r for r in only if r not in RULES]
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {', '.join(unknown)}")
+        ids = only
+    else:
+        ids = sorted(RULES)
+    for rule_id in ids:
+        yield rule_id, RULES[rule_id].check
+
+
+# importing the rule modules populates RULES
+from . import (  # noqa: E402,F401
+    control_flow,
+    donation,
+    dtypes,
+    host_calls,
+    import_time,
+    recompile,
+)

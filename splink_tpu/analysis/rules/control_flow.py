@@ -1,0 +1,68 @@
+"""JL002: Python ``if``/``while`` on traced values.
+
+A Python branch inside a traced function evaluates the condition at trace
+time: on a traced array that raises ``TracerBoolConversionError`` — or, with
+a concrete-making wrapper around it, silently specialises the program to one
+branch and recompiles per value. Data-dependent control flow belongs in
+``lax.cond`` / ``lax.while_loop`` / ``jnp.where`` so it compiles once.
+
+Conditions that only test host structure are exempt: ``x is None`` /
+``is not None`` chains and ``isinstance`` checks branch on Python-level
+facts that are static under tracing (the ``weights is None`` idiom all
+over the EM kernels).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import rule
+
+
+def _structural_only(test: ast.expr) -> bool:
+    """True when every leaf of the condition is an is-None / isinstance /
+    truthiness-of-host-collection style structural check."""
+    if isinstance(test, ast.BoolOp):
+        return all(_structural_only(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _structural_only(test.operand)
+    if isinstance(test, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+    if isinstance(test, ast.Call):
+        return isinstance(test.func, ast.Name) and test.func.id in (
+            "isinstance",
+            "hasattr",
+            "len",
+            "callable",
+        )
+    return False
+
+
+@rule(
+    "JL002",
+    "Python branch on a traced value",
+    "if/while on traced values trace-specialise or fail; use lax.cond/while_loop",
+)
+def check_traced_branches(mod):
+    for info in mod.fns.values():
+        if not info.traced:
+            continue
+        for node in ast.walk(info.node):
+            if mod.enclosing_fn(node) is not info.node:
+                continue
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            if _structural_only(node.test):
+                continue
+            if not mod._mentions_traced(node.test, set(info.traced_names)):
+                continue
+            kw = "while" if isinstance(node, ast.While) else "if"
+            src = (ast.get_source_segment(mod.source, node.test) or "").strip()
+            yield mod.finding(
+                "JL002",
+                node,
+                f"Python `{kw}` on traced value `{src}` inside traced "
+                f"function '{info.qualname}'",
+                "use lax.cond / lax.while_loop / jnp.where, or mark the "
+                "argument static (static_argnames)",
+            )

@@ -1,0 +1,98 @@
+"""JL008: donated buffer read after the donating call.
+
+``donate_argnums`` lets XLA reuse an input's HBM for outputs — essential at
+gamma-matrix scale — but the caller's array is *invalidated* by the call.
+Reading it afterwards returns garbage on TPU (and only warns on CPU, so the
+test tier never catches it). The rule tracks call sites of jit wrappers
+declared with donated parameters and flags any later read of the argument
+name in the same function, unless the name is rebound first.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import rule
+
+
+def _stmts_after(mod, fn_node, lineno: int):
+    """All nodes in the function that start after the given line."""
+    for node in ast.walk(fn_node):
+        if getattr(node, "lineno", 0) > lineno:
+            yield node
+
+
+@rule(
+    "JL008",
+    "donated buffer used after donation",
+    "an argument donated to jit is invalidated by the call",
+)
+def check_donated_reuse(mod):
+    donors = {}
+    for info in mod.fns.values():
+        if info.donated:
+            donors[info.node.name] = info
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = (
+            node.func.id
+            if isinstance(node.func, ast.Name)
+            else node.func.attr
+            if isinstance(node.func, ast.Attribute)
+            else None
+        )
+        info = donors.get(name)
+        if info is None:
+            continue
+        fn = mod.enclosing_fn(node)
+        if fn is None:
+            continue
+        # map donated parameter names to the argument expressions passed
+        donated_vars = []
+        for pname in info.donated:
+            expr = None
+            if pname in info.params:
+                pos = info.params.index(pname)
+                if pos < len(node.args):
+                    expr = node.args[pos]
+            for kw in node.keywords:
+                if kw.arg == pname:
+                    expr = kw.value
+            if isinstance(expr, ast.Name):
+                donated_vars.append(expr.id)
+        if not donated_vars:
+            continue
+        call_line = node.end_lineno or node.lineno
+        for var in donated_vars:
+            # a Store ON the call line is the donating call's own target
+            # (`buf = update(buf, ...)`): the name is rebound immediately
+            rebound_at = None
+            for later in ast.walk(fn):
+                if (
+                    isinstance(later, ast.Name)
+                    and later.id == var
+                    and isinstance(later.ctx, ast.Store)
+                    and later.lineno >= node.lineno
+                ):
+                    line = later.lineno
+                    if rebound_at is None or line < rebound_at:
+                        rebound_at = line
+            for later in _stmts_after(mod, fn, call_line):
+                if (
+                    isinstance(later, ast.Name)
+                    and later.id == var
+                    and isinstance(later.ctx, ast.Load)
+                    and (rebound_at is None or later.lineno <= rebound_at)
+                ):
+                    yield mod.finding(
+                        "JL008",
+                        later,
+                        f"'{var}' was donated to '{info.qualname}' at line "
+                        f"{node.lineno} and read again here — its buffer "
+                        "is invalid after the call",
+                        "reorder reads before the donating call, or drop "
+                        "donation for this argument",
+                    )
+                    break

@@ -1,0 +1,156 @@
+"""JL004 / JL005: dtype discipline.
+
+JL004 — unpinned array constructors. ``jnp.arange(L)`` is int32 on TPU and
+int64 under the CPU test tier's x64 mode; ``jnp.zeros(n)`` flips float32 /
+float64 the same way. A kernel whose internal dtypes depend on ambient
+config produces different programs per backend — the JAX analogue of the
+reference's implicit SQL type coercion. Constructors must pin ``dtype=``
+(or derive it from an input's ``.dtype``).
+
+JL005 — explicit float64 in device code. float64 does not exist on TPU and
+doubles every HBM byte elsewhere; the only sanctioned uses are gated on the
+x64/f64 mode switch (the CPU oracle tier), which the rule recognises by the
+gate's condition mentioning x64/f64. Host-side numpy float64 (pandas
+interop) is out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import rule
+
+# constructors whose default dtype follows ambient x64 config:
+# name -> number of positional args that, when present, include the dtype
+_CTORS_ALWAYS = {
+    "jax.numpy.zeros": 2,
+    "jax.numpy.ones": 2,
+    "jax.numpy.empty": 2,
+    "jax.numpy.arange": 4,
+    "jax.numpy.linspace": 6,
+}
+# constructors that inherit the dtype of an array argument — only unpinned
+# when fed a bare Python literal
+_CTORS_LITERAL = {
+    "jax.numpy.array": 2,
+    "jax.numpy.asarray": 2,
+    "jax.numpy.full": 3,
+}
+
+_NUMERIC_ATTRS = {
+    "jax.numpy.nan",
+    "jax.numpy.inf",
+    "jax.numpy.pi",
+    "numpy.nan",
+    "numpy.inf",
+    "numpy.pi",
+    "math.nan",
+    "math.inf",
+    "math.pi",
+}
+
+_F64_ATTRS = {"jax.numpy.float64", "jax.numpy.complex128"}
+_NP_F64 = {"numpy.float64", "numpy.complex128"}
+
+
+def _is_numeric_literal(mod, node: ast.expr) -> bool:
+    """A bare Python number (or container of them) whose jnp dtype would be
+    decided by ambient config rather than by data."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) and not isinstance(
+            node.value, bool
+        )
+    if isinstance(node, ast.UnaryOp):
+        return _is_numeric_literal(mod, node.operand)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_is_numeric_literal(mod, e) for e in node.elts)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("float", "int")
+    if isinstance(node, (ast.Attribute, ast.Name)):
+        return mod.canonical(node) in _NUMERIC_ATTRS
+    return False
+
+
+def _has_dtype(call: ast.Call, positional_cutoff: int) -> bool:
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    return len(call.args) >= positional_cutoff
+
+
+@rule(
+    "JL004",
+    "array constructor without a pinned dtype",
+    "default dtypes follow ambient x64 config; pin dtype= explicitly",
+)
+def check_unpinned_ctors(mod):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func)
+        if canon in _CTORS_ALWAYS:
+            if not _has_dtype(node, _CTORS_ALWAYS[canon]):
+                yield mod.finding(
+                    "JL004",
+                    node,
+                    f"{canon} without an explicit dtype (int64/float64 "
+                    "under x64, int32/float32 otherwise)",
+                    "pass dtype= (e.g. jnp.int32 / an input's .dtype)",
+                )
+        elif canon in _CTORS_LITERAL:
+            if not _has_dtype(node, _CTORS_LITERAL[canon]):
+                value = node.args[-1] if node.args else None
+                if value is not None and _is_numeric_literal(mod, value):
+                    yield mod.finding(
+                        "JL004",
+                        node,
+                        f"{canon} of a bare Python number without dtype "
+                        "(promotes to float64/int64 under x64)",
+                        "pass dtype= or use a typed scalar "
+                        "(jnp.float32(x))",
+                    )
+
+
+@rule(
+    "JL005",
+    "explicit float64 in device code",
+    "float64 is absent on TPU and doubles HBM elsewhere; gate on x64 mode",
+)
+def check_float64(mod):
+    for node in ast.walk(mod.tree):
+        canon = (
+            mod.canonical(node)
+            if isinstance(node, (ast.Attribute, ast.Name))
+            else None
+        )
+        if canon in _F64_ATTRS:
+            # comparing a dtype AGAINST float64 (mode tests like
+            # `float_dtype == jnp.float64`) creates no f64 data
+            if isinstance(mod.parents.get(node), ast.Compare):
+                continue
+            if not mod.x64_gated(node):
+                yield mod.finding(
+                    "JL005",
+                    node,
+                    f"{canon} outside an x64-mode gate",
+                    "derive the dtype from an input, or gate on "
+                    "jax.config.jax_enable_x64 (f64 oracle tier)",
+                )
+        elif canon in _NP_F64:
+            # numpy float64 is host-side business as usual; only flag it
+            # when fed into a device-namespace call in a traced function
+            parent = mod.parents.get(node)
+            fn = mod.enclosing_fn(node)
+            info = mod.fns.get(fn) if fn is not None else None
+            if (
+                info is not None
+                and info.traced
+                and isinstance(parent, ast.Call)
+                and mod.is_device_ns(mod.canonical(parent.func))
+                and not mod.x64_gated(node)
+            ):
+                yield mod.finding(
+                    "JL005",
+                    node,
+                    f"{canon} passed into device code outside an x64 gate",
+                    "use jnp dtypes derived from inputs, or gate on x64",
+                )

@@ -1,0 +1,119 @@
+"""JL001 / JL003: host-library calls and host syncs on traced values.
+
+Inside a traced function, ``np.sum(x)`` on a traced ``x`` either fails at
+trace time or — worse, via ``__array__`` — silently pulls the value to the
+host, baking it into the program as a constant. ``float(x)`` / ``.item()``
+block until the device catches up: inside a hot path that is a full
+pipeline stall per call (the reference's per-row JVM UDF round-trip, in
+JAX clothing). Both rules only fire when an argument provably references a
+traced name, so host-side trace-time computation (settings parsing, layout
+construction) stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import rule
+
+# numpy.asarray / numpy.array are host syncs (JL003's subject), not host
+# compute — keep the two rules disjoint so a finding maps to one hazard.
+_SYNC_NP = {"numpy.asarray", "numpy.array"}
+
+
+def _own_nodes(mod, fn_node):
+    for node in ast.walk(fn_node):
+        if node is not fn_node and mod.enclosing_fn(node) is fn_node:
+            yield node
+
+
+def _traced_arg(mod, call: ast.Call, traced: frozenset) -> bool:
+    names = set(traced)
+    return any(
+        mod._mentions_traced(a, names) for a in call.args
+    ) or any(mod._mentions_traced(kw.value, names) for kw in call.keywords)
+
+
+@rule(
+    "JL001",
+    "host numpy/math call on a traced value",
+    "np./math. calls inside jitted code sync or constant-fold traced arrays",
+)
+def check_host_calls(mod):
+    for info in mod.fns.values():
+        if not info.traced:
+            continue
+        for node in _own_nodes(mod, info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = mod.canonical(node.func)
+            if canon is None or canon in _SYNC_NP:
+                continue
+            if canon.startswith("numpy.") or canon.startswith("math."):
+                if _traced_arg(mod, node, info.traced_names):
+                    yield mod.finding(
+                        "JL001",
+                        node,
+                        f"{canon} called on a traced value inside traced "
+                        f"function '{info.qualname}'",
+                        "use the jnp/lax equivalent so the op stays in the "
+                        "compiled program",
+                    )
+
+
+@rule(
+    "JL003",
+    "host sync on a traced value",
+    "float()/int()/.item()/np.asarray() on traced values stall the pipeline",
+)
+def check_host_syncs(mod):
+    for info in mod.fns.values():
+        if not info.traced:
+            continue
+        for node in _own_nodes(mod, info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            # float(x) / int(x) / bool(x) on a traced x
+            if isinstance(node.func, ast.Name) and node.func.id in (
+                "float",
+                "int",
+                "bool",
+            ):
+                if node.func.id not in mod.aliases and _traced_arg(
+                    mod, node, info.traced_names
+                ):
+                    yield mod.finding(
+                        "JL003",
+                        node,
+                        f"{node.func.id}() forces a host sync on a traced "
+                        f"value inside traced function '{info.qualname}'",
+                        "keep the value on device (jnp scalar) or compute "
+                        "it outside the traced function",
+                    )
+                continue
+            canon = mod.canonical(node.func)
+            if canon in _SYNC_NP:
+                if _traced_arg(mod, node, info.traced_names):
+                    yield mod.finding(
+                        "JL003",
+                        node,
+                        f"{canon} transfers a traced value to host inside "
+                        f"traced function '{info.qualname}'",
+                        "operate on the device array directly (jnp.*)",
+                    )
+                continue
+            # x.item() / x.tolist() where x references a traced name
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("item", "tolist")
+                and mod._mentions_traced(
+                    node.func.value, set(info.traced_names)
+                )
+            ):
+                yield mod.finding(
+                    "JL003",
+                    node,
+                    f".{node.func.attr}() forces a host sync on a traced "
+                    f"value inside traced function '{info.qualname}'",
+                    "return the device scalar and read it after dispatch",
+                )

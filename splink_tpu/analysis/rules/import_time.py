@@ -1,0 +1,60 @@
+"""JL006: device work at module import time.
+
+A module-level ``jnp.zeros(...)`` (or ``jax.devices()``) initialises the
+backend as a side effect of ``import`` — before the application configures
+platforms, meshes or distributed state. In this codebase that ordering bug
+is fatal: tests pin the process to CPU *before* jax initialises
+(tests/conftest.py), and the linker selects platforms at runtime. Module
+scope may *define* traceable callables (``jax.vmap(fn)`` wraps lazily) but
+must not execute device ops.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import rule
+
+# jax.* calls that touch or initialise the backend
+_BACKEND_CALLS = {
+    "jax.device_put",
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.default_backend",
+    "jax.process_index",
+    "jax.process_count",
+    "jax.block_until_ready",
+}
+
+
+@rule(
+    "JL006",
+    "device work at module import time",
+    "module-level jnp/backend calls initialise the device on import",
+)
+def check_import_time(mod):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if mod.enclosing_fn(node) is not None:
+            continue  # inside a function: runs when called, not on import
+        canon = mod.canonical(node.func)
+        if canon is None:
+            continue
+        if canon.startswith("jax.numpy.") or canon.startswith("jax.lax."):
+            yield mod.finding(
+                "JL006",
+                node,
+                f"module-level {canon} call runs device work at import time",
+                "move it inside a function or cache it lazily",
+            )
+        elif canon in _BACKEND_CALLS:
+            yield mod.finding(
+                "JL006",
+                node,
+                f"module-level {canon} initialises the JAX backend at "
+                "import time",
+                "defer backend probes until first use",
+            )

@@ -1,0 +1,109 @@
+"""JL007: jit recompilation hazards.
+
+``jax.jit`` caches compiled executables on the *wrapper object*: a wrapper
+built inside a loop (``jax.jit(f)(x)`` per iteration) starts with an empty
+cache every time, so every iteration pays a full trace + XLA compile — the
+JAX analogue of the reference re-planning its SQL per EM iteration, which
+this codebase exists to avoid (em.py keeps ONE compiled program). Passing a
+loop-varying Python value as a *static* argument recompiles the same way:
+each distinct value is a new cache key.
+
+The repo-sanctioned patterns are module-level jit (one wrapper per process),
+jit in ``__init__`` stored on ``self`` (one per program object), or an
+``lru_cache``'d factory (term_frequencies._device_token_stats_fn).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import rule
+
+
+def _jit_call(mod, node: ast.Call) -> bool:
+    canon = mod.canonical(node.func)
+    if canon == "jax.jit":
+        return True
+    # functools.partial(jax.jit, ...) builds the wrapper just the same
+    if canon == "functools.partial" and node.args:
+        return mod.canonical(node.args[0]) == "jax.jit"
+    return False
+
+
+@rule(
+    "JL007",
+    "jit wrapper rebuilt or static arg varied per call",
+    "a fresh jit wrapper (or a varying static arg) recompiles every time",
+)
+def check_recompile(mod):
+    by_name = {}
+    for info in mod.fns.values():
+        if info.static_params:
+            by_name.setdefault(info.node.name, info)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # jax.jit(f)(args): wrapper born and discarded in one expression.
+        # partial(jax.jit, ...)(f) is NOT this — the outer call there
+        # *constructs* the wrapper (the repo's mesh-sharding idiom).
+        if (
+            isinstance(node.func, ast.Call)
+            and mod.canonical(node.func.func) == "jax.jit"
+            and node.func.args
+        ):
+            yield mod.finding(
+                "JL007",
+                node,
+                "jax.jit(...) called immediately — the wrapper (and its "
+                "compile cache) is discarded after one call",
+                "bind the jitted wrapper once (module level / __init__ / "
+                "lru_cache) and reuse it",
+            )
+            continue
+        # jit wrapper constructed inside a loop body
+        if _jit_call(mod, node) and mod.in_loop(node) is not None:
+            yield mod.finding(
+                "JL007",
+                node,
+                "jax.jit wrapper constructed inside a loop — each "
+                "iteration starts with an empty compile cache",
+                "hoist the jit() call out of the loop",
+            )
+            continue
+        # known-jitted callee fed a loop-varying value in a static arg
+        info = (
+            by_name.get(node.func.id)
+            if isinstance(node.func, ast.Name)
+            else None
+        )
+        if info is None:
+            continue
+        loop = mod.in_loop(node)
+        if loop is None or not isinstance(loop, ast.For):
+            continue
+        loop_names = {
+            n.id for n in ast.walk(loop.target) if isinstance(n, ast.Name)
+        }
+        static_args = {}
+        for i, arg in enumerate(node.args):
+            if i < len(info.params) and info.params[i] in info.static_params:
+                static_args[info.params[i]] = arg
+        for kw in node.keywords:
+            if kw.arg in info.static_params:
+                static_args[kw.arg] = kw.value
+        for pname, expr in static_args.items():
+            if any(
+                isinstance(n, ast.Name) and n.id in loop_names
+                for n in ast.walk(expr)
+            ):
+                yield mod.finding(
+                    "JL007",
+                    node,
+                    f"static argument '{pname}' of jitted "
+                    f"'{info.qualname}' varies with loop variable(s) "
+                    f"{sorted(loop_names)} — one recompile per distinct "
+                    "value",
+                    "make the argument traced, or hoist distinct values "
+                    "out of the loop",
+                )

@@ -1,0 +1,534 @@
+"""trace_audit: jaxpr-level audit of the declared kernel registry (layer 2).
+
+The AST linter sees what the source *says*; this layer checks what the
+compiler will actually *run*. Every kernel in the registry — the EM step,
+the gamma batch, the string kernels, the TF adjustment, the streamed pass —
+is traced with abstract-shaped example inputs and its jaxpr is asserted
+against four invariants:
+
+  TA-CONST     no embedded constant above a size budget. A closed-over
+               numpy/device array becomes a jaxpr constant serialised into
+               every compile request — observed as HTTP 413 from the
+               tunnelled TPU remote-compile at ~4M rows (gammas.py keeps
+               the packed table an explicit argument for exactly this
+               reason; the audit pins that design).
+  TA-DTYPE     no strong dtype wider than float32/int32 (weak-typed Python
+               scalars are exempt — they adapt to their operand's dtype).
+               Kernels are traced with x64 FORCED ON (enable_x64), which is
+               what makes the check a leak detector: any internal f64/i64
+               means a constructor derives its dtype from ambient config
+               instead of from inputs, and would behave differently across
+               backends. The CLI therefore catches the same leaks the x64
+               test tier does.
+  TA-CALLBACK  no host callback other than the declared ones (the EM
+               checkpoint hook's ordered io_callback is the single
+               sanctioned host round-trip in the hot loop).
+  TA-HASH      identical jaxpr across two independent traces — a trace that
+               differs run-to-run (dict-order iteration, fresh closures)
+               defeats jit caching and reproducibility.
+
+Registering a kernel::
+
+    @register_kernel("my_kernel", allow_callbacks=("io_callback",))
+    def _build_my_kernel():
+        fn = ...            # callable to trace
+        args = (...)        # example inputs (small shapes; dtypes matter)
+        return fn, args, {}
+
+The builder runs lazily inside :func:`run_audit` so importing this module
+stays cheap and the registry can reference heavyweight modules.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass
+from typing import Callable
+
+from .findings import Finding
+
+# dtypes a production (TPU-regime) kernel may hold internally
+DEFAULT_ALLOWED_DTYPES = frozenset(
+    {"float32", "int32", "int8", "int16", "uint8", "uint16", "uint32", "bool"}
+)
+
+# primitives that cross to the host
+_CALLBACK_PRIMS = {
+    "io_callback",
+    "pure_callback",
+    "callback",
+    "debug_callback",
+    "debug_print",
+}
+
+DEFAULT_CONST_BUDGET = 1 << 16  # 64 KiB per embedded constant
+
+
+@dataclass
+class KernelSpec:
+    name: str
+    build: Callable  # () -> (fn, args, kwargs)
+    allow_dtypes: frozenset = DEFAULT_ALLOWED_DTYPES
+    allow_callbacks: tuple = ()
+    const_budget_bytes: int = DEFAULT_CONST_BUDGET
+
+
+REGISTRY: dict[str, KernelSpec] = {}
+
+
+def register_kernel(
+    name: str,
+    *,
+    allow_dtypes=None,
+    allow_callbacks=(),
+    const_budget_bytes: int = DEFAULT_CONST_BUDGET,
+):
+    """Declare one kernel for auditing; the decorated builder returns
+    ``(fn, example_args, example_kwargs)`` and runs lazily."""
+
+    def deco(build: Callable) -> Callable:
+        if name in REGISTRY:
+            raise ValueError(f"duplicate kernel name {name!r}")
+        REGISTRY[name] = KernelSpec(
+            name=name,
+            build=build,
+            allow_dtypes=(
+                DEFAULT_ALLOWED_DTYPES
+                if allow_dtypes is None
+                else frozenset(allow_dtypes)
+            ),
+            allow_callbacks=tuple(allow_callbacks),
+            const_budget_bytes=const_budget_bytes,
+        )
+        return build
+
+    return deco
+
+
+def _iter_jaxprs(jaxpr):
+    """The jaxpr and every sub-jaxpr reachable through eqn params."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for value in eqn.params.values():
+            for sub in _as_jaxprs(value):
+                yield from _iter_jaxprs(sub)
+
+
+def _as_jaxprs(value):
+    import jax.core
+
+    if isinstance(value, jax.core.ClosedJaxpr):
+        yield value.jaxpr
+    elif isinstance(value, jax.core.Jaxpr):
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _as_jaxprs(v)
+
+
+def _iter_closed_consts(closed):
+    """(const, owner) pairs for the closed jaxpr and nested closed jaxprs."""
+    import jax.core
+
+    for c in closed.consts:
+        yield c
+    for jaxpr in _iter_jaxprs(closed.jaxpr):
+        for eqn in jaxpr.eqns:
+            for value in eqn.params.values():
+                stack = [value]
+                while stack:
+                    v = stack.pop()
+                    if isinstance(v, jax.core.ClosedJaxpr):
+                        for c in v.consts:
+                            yield c
+                    elif isinstance(v, (tuple, list)):
+                        stack.extend(v)
+
+
+def audit_kernel(spec: KernelSpec) -> list[Finding]:
+    """Trace one registered kernel and check the four invariants."""
+    import jax
+    import numpy as np
+
+    findings: list[Finding] = []
+
+    def fail(check: str, message: str, hint: str = "") -> None:
+        findings.append(
+            Finding(rule=check, path=spec.name, line=0, message=message, hint=hint)
+        )
+
+    from jax.experimental import enable_x64
+
+    try:
+        # Trace under x64 REGARDLESS of ambient config: unpinned
+        # constructors only reveal themselves as int64/float64 when x64 is
+        # on, so without this the CLI (`make lint`, x64 off) would pass a
+        # kernel that the x64 test tier rejects.
+        with enable_x64():
+            fn, args, kwargs = spec.build()
+            # Each trace goes through a FRESH wrapper object AND the jit
+            # trace caches are dropped in between: jax caches traces on
+            # function identity (for jit-wrapped kernels even a fresh outer
+            # lambda still hits pjit's cached inner jaxpr), so without both
+            # steps the determinism check would compare a value with
+            # itself.
+            closed = jax.make_jaxpr(lambda *a, **k: fn(*a, **k))(
+                *args, **kwargs
+            )
+            jax.clear_caches()
+            closed2 = jax.make_jaxpr(lambda *a, **k: fn(*a, **k))(
+                *args, **kwargs
+            )
+    except Exception as e:  # noqa: BLE001 - any trace failure is a finding
+        fail("TA-ERROR", f"kernel failed to trace: {type(e).__name__}: {e}")
+        return findings
+
+    # (a) embedded-constant budget
+    for const in _iter_closed_consts(closed):
+        arr = np.asarray(const) if hasattr(const, "shape") else None
+        if arr is None:
+            continue
+        if arr.nbytes > spec.const_budget_bytes:
+            fail(
+                "TA-CONST",
+                f"embedded constant {arr.shape} {arr.dtype} "
+                f"({arr.nbytes} bytes) exceeds the "
+                f"{spec.const_budget_bytes}-byte budget",
+                "pass the array as an explicit argument instead of closing "
+                "over it (it is serialised into every compile request)",
+            )
+
+    # (b) dtype-width audit and (c) callback allowlist, one jaxpr walk
+    bad_dtypes: dict[str, set[str]] = {}
+    for jaxpr in _iter_jaxprs(closed.jaxpr):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim in _CALLBACK_PRIMS and prim not in spec.allow_callbacks:
+                fail(
+                    "TA-CALLBACK",
+                    f"undeclared host callback primitive '{prim}' in the "
+                    "compiled program",
+                    "declare it in the kernel's allow_callbacks, or remove "
+                    "the host round-trip",
+                )
+            for var in (*eqn.invars, *eqn.outvars):
+                aval = getattr(var, "aval", None)
+                dtype = getattr(aval, "dtype", None)
+                if dtype is None:
+                    continue  # tokens etc.
+                if getattr(aval, "weak_type", False):
+                    continue  # Python scalars adapt to their operands
+                name = dtype.name
+                if name not in spec.allow_dtypes:
+                    bad_dtypes.setdefault(name, set()).add(prim)
+    for name, prims in sorted(bad_dtypes.items()):
+        shown = ", ".join(sorted(prims)[:6])
+        fail(
+            "TA-DTYPE",
+            f"dtype {name} appears in the traced program (primitives: "
+            f"{shown}) but is not in the kernel's allowed set "
+            f"{sorted(spec.allow_dtypes)}",
+            "pin the constructor/accumulator dtype (dtype=jnp.int32 / "
+            "float32) or allowlist it for this kernel",
+        )
+
+    # (d) trace determinism. Callback primitives print their wrapper
+    # object's repr (a fresh address per trace); normalise addresses away
+    # so only STRUCTURAL differences — changed constants, reordered eqns —
+    # fail the check.
+    def jaxpr_hash(c):
+        text = re.sub(r"0x[0-9a-f]+", "0x", str(c.jaxpr))
+        return hashlib.sha256(text.encode()).hexdigest()
+
+    h1 = jaxpr_hash(closed)
+    h2 = jaxpr_hash(closed2)
+    if h1 != h2:
+        fail(
+            "TA-HASH",
+            f"two traces produced different jaxprs ({h1[:12]} vs {h2[:12]})",
+            "remove trace-order nondeterminism (unordered dict/set "
+            "iteration, per-call closures) from the kernel",
+        )
+    return findings
+
+
+def run_audit(names=None) -> tuple[list[Finding], int]:
+    """Audit the given kernels (default: all). Returns (findings, count)."""
+    _ensure_default_registry()
+    if names:
+        unknown = [n for n in names if n not in REGISTRY]
+        if unknown:
+            raise KeyError(f"unknown kernel(s): {', '.join(unknown)}")
+        specs = [REGISTRY[n] for n in names]
+    else:
+        specs = [REGISTRY[n] for n in sorted(REGISTRY)]
+    findings: list[Finding] = []
+    for spec in specs:
+        findings.extend(audit_kernel(spec))
+    return findings, len(specs)
+
+
+# ---------------------------------------------------------------------------
+# Default registry: the pipeline's hot kernels.
+# ---------------------------------------------------------------------------
+
+_defaults_registered = False
+
+
+def _ensure_default_registry() -> None:
+    global _defaults_registered
+    if _defaults_registered:
+        return
+    _defaults_registered = True
+
+    def _fs_inputs():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.fellegi_sunter import FSParams
+
+        rng = np.random.default_rng(0)
+        G = jnp.asarray(
+            rng.integers(-1, 3, size=(128, 3)).astype(np.int8)
+        )
+        params = FSParams(
+            lam=jnp.float32(0.3),
+            m=jnp.asarray(
+                np.full((3, 3), 1.0 / 3, np.float32)
+            ),
+            u=jnp.asarray(
+                np.full((3, 3), 1.0 / 3, np.float32)
+            ),
+        )
+        return G, params
+
+    # make_jaxpr would trace every argument, including the jit wrapper's
+    # static ones — each builder therefore closes the statics into a lambda
+    # and exposes ONLY the traced arguments
+
+    @register_kernel("em_step")
+    def _build_em_step():
+        import jax.numpy as jnp
+
+        from ..em import run_em
+
+        G, params = _fs_inputs()
+        fn = lambda G, p, tol: run_em(  # noqa: E731
+            G,
+            p,
+            max_iterations=4,
+            max_levels=3,
+            em_convergence=tol,
+            compute_ll=True,
+        )
+        return fn, (G, params, jnp.float32(1e-4)), {}
+
+    # host_hook=True is the checkpoint path: exactly one declared
+    # io_callback may cross to the host per update, nothing else
+    @register_kernel("em_step_checkpointed", allow_callbacks=("io_callback",))
+    def _build_em_step_hooked():
+        import jax.numpy as jnp
+
+        from ..em import run_em
+
+        G, params = _fs_inputs()
+        fn = lambda G, p, tol: run_em(  # noqa: E731
+            G,
+            p,
+            max_iterations=4,
+            max_levels=3,
+            em_convergence=tol,
+            compute_ll=True,
+            host_hook=True,
+        )
+        return fn, (G, params, jnp.float32(1e-4)), {}
+
+    @register_kernel("streamed_pass")
+    def _build_streamed_pass():
+        from ..parallel.streaming import _batch_stats
+
+        G, params = _fs_inputs()
+        fn = lambda G, p: _batch_stats(  # noqa: E731
+            G, p, 3, None, True
+        )
+        return fn, (G, params), {}
+
+    @register_kernel("score_pairs")
+    def _build_score_pairs():
+        from ..em import score_pairs
+
+        G, params = _fs_inputs()
+        return score_pairs, (G, params), {}
+
+    # one shared program for the three gamma-family specs (builders use it
+    # read-only; rebuilding costs encode_table + program construction each)
+    import functools
+
+    @functools.lru_cache(maxsize=1)
+    def _gamma_program():
+        import jax.numpy as jnp
+        import pandas as pd
+
+        from ..data import encode_table
+        from ..gammas import GammaProgram
+        from ..settings import complete_settings_dict
+
+        df = pd.DataFrame(
+            {
+                "unique_id": range(6),
+                "name": ["martha", "marhta", "mx", None, "anna", "bob"],
+                "city": ["x", "y", "x", "y", None, "x"],
+                "amount": [1.0, 1.01, 5.0, None, 2.0, 3.0],
+            }
+        )
+        settings = complete_settings_dict(
+            {
+                "link_type": "dedupe_only",
+                "comparison_columns": [
+                    {"col_name": "name", "num_levels": 3},
+                    {
+                        "col_name": "city",
+                        "num_levels": 2,
+                        "comparison": {"kind": "exact"},
+                    },
+                    {
+                        "col_name": "amount",
+                        "data_type": "numeric",
+                        "num_levels": 3,
+                        "comparison": {
+                            "kind": "numeric_perc",
+                            "thresholds": [0.01, 0.2],
+                        },
+                    },
+                ],
+                "blocking_rules": ["l.unique_id = r.unique_id"],
+            }
+        )
+        table = encode_table(df, settings)
+        program = GammaProgram(settings, table, float_dtype=jnp.float32)
+        return program
+
+    @register_kernel("gamma_batch")
+    def _build_gamma_batch():
+        import jax.numpy as jnp
+        import numpy as np
+
+        program = _gamma_program()
+        il = jnp.asarray(np.zeros(256, np.int32))
+        ir = jnp.asarray(np.ones(256, np.int32))
+        # packed table as an explicit argument — the no-embedded-constant
+        # design TA-CONST pins (a closure capture here would blow the budget
+        # at real row counts)
+        return program._gamma_batch_fn, (program._packed, il, ir), {}
+
+    @register_kernel("pattern_kernel")
+    def _build_pattern_kernel():
+        import jax.numpy as jnp
+        import numpy as np
+
+        program = _gamma_program()
+        il = jnp.asarray(np.zeros(256, np.int32))
+        ir = jnp.asarray(np.ones(256, np.int32))
+        acc = jnp.zeros(program.n_patterns + 1, jnp.int32)
+        valid = jnp.int32(200)
+        return program._pattern_kernel, (program._packed, il, ir, valid, acc), {}
+
+    @register_kernel("virtual_pattern_kernel")
+    def _build_virtual_pattern():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..pairgen import make_virtual_pattern_fn
+
+        program = _gamma_program()
+        bs = 128
+        fn = make_virtual_pattern_fn(
+            program, bs, n_prev=0, has_uid_mask=False
+        )
+        imax = np.int32(np.iinfo(np.int32).max)
+        pos = jnp.arange(bs, dtype=jnp.int32)
+        order = jnp.asarray(np.arange(6, dtype=np.int32))
+        units = jnp.asarray(np.zeros(4, np.int32))
+        lens = jnp.asarray(np.full(4, 3, np.int32))
+        # meta row layout: [u0, valid, pc_rel... (power-of-two padded with
+        # int32 max)] — values are irrelevant to the trace, shapes/dtypes
+        # are what the audit checks
+        meta = jnp.asarray(
+            np.array([0, bs, 0, imax, imax, imax], np.int32)
+        )
+        acc = jnp.asarray(np.zeros(program.n_patterns + 2, np.int32))
+        prev_codes = jnp.asarray(np.zeros((1, 6), np.int32))
+        uid_codes = jnp.asarray(np.zeros(6, np.int32))
+        return (
+            fn,
+            (
+                pos,
+                program._packed,
+                order,
+                units,
+                lens,
+                units,
+                lens,
+                prev_codes,
+                uid_codes,
+                (),
+                meta,
+                acc,
+            ),
+            {},
+        )
+
+    @register_kernel("jaro_winkler")
+    def _build_jw():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops import strings
+
+        rng = np.random.default_rng(0)
+        s = jnp.asarray(rng.integers(97, 123, size=(64, 24)).astype(np.uint8))
+        ln = jnp.asarray(np.full(64, 8, np.int32))
+        return (
+            strings.jaro_winkler_vmapped,
+            (s, s, ln, ln, jnp.float32(0.1), jnp.float32(0.7)),
+            {},
+        )
+
+    @register_kernel("levenshtein")
+    def _build_lev():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops import strings
+
+        rng = np.random.default_rng(0)
+        s = jnp.asarray(rng.integers(97, 123, size=(64, 24)).astype(np.uint8))
+        ln = jnp.asarray(np.full(64, 8, np.int32))
+        return strings.levenshtein_ratio_vmapped, (s, s, ln, ln), {}
+
+    @register_kernel("tf_adjustment")
+    def _build_tf():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..term_frequencies import _device_token_stats_fn
+
+        n_seg = 256
+        tid = jnp.asarray(np.zeros(512, np.int32))
+        p = jnp.zeros(512, jnp.float32)
+        sums = jnp.zeros(n_seg, jnp.float32)
+        counts = jnp.zeros(n_seg, jnp.float32)
+        return _device_token_stats_fn(n_seg), (tid, tid, p, sums, counts), {}
+
+    @register_kernel("tf_gather")
+    def _build_tf_gather():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..term_frequencies import _device_token_gather_fn
+
+        n_seg = 256
+        tid = jnp.asarray(np.zeros(512, np.int32))
+        adjusted = jnp.zeros(n_seg, jnp.float32)
+        return _device_token_gather_fn(n_seg), (tid, tid, adjusted), {}

@@ -181,6 +181,18 @@ class _CharsetField:
         self.space_lane = space_lane
 
 
+def int32_histogram(ids, length: int):
+    """``jnp.bincount(ids, length=length)`` with the count dtype pinned to
+    int32 via an explicit scatter-add — bincount counts in int64 under x64,
+    while the device pattern-histogram accumulators are int32 BY PROTOCOL
+    (partial sums stay below 2^30 and flush to host int64 every
+    _HIST_FLUSH_BATCHES batches). Out-of-range ids drop, matching bincount
+    on in-range input. The single histogram used by every pattern kernel
+    (gamma pattern batch, host-G batch, pairgen's virtual twin) so the
+    dtype discipline cannot drift between them."""
+    return jnp.zeros(length, jnp.int32).at[ids].add(1, mode="drop")
+
+
 def pattern_ids_fit_uint16(n_patterns: int) -> bool:
     """True when every pattern id AND the mask sentinel (== n_patterns)
     fit uint16 — the single predicate deciding both the device-side
@@ -570,8 +582,18 @@ def _jw_two_phase(ctx: PairContext, pc: PairColumn, aux, thresholds):
     surv = (ub >= lowest - jw_bound.BOUND_MARGIN) & ~equal & ~pc.null
     b = surv.shape[0]
     cap = ctx.survivor_capacity(b)
-    pos = jnp.nonzero(surv, size=cap, fill_value=b)[0]
-    ctx.record_overflow(jnp.sum(surv) > cap)
+    # survivor compaction: pos[k] = index of the k-th True in surv, padded
+    # with b — jnp.nonzero(size=cap, fill_value=b) semantics, but built from
+    # an int32 cumsum-rank scatter because nonzero's internals run int64
+    # under x64 (ranks are unique so the scatter is deterministic; ranks
+    # >= cap drop, which matches nonzero's truncation)
+    rank = jnp.cumsum(surv, dtype=jnp.int32) - 1
+    pos = (
+        jnp.full((cap,), b, jnp.int32)
+        .at[jnp.where(surv, rank, cap)]
+        .set(jnp.arange(b, dtype=jnp.int32), mode="drop")
+    )
+    ctx.record_overflow(jnp.sum(surv, dtype=jnp.int32) > cap)
     posc = jnp.minimum(pos, b - 1)
     sim = string_ops.jaro_winkler(
         pc.chars_l[posc], pc.chars_r[posc],
@@ -869,13 +891,17 @@ class GammaProgram:
                 def _pattern_kernel(packed, idx_l, idx_r, valid, acc):
                     G, ovf = gamma_body(packed, idx_l, idx_r)
                     G = G.astype(jnp.int32)
-                    pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
+                    pid = jnp.sum(
+                        (G + 1) * strides_dev[None, :], axis=1, dtype=jnp.int32
+                    )
                     masked = jnp.where(
-                        jnp.arange(pid.shape[0]) < valid, pid, n_patterns
+                        jnp.arange(pid.shape[0], dtype=jnp.int32) < valid,
+                        pid,
+                        n_patterns,
                     )
                     ovf_flag = (ovf > 0).astype(jnp.int32)
-                    acc = acc + jnp.bincount(
-                        masked, length=n_patterns + 1
+                    acc = acc + int32_histogram(
+                        masked, n_patterns + 1
                     ) * (1 - ovf_flag)
                     if pattern_ids_fit_uint16(n_patterns):
                         # narrow on device: halves the per-batch D2H (all
@@ -1407,9 +1433,15 @@ def pattern_strides_for(level_counts: list[int]) -> tuple[list[int], int]:
 
 @functools.partial(jax.jit, static_argnames=("n_patterns",))
 def _pattern_counts_batch(G, valid, strides, n_patterns, acc):
-    pattern = jnp.sum((G.astype(jnp.int32) + 1) * strides[None, :], axis=1)
-    pattern = jnp.where(jnp.arange(pattern.shape[0]) < valid, pattern, n_patterns)
-    return acc + jnp.bincount(pattern, length=n_patterns + 1)
+    pattern = jnp.sum(
+        (G.astype(jnp.int32) + 1) * strides[None, :], axis=1, dtype=jnp.int32
+    )
+    pattern = jnp.where(
+        jnp.arange(pattern.shape[0], dtype=jnp.int32) < valid,
+        pattern,
+        n_patterns,
+    )
+    return acc + int32_histogram(pattern, n_patterns + 1)
 
 
 # Flush the device int32 histogram accumulator to the host int64 total at

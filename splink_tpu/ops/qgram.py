@@ -50,7 +50,10 @@ def _gram_codes(s, length, q: int):
     n_words = -(-(q * bpc) // 32)
     L = s.shape[0]
     n_windows = max(L - q + 1, 1)
-    win = jnp.arange(n_windows)[:, None] + jnp.arange(q)[None, :]
+    win = (
+        jnp.arange(n_windows, dtype=jnp.int32)[:, None]
+        + jnp.arange(q, dtype=jnp.int32)[None, :]
+    )
     grams = s[jnp.minimum(win, L - 1)].astype(jnp.uint32)  # (n_windows, q)
     words = [jnp.zeros(n_windows, jnp.uint32) for _ in range(n_words)]
     for k in range(q):
@@ -60,7 +63,9 @@ def _gram_codes(s, length, q: int):
         words[w] = words[w] | (g << bit)  # uint32 shift truncates high bits
         if bit + bpc > 32 and w + 1 < n_words:
             words[w + 1] = words[w + 1] | (g >> (32 - bit))
-    valid = jnp.arange(n_windows) < jnp.maximum(length - q + 1, 0)
+    valid = jnp.arange(n_windows, dtype=jnp.int32) < jnp.maximum(
+        length - q + 1, 0
+    )
     return jnp.stack(words, axis=1), valid
 
 
@@ -83,13 +88,24 @@ def qgram_jaccard_single(s1, s2, l1, l2, q: int = 2):
     """Exact set Jaccard of the two strings' distinct q-grams."""
     eq11, eq22, eq12, v1, v2 = _eq_matrices(s1, s2, l1, l2, q)
     # first-occurrence mask = the set of distinct grams
-    idx = jnp.arange(len(v1))
-    first1 = v1 & (jnp.sum(eq11 & (idx[None, :] < idx[:, None]), axis=1) == 0)
-    idx2 = jnp.arange(len(v2))
-    first2 = v2 & (jnp.sum(eq22 & (idx2[None, :] < idx2[:, None]), axis=1) == 0)
-    inter = jnp.sum(first1 & (jnp.sum(eq12, axis=1) > 0))
-    n1 = jnp.sum(first1)
-    n2 = jnp.sum(first2)
+    idx = jnp.arange(len(v1), dtype=jnp.int32)
+    first1 = v1 & (
+        jnp.sum(eq11 & (idx[None, :] < idx[:, None]), axis=1, dtype=jnp.int32)
+        == 0
+    )
+    idx2 = jnp.arange(len(v2), dtype=jnp.int32)
+    first2 = v2 & (
+        jnp.sum(
+            eq22 & (idx2[None, :] < idx2[:, None]), axis=1, dtype=jnp.int32
+        )
+        == 0
+    )
+    inter = jnp.sum(
+        first1 & (jnp.sum(eq12, axis=1, dtype=jnp.int32) > 0),
+        dtype=jnp.int32,
+    )
+    n1 = jnp.sum(first1, dtype=jnp.int32)
+    n2 = jnp.sum(first2, dtype=jnp.int32)
     union = n1 + n2 - inter
     return jnp.where(union > 0, inter / union, 0.0).astype(jnp.float32)
 
@@ -221,9 +237,9 @@ def qgram_jaccard_masked_single(s1, s2, l1, l2, m1, n1, n2, q: int = 2):
     device-side sums it replaces. (Only the LEFT mask is needed: inter
     counts s1's distinct grams present in s2; union = n1 + n2 - inter.)"""
     eq12, nw = _cross_eq(s1, s2, l1, l2, q)
-    idx = jnp.arange(nw)
+    idx = jnp.arange(nw, dtype=jnp.int32)
     first1 = ((m1[idx // 32] >> (idx % 32).astype(jnp.uint32)) & 1) == 1
-    inter = jnp.sum(first1 & eq12.any(axis=1))
+    inter = jnp.sum(first1 & eq12.any(axis=1), dtype=jnp.int32)
     union = n1 + n2 - inter
     return jnp.where(union > 0, inter / union, 0.0).astype(jnp.float32)
 
@@ -271,7 +287,7 @@ def charset_jaccard_single(s1, s2, l1, l2, q: int | None = None):
     golden test treats exact ties as ±0.01 and everything else as exact.
     """
     L = s1.shape[0]
-    idx = jnp.arange(L)
+    idx = jnp.arange(L, dtype=jnp.int32)
     va = idx < l1
     vb = idx < l2
     sp = jnp.asarray(ord(" "), s1.dtype)
@@ -287,9 +303,9 @@ def charset_jaccard_single(s1, s2, l1, l2, q: int | None = None):
     nsa = s1 != sp
     nsb = s2 != sp
     present_in_b = ((s1[:, None] == s2[None, :]) & vb[None, :]).any(axis=1)
-    inter_ns = jnp.sum(fa & nsa & present_in_b)
-    da = jnp.sum(fa & nsa)
-    db = jnp.sum(fb & nsb)
+    inter_ns = jnp.sum(fa & nsa & present_in_b, dtype=jnp.int32)
+    da = jnp.sum(fa & nsa, dtype=jnp.int32)
+    db = jnp.sum(fb & nsb, dtype=jnp.int32)
     space_a = ((s1 == sp) & va).any()
     space_b = ((s2 == sp) & vb).any()
     if q is not None:
@@ -344,15 +360,15 @@ def charset_jaccard_masked_single(
     than the widths the masks were built at — bits beyond the mask are
     absent and those positions are invalid anyway."""
     L1 = s1.shape[0]
-    idx = jnp.arange(L1)
+    idx = jnp.arange(L1, dtype=jnp.int32)
     lane = jnp.minimum(idx // 32, m1.shape[0] - 1)
     fns = (
         (((m1[lane] >> (idx % 32).astype(jnp.uint32)) & 1) == 1)
         & (idx < m1.shape[0] * 32)
     )
-    vb = jnp.arange(s2.shape[0]) < l2
+    vb = jnp.arange(s2.shape[0], dtype=jnp.int32) < l2
     present_in_b = ((s1[:, None] == s2[None, :]) & vb[None, :]).any(axis=1)
-    inter_ns = jnp.sum(fns & present_in_b)
+    inter_ns = jnp.sum(fns & present_in_b, dtype=jnp.int32)
     space_a = sp1 > 0
     space_b = sp2 > 0
     if q is not None:

@@ -57,7 +57,7 @@ def jaro_winkler_single(
     vmapped batch runs entirely on the VPU.
     """
     L = s1.shape[0]
-    idx = jnp.arange(L)
+    idx = jnp.arange(L, dtype=jnp.int32)
     l1 = l1.astype(jnp.int32)
     l2 = l2.astype(jnp.int32)
     # iterate the shorter string over the longer (jar matches() semantics)
@@ -74,26 +74,27 @@ def jaro_winkler_single(
         cand = (
             (b == ch) & (jnp.abs(idx - i) <= window) & valid_b & (~used_b) & (i < la)
         )
-        first = cand & (jnp.cumsum(cand) == 1)  # one-hot of first eligible j
+        # one-hot of first eligible j
+        first = cand & (jnp.cumsum(cand, dtype=jnp.int32) == 1)
         return used_b | first, first.any()
 
     used_b, matched_a = lax.scan(
         step, jnp.zeros(L, bool), (a, jnp.arange(L, dtype=jnp.int32))
     )
-    m = jnp.sum(matched_a).astype(jnp.int32)
+    m = jnp.sum(matched_a, dtype=jnp.int32)
 
     # Order-preserving compaction of each side's matched characters via a
     # rank-indicator matmul (MXU work, no scatters): seq[k] = sum_i
     # s[i] * [rank(i) == k], rank = prefix count of matches.
     def compact(s, matched):
-        rank = jnp.cumsum(matched) - 1
+        rank = jnp.cumsum(matched, dtype=jnp.int32) - 1
         ind = (rank[:, None] == idx[None, :]) & matched[:, None]  # (L, L)
         return (s.astype(jnp.float32) * matched) @ ind.astype(jnp.float32)
 
     seq1 = compact(a, matched_a)
     seq2 = compact(b, used_b)
     in_match = idx < m
-    mismatched = jnp.sum(((seq1 != seq2) & in_match).astype(jnp.int32))
+    mismatched = jnp.sum((seq1 != seq2) & in_match, dtype=jnp.int32)
 
     mf = _f(m)
     t = _f(mismatched // 2)  # Java integer division
@@ -103,8 +104,10 @@ def jaro_winkler_single(
         0.0,
     )
 
-    prefix_run = jnp.cumprod(((s1 == s2) & (idx < la)).astype(jnp.int32))
-    ell = jnp.sum(prefix_run).astype(jnp.float32)  # NOT capped (jar)
+    prefix_run = jnp.cumprod(
+        (s1 == s2) & (idx < la), dtype=jnp.int32
+    )
+    ell = jnp.sum(prefix_run, dtype=jnp.int32).astype(jnp.float32)  # NOT capped (jar)
     scale = jnp.minimum(prefix_scale, 1.0 / jnp.maximum(_f(lb), 1.0))
     boosted = jaro + ell * scale * (1.0 - jaro)
     return jnp.where(jaro < boost_threshold, jaro, boosted)
@@ -133,7 +136,7 @@ def jaro_winkler_bitmask_single(
         materialising both compacted sequences.
     """
     L = s1.shape[0]
-    idx = jnp.arange(L)
+    idx = jnp.arange(L, dtype=jnp.int32)
     l1 = l1.astype(jnp.int32)
     l2 = l2.astype(jnp.int32)
     swap = l1 > l2
@@ -170,17 +173,17 @@ def jaro_winkler_bitmask_single(
 
     used, firsts = lax.scan(step, jnp.uint32(0), masks)
     matched_a = firsts != 0
-    m = jnp.sum(matched_a).astype(jnp.int32)
+    m = jnp.sum(matched_a, dtype=jnp.int32)
 
     used_j = ((used >> idx.astype(jnp.uint32)) & 1).astype(jnp.int32)
-    rank1 = jnp.cumsum(matched_a.astype(jnp.int32)) - 1
-    rank2 = jnp.cumsum(used_j) - 1
+    rank1 = jnp.cumsum(matched_a, dtype=jnp.int32) - 1
+    rank2 = jnp.cumsum(used_j, dtype=jnp.int32) - 1
     aligned = (
         (rank1[:, None] == rank2[None, :])
         & matched_a[:, None]
         & (used_j[None, :] == 1)
     )
-    mismatched = jnp.sum(aligned & ~eq).astype(jnp.int32)
+    mismatched = jnp.sum(aligned & ~eq, dtype=jnp.int32)
 
     mf = _f(m)
     t = _f(mismatched // 2)  # Java integer division
@@ -190,8 +193,10 @@ def jaro_winkler_bitmask_single(
         0.0,
     )
 
-    prefix_run = jnp.cumprod(((s1 == s2) & (idx < la)).astype(jnp.int32))
-    ell = jnp.sum(prefix_run).astype(jnp.float32)  # NOT capped (jar)
+    prefix_run = jnp.cumprod(
+        (s1 == s2) & (idx < la), dtype=jnp.int32
+    )
+    ell = jnp.sum(prefix_run, dtype=jnp.int32).astype(jnp.float32)  # NOT capped (jar)
     scale = jnp.minimum(prefix_scale, 1.0 / jnp.maximum(_f(lb), 1.0))
     boosted = jaro + ell * scale * (1.0 - jaro)
     return jnp.where(jaro < boost_threshold, jaro, boosted)

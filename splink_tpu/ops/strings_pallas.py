@@ -38,7 +38,7 @@ TPU_BACKENDS = ("tpu", "axon")  # axon = tunnelled TPU plugin
 
 
 def _tril(L: int, strict: bool) -> jnp.ndarray:
-    r = jnp.arange(L)
+    r = jnp.arange(L, dtype=jnp.int32)
     return (r[:, None] > r[None, :] if strict else r[:, None] >= r[None, :]).astype(
         jnp.float32
     )

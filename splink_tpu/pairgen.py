@@ -68,7 +68,7 @@ from .blocking import (
     parse_blocking_rule,
 )
 from .data import EncodedTable
-from .gammas import pattern_ids_fit_uint16
+from .gammas import int32_histogram, pattern_ids_fit_uint16
 
 # Unit extent bound. 2048 keeps the triangle discriminant (2s-1)^2 < 2^24
 # (f32-exact) and a rectangle's pair count at 2048^2 ~ 4.2M (int32-safe);
@@ -1020,7 +1020,7 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
             marks = jnp.zeros(bs + 1, jnp.int32).at[idx].add(
                 jnp.where(starts < bs, 1, 0), mode="drop"
             )[:bs]
-            ui = jnp.cumsum(marks)
+            ui = jnp.cumsum(marks, dtype=jnp.int32)
         else:
             # under a mesh, pos arrives SHARDED along the batch axis; a
             # cumsum there would need cross-device prefix comms, so keep
@@ -1095,10 +1095,12 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
 
         G, ovf = gamma_fn(packed, i, j)
         G = G.astype(jnp.int32)
-        pid = jnp.sum((G + 1) * strides_dev[None, :], axis=1)
+        pid = jnp.sum(
+            (G + 1) * strides_dev[None, :], axis=1, dtype=jnp.int32
+        )
         pid = jnp.where(masked, n_patterns, pid)
         ovf_flag = (ovf > 0).astype(jnp.int32)
-        hist = jnp.bincount(pid, length=n_patterns + 1)
+        hist = int32_histogram(pid, n_patterns + 1)
         acc = acc.at[: n_patterns + 1].add(hist * (1 - ovf_flag))
         acc = acc.at[n_patterns + 1].add(ovf_flag)
         if pattern_ids_fit_uint16(n_patterns):

@@ -1,0 +1,18 @@
+"""JL001 bad twin: host numpy/math calls on traced values inside jit."""
+
+import math
+
+import jax
+import jax.numpy as jnp  # noqa: F401
+import numpy as np
+
+
+@jax.jit
+def bad_mean(x):
+    centred = x - np.mean(x)  # np reduction on a traced array
+    return centred * math.log(x)  # math call on a traced value
+
+
+@jax.jit
+def bad_but_suppressed(x):
+    return x - np.mean(x)  # jaxlint: disable=JL001

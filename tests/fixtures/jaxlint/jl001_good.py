@@ -1,0 +1,20 @@
+"""JL001 good twin: jnp ops on traced values, host math only on host
+constants (trace-time evaluation is fine)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def good_mean(x):
+    centred = x - jnp.mean(x)
+    scale = math.log(2.0)  # host constant folded at trace time
+    return centred * scale
+
+
+def host_helper(values):
+    # not traced: host-side numpy is business as usual
+    return np.mean(np.asarray(values))

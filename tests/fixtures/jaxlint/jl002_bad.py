@@ -1,0 +1,20 @@
+"""JL002 bad twin: Python control flow on traced values."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:  # Python branch on a traced scalar
+        x = x + 1
+    while jnp.max(x) > 0:  # Python loop on a traced reduction
+        x = x - 1
+    return x
+
+
+@jax.jit
+def bad_but_suppressed(x):
+    if x > 0:  # jaxlint: disable=JL002
+        x = x + 1
+    return x

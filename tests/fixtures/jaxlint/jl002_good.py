@@ -1,0 +1,18 @@
+"""JL002 good twin: static/structural branches and lax control flow."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("double",))
+def good_branch(x, weights=None, double=False):
+    if double:  # static argument: a trace-time constant
+        x = x * 2
+    if weights is not None:  # structural None check
+        x = x * weights
+    if x.shape[0] > 4:  # shapes are static under tracing
+        x = x + 1
+    return lax.cond(jnp.max(x) > 0, lambda v: v - 1, lambda v: v, x)

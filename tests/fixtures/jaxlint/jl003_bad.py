@@ -1,0 +1,18 @@
+"""JL003 bad twin: host syncs on traced values inside jit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_sync(x):
+    total = float(jnp.sum(x))  # blocks on the device inside the program
+    host = np.asarray(x)  # D2H transfer of a traced array
+    single = x.item()  # scalar sync
+    return total + host[0] + single
+
+
+@jax.jit
+def bad_but_suppressed(x):
+    return float(jnp.max(x))  # jaxlint: disable=JL003

@@ -1,0 +1,17 @@
+"""JL003 good twin: syncs happen in the host driver, after dispatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def device_total(x):
+    return jnp.sum(x)  # stays a device scalar
+
+
+def host_driver(x):
+    total = device_total(x)
+    # host-side read AFTER the compiled program returns: the one deliberate
+    # sync point, outside any traced function
+    return float(np.asarray(total))

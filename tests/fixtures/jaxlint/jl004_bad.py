@@ -1,0 +1,12 @@
+"""JL004 bad twin: array constructors whose dtype follows ambient config."""
+
+import jax.numpy as jnp
+
+
+def build():
+    idx = jnp.arange(8)  # int64 under x64, int32 otherwise
+    zeros = jnp.zeros(4)  # float64 under x64, float32 otherwise
+    half = jnp.asarray(0.5)  # bare float literal: weak f64 under x64
+    filled = jnp.full((3,), 1.5)  # bare float fill value
+    suppressed = jnp.arange(3)  # jaxlint: disable=JL004
+    return idx, zeros, half, filled, suppressed

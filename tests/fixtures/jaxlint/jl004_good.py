@@ -1,0 +1,13 @@
+"""JL004 good twin: every constructor pins its dtype (or inherits one)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def build():
+    idx = jnp.arange(8, dtype=jnp.int32)
+    zeros = jnp.zeros(4, jnp.float32)
+    half = jnp.asarray(0.5, jnp.float32)
+    filled = jnp.full((3,), 1.5, jnp.float32)
+    inherited = jnp.asarray(np.zeros(4, np.float32))  # dtype rides along
+    return idx, zeros, half, filled, inherited

@@ -1,0 +1,13 @@
+"""JL005 bad twin: explicit float64 in device code with no x64 gate."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_wide(x):
+    acc = jnp.zeros(4, jnp.float64)  # f64 absent on TPU, 2x HBM elsewhere
+    return acc + x
+
+
+wide_dtype = jnp.float64  # jaxlint: disable=JL005

@@ -1,0 +1,20 @@
+"""JL005 good twin: float64 only behind the x64-mode gate (the CPU oracle
+tier), or as a dtype comparison."""
+
+import jax
+import jax.numpy as jnp
+
+
+def pick_dtype():
+    # gated: f64 is the deliberate oracle-parity mode, not a leak
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def is_f64_mode(dtype) -> bool:
+    return dtype == jnp.float64  # comparing against f64 creates no f64 data
+
+
+@jax.jit
+def good_accumulate(x):
+    acc = jnp.zeros(4, x.dtype)  # dtype derived from the input
+    return acc + x

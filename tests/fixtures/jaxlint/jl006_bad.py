@@ -1,0 +1,8 @@
+"""JL006 bad twin: device work as an import side effect."""
+
+import jax
+import jax.numpy as jnp
+
+PROBE = jnp.zeros(8, jnp.float32)  # allocates on device when imported
+N_DEVICES = jax.device_count()  # initialises the backend at import
+SUPPRESSED = jnp.ones(4, jnp.float32)  # jaxlint: disable=JL006

@@ -1,0 +1,21 @@
+"""JL006 good twin: module scope only defines; device work runs on call."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+DTYPE = jnp.float32  # attribute reference: no device work
+
+
+def _square(x):
+    return x * x
+
+
+square = jax.vmap(_square)  # wrapping is lazy: nothing traces at import
+
+
+@functools.lru_cache(maxsize=None)
+def probe():
+    # backend touched on first call, not at import
+    return jnp.zeros(8, jnp.float32), jax.device_count()

@@ -1,0 +1,35 @@
+"""JL007 bad twin: recompile hazards — throwaway wrappers, varying
+statics."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def per_call_wrapper(xs):
+    out = []
+    for x in xs:
+        # fresh wrapper per iteration: empty compile cache every time
+        out.append(jax.jit(lambda v: v * 2)(x))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def kernel(x, width):
+    return x[:width]
+
+
+def sweep(widths):
+    data = jnp.zeros(64, jnp.float32)
+    res = []
+    for w in widths:
+        res.append(kernel(data, width=w))  # one recompile per distinct w
+    return res
+
+
+def suppressed(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v + 1)(x))  # jaxlint: disable=JL007
+    return out

@@ -1,0 +1,31 @@
+"""JL007 good twin: one wrapper per process/object, statics held constant."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _double(v):
+    return v * 2
+
+
+double = jax.jit(_double)  # module-level wrapper: one compile, reused
+
+
+@functools.partial(jax.jit, static_argnames=("width",))
+def kernel(x, width):
+    return x[:width]
+
+
+def sweep(xs):
+    # static arg constant across the loop: single compile
+    return [kernel(x, width=8) for x in xs]
+
+
+class Program:
+    def __init__(self, body):
+        self._fn = jax.jit(body)  # bound once in __init__ (the repo idiom)
+
+    def run(self, xs):
+        return [self._fn(x) for x in xs]

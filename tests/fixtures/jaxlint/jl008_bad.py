@@ -1,0 +1,20 @@
+"""JL008 bad twin: reading a buffer after donating it."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update(buf, delta):
+    return buf + delta
+
+
+def bad_step(buf, delta):
+    out = update(buf, delta)
+    return out + buf  # buf's HBM was donated: garbage on TPU
+
+
+def suppressed_step(buf, delta):
+    out = update(buf, delta)
+    return out + buf  # jaxlint: disable=JL008

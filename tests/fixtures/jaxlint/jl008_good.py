@@ -1,0 +1,16 @@
+"""JL008 good twin: donated names are rebound or never read again."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update(buf, delta):
+    return buf + delta
+
+
+def good_step(buf, delta):
+    checksum = buf.sum()  # read BEFORE donation: fine
+    buf = update(buf, delta)  # rebinding replaces the dead buffer
+    return buf, checksum

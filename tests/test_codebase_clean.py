@@ -1,0 +1,42 @@
+"""The CI gate: splink_tpu/ itself must lint clean AND every registered
+kernel must pass the jaxpr audit. This is the tier-1 enforcement of the
+discipline both analysis layers encode — a new hazard anywhere in the
+package (or a kernel regression that bakes in a constant / leaks float64 /
+adds an undeclared callback) fails the suite, not just ``make lint``.
+
+The audit forces x64 on while tracing (unpinned constructors only reveal
+themselves as int64/float64 under x64), so this gate and ``make lint``
+check the identical configuration.
+"""
+
+import os
+
+from splink_tpu.analysis import lint_paths, run_audit
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "splink_tpu")
+
+
+def test_package_lints_clean():
+    report = lint_paths([PACKAGE])
+    assert report.files_checked > 40  # the whole package, not a subdir
+    assert report.clean, "\n" + "\n".join(
+        f.format() for f in report.sorted()
+    )
+
+
+def test_kernel_registry_audits_clean():
+    findings, audited = run_audit()
+    # the declared hot-path kernels: EM (plain + checkpoint-hook), streamed
+    # pass, scoring, gamma batch, pattern kernel, string ops, TF adjustment
+    assert audited >= 10
+    assert not findings, "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_bad_fixtures_fail_the_gate():
+    # the gate must be falsifiable: the fixture corpus trips it
+    fixtures = os.path.join(os.path.dirname(__file__), "fixtures", "jaxlint")
+    report = lint_paths([fixtures])
+    assert not report.clean
+    fired = {f.rule for f in report.findings}
+    assert fired >= {f"JL00{i}" for i in range(1, 9)}

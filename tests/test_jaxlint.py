@@ -1,0 +1,142 @@
+"""jaxlint layer: every rule fires on its bad fixture twin, stays silent on
+the good twin and on suppressed lines; suppression syntax; CLI modes.
+
+The fixtures under tests/fixtures/jaxlint/ are DATA, not importable test
+code: each rule has a ``jlNNN_bad.py`` containing at least one violation
+plus one suppressed copy, and a ``jlNNN_good.py`` expressing the same
+intent cleanly."""
+
+import json
+import os
+
+import pytest
+
+from splink_tpu.analysis import RULES, lint_paths, lint_source
+from splink_tpu.analysis.__main__ import main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "jaxlint")
+RULE_IDS = sorted(RULES)
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _lint_file(path):
+    with open(path) as fh:
+        return lint_source(path, fh.read())
+
+
+def test_rule_catalog_complete():
+    # the advertised 8 hazard classes, each with title + doc for the CLI
+    assert RULE_IDS == [f"JL00{i}" for i in range(1, 9)]
+    for spec in RULES.values():
+        assert spec.title and spec.doc
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_bad_twin_only(rule_id):
+    bad = _fixture(f"{rule_id.lower()}_bad.py")
+    good = _fixture(f"{rule_id.lower()}_good.py")
+
+    bad_findings = [f for f in _lint_file(bad) if f.rule == rule_id]
+    assert bad_findings, f"{rule_id} did not fire on {bad}"
+
+    # the suppressed copy inside the bad twin stays silent
+    with open(bad) as fh:
+        suppressed_lines = {
+            i + 1
+            for i, line in enumerate(fh)
+            if "jaxlint: disable" in line
+        }
+    assert suppressed_lines, f"{bad} must contain a suppressed violation"
+    hit = suppressed_lines & {f.line for f in bad_findings}
+    assert not hit, f"{rule_id} fired on suppressed line(s) {sorted(hit)}"
+
+    good_findings = _lint_file(good)
+    assert not good_findings, (
+        f"good twin {good} not clean: "
+        + "; ".join(f.format() for f in good_findings)
+    )
+
+
+def test_file_level_suppression():
+    source = (
+        "# jaxlint: disable-file=JL004\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def build():\n"
+        "    return jnp.arange(8)\n"
+    )
+    assert lint_source("x.py", source) == []
+    # without the pragma the same source is a finding
+    assert lint_source("x.py", source.split("\n", 1)[1])
+
+
+def test_suppression_on_preceding_line():
+    source = (
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def build():\n"
+        "    # jaxlint: disable=JL004\n"
+        "    return jnp.arange(8)\n"
+    )
+    assert lint_source("x.py", source) == []
+
+
+def test_unknown_rule_id_rejected():
+    with pytest.raises(KeyError):
+        lint_paths([FIXTURES], rules=["JL999"])
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    report = lint_paths([str(p)])
+    assert [f.rule for f in report.findings] == ["JL000"]
+
+
+def test_unparseable_files_are_findings_not_crashes(tmp_path):
+    # the gate must report, not abort, on files ast/utf-8 cannot take
+    (tmp_path / "nullbyte.py").write_bytes(b"x = 1\x00\n")
+    (tmp_path / "latin1.py").write_bytes("s = 'caf\xe9'\n".encode("latin-1"))
+    report = lint_paths([str(tmp_path)])
+    assert report.files_checked == 2
+    assert sorted(f.rule for f in report.findings) == ["JL000", "JL000"]
+
+
+def test_cli_json_mode_on_bad_fixtures(capsys):
+    rc = main([_fixture("jl004_bad.py"), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["clean"] is False
+    assert out["files_checked"] == 1
+    assert {f["rule"] for f in out["findings"]} == {"JL004"}
+    f = out["findings"][0]
+    assert set(f) >= {"rule", "path", "line", "message", "hint"}
+
+
+def test_cli_exit_zero_on_clean_path(capsys):
+    rc = main([_fixture("jl004_good.py")])
+    assert rc == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_cli_rule_filter(capsys):
+    # restricting to JL006 silences the JL004 findings in the bad twin
+    rc = main([_fixture("jl004_bad.py"), "--rules", "JL006"])
+    assert rc == 0
+    capsys.readouterr()
+
+
+def test_cli_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in RULE_IDS:
+        assert rule_id in out
+
+
+def test_cli_usage_error_without_paths():
+    assert main([]) == 2

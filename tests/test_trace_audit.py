@@ -1,0 +1,169 @@
+"""trace_audit layer: each invariant catches a crafted offender, the
+sanctioned patterns pass, and the registry machinery behaves."""
+
+import numpy as np
+import pytest
+
+from splink_tpu.analysis.trace_audit import (
+    DEFAULT_ALLOWED_DTYPES,
+    KernelSpec,
+    audit_kernel,
+    register_kernel,
+)
+
+
+def _spec(build, **kw):
+    return KernelSpec(name="probe", build=build, **kw)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def test_const_budget_catches_closure_capture():
+    big = np.zeros((64, 1024), np.float32)  # 256 KiB
+
+    def build():
+        import jax.numpy as jnp
+
+        big_dev = jnp.asarray(big)
+        return (lambda x: x + big_dev), (jnp.zeros((64, 1024), jnp.float32),), {}
+
+    findings = audit_kernel(_spec(build, const_budget_bytes=1 << 16))
+    assert "TA-CONST" in _rules(findings)
+    # raising the budget clears it — the budget is the knob, not the check
+    findings = audit_kernel(_spec(build, const_budget_bytes=1 << 20))
+    assert "TA-CONST" not in _rules(findings)
+
+
+def test_const_as_argument_passes():
+    def build():
+        import jax.numpy as jnp
+
+        big = jnp.zeros((64, 1024), jnp.float32)
+        return (lambda table, x: x + table), (big, big), {}
+
+    assert audit_kernel(_spec(build, const_budget_bytes=1 << 16)) == []
+
+
+def test_dtype_audit_catches_float64():
+    def build():
+        import jax.numpy as jnp
+
+        # the audit forces x64 on during tracing, so this f64 is real —
+        # exactly the leak the check exists to catch (and the reason the
+        # CLI catches it even though the CLI process runs with x64 off)
+        return (
+            lambda x: x.astype(jnp.float64).sum(),
+            (jnp.zeros(8, jnp.float32),),
+            {},
+        )
+
+    findings = audit_kernel(_spec(build))
+    assert _rules(findings) == ["TA-DTYPE"]
+    assert "float64" in findings[0].message
+
+
+def test_dtype_allowlist_is_per_kernel():
+    def build():
+        import jax.numpy as jnp
+
+        return (
+            lambda x: x.astype(jnp.float64).sum(),
+            (jnp.zeros(8, jnp.float32),),
+            {},
+        )
+
+    allowed = DEFAULT_ALLOWED_DTYPES | {"float64"}
+    assert audit_kernel(_spec(build, allow_dtypes=allowed)) == []
+
+
+def test_weak_scalars_are_exempt():
+    def build():
+        import jax.numpy as jnp
+
+        # the Python literal is weak-typed (f64 under x64) but adapts to
+        # the f32 operand — not a leak
+        return (lambda x: x * 0.5), (jnp.zeros(8, jnp.float32),), {}
+
+    assert audit_kernel(_spec(build)) == []
+
+
+def test_callback_audit_requires_declaration():
+    def build():
+        import jax.numpy as jnp
+        from jax.experimental import io_callback
+
+        def fn(x):
+            io_callback(lambda v: None, None, x, ordered=True)
+            return x + 1
+
+        return fn, (jnp.zeros((), jnp.float32),), {}
+
+    findings = audit_kernel(_spec(build))
+    assert "TA-CALLBACK" in _rules(findings)
+    assert audit_kernel(_spec(build, allow_callbacks=("io_callback",))) == []
+
+
+def test_hash_audit_catches_nondeterministic_trace():
+    import itertools
+
+    counter = itertools.count()
+
+    def build():
+        import jax.numpy as jnp
+
+        # each trace embeds a different constant: the jaxpr is not a
+        # function of the inputs alone
+        return (
+            lambda x: x + next(counter),
+            (jnp.zeros((), jnp.float32),),
+            {},
+        )
+
+    findings = audit_kernel(_spec(build))
+    assert "TA-HASH" in _rules(findings)
+
+
+def test_hash_audit_sees_through_jit_trace_cache():
+    import itertools
+
+    import jax
+
+    counter = itertools.count()
+
+    def build():
+        import jax.numpy as jnp
+
+        # jit-wrapped: without the cache clear between traces, pjit would
+        # hand the second trace the first's cached jaxpr and the check
+        # would vacuously pass
+        fn = jax.jit(lambda x: x + next(counter))
+        return fn, (jnp.zeros((), jnp.float32),), {}
+
+    findings = audit_kernel(_spec(build))
+    assert "TA-HASH" in _rules(findings)
+
+
+def test_trace_failure_is_a_finding_not_a_crash():
+    def build():
+        return (lambda x: undefined_name + x), (1.0,), {}  # noqa: F821
+
+    findings = audit_kernel(_spec(build))
+    assert _rules(findings) == ["TA-ERROR"]
+
+
+def test_duplicate_registration_rejected():
+    @register_kernel("test_dup_kernel_xyz")
+    def _build():
+        return (lambda x: x), (1.0,), {}
+
+    with pytest.raises(ValueError):
+
+        @register_kernel("test_dup_kernel_xyz")
+        def _build2():
+            return (lambda x: x), (1.0,), {}
+
+    from splink_tpu.analysis.trace_audit import REGISTRY
+
+    REGISTRY.pop("test_dup_kernel_xyz", None)
