@@ -1,4 +1,4 @@
-.PHONY: test lint tpu-smoke bench bench-blocking all
+.PHONY: test lint tpu-smoke obs-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -16,6 +16,12 @@ lint:
 # when the accelerator backend is unreachable — treated as a skip.
 tpu-smoke:
 	python -m pytest tests_tpu/ -q || [ $$? -eq 5 ]
+
+# Telemetry smoke: fixture linker run with the JSONL sink enabled (fault
+# injection included), then the summarize + export-trace CLI over the
+# record (docs/observability.md).
+obs-smoke:
+	python scripts/obs_smoke.py
 
 bench:
 	python bench.py

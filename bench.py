@@ -275,12 +275,38 @@ def main():
     from splink_tpu.models.fellegi_sunter import FSParams, match_probability
     from splink_tpu.settings import complete_settings_dict
 
+    # Telemetry record of the bench run (splink_tpu/obs): stage spans with
+    # the compile-vs-execute split, plus a JSONL artifact the summarize CLI
+    # renders. The compile monitor also feeds the BENCH json's
+    # compile_seconds/jit_compiles keys (BENCHMARKS.md). Never fatal.
+    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+
+    install_compile_monitor()
+    obs = None
+    tel_dir = os.environ.get("SPLINK_TPU_BENCH_TELEMETRY_DIR", "bench_telemetry")
+    if tel_dir:
+        try:
+            from splink_tpu.obs.runtime import RunContext
+
+            obs = RunContext.from_settings({"telemetry_dir": tel_dir})
+            if not obs.enabled:
+                obs = None
+        except Exception as e:  # noqa: BLE001 - telemetry must not kill bench
+            print(f"bench: telemetry disabled ({e})", file=sys.stderr)
+            obs = None
+
+    from contextlib import nullcontext
+
+    def span(name):
+        return obs.span(name) if obs is not None else nullcontext()
+
     rng = np.random.default_rng(0)
     settings = complete_settings_dict(dict(SETTINGS))
 
     df = _make_df(rng, N_ROWS)
     t_enc = time.perf_counter()
-    table = encode_table(df, settings)
+    with span("encode"):
+        table = encode_table(df, settings)
     encode_time = time.perf_counter() - t_enc
     prog = GammaProgram(settings, table)
 
@@ -354,11 +380,12 @@ def main():
     t0 = time.perf_counter()
     Gs = [G1]
     psums = [s1]
-    for bl, br in batches[1:]:
-        G, p, s = score_batch(bl, br, params)
-        Gs.append(G)
-        psums.append(s)
-    float(psum_fn(*psums))
+    with span("score"):
+        for bl, br in batches[1:]:
+            G, p, s = score_batch(bl, br, params)
+            Gs.append(G)
+            psums.append(s)
+        float(psum_fn(*psums))
     score_time = first_batch_time + (time.perf_counter() - t0)
     pairs_per_sec = N_PAIRS / score_time
 
@@ -373,9 +400,10 @@ def main():
                  em_convergence=1e-4)
     float(res.params.lam)  # value fetch = real barrier
     t1 = time.perf_counter()
-    res = run_em(G_all, init, max_iterations=25, max_levels=max_levels,
-                 em_convergence=1e-4)
-    float(res.params.lam)  # value fetch = real barrier
+    with span("em"):
+        res = run_em(G_all, init, max_iterations=25, max_levels=max_levels,
+                     em_convergence=1e-4)
+        float(res.params.lam)  # value fetch = real barrier
     em_time = time.perf_counter() - t1
 
     # Checkpointed EM capture (splink_tpu/resilience): the in-loop host
@@ -418,6 +446,18 @@ def main():
 
     extras = _bench_virtual_pipeline(settings, table, prog)
     extras.update(_bench_virtual_qgram(df))
+
+    # compile-vs-execute split: process-wide jit totals from the compile
+    # monitor. Timed phases above run AFTER their warmup, so their wall is
+    # execute-only; compile_seconds is the cold-start cost a persistent
+    # compilation cache amortises away (BENCHMARKS.md).
+    n_compiles, compile_seconds = compile_totals()
+    extras["jit_compiles"] = n_compiles
+    extras["compile_seconds"] = round(compile_seconds, 3)
+    extras["execute_seconds"] = round(score_time + em_time, 3)
+    if obs is not None:
+        obs.finish()
+        extras["telemetry_jsonl"] = obs.sink.path
 
     print(json.dumps({
         "metric": "scored_record_pairs_per_sec_per_chip",

@@ -1,0 +1,89 @@
+"""Telemetry smoke: a tiny fixture linker run with the sink enabled, then
+the ``python -m splink_tpu.obs`` CLI over the emitted JSONL (``make
+obs-smoke``). Exercises the full chain — span tracer, metrics registry, EM
+convergence stream, resilience events under fault injection, summarize and
+chrome-trace export — on CPU in a few seconds. Exits nonzero if any link in
+the chain is missing from the record.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+
+def main() -> int:
+    import warnings
+
+    from splink_tpu import Splink
+    from splink_tpu.obs.cli import main as obs_cli
+    from splink_tpu.obs.events import read_events
+
+    rng = np.random.default_rng(7)
+    n = 240
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["ann", "bob", "cat", "dan", "eva"], n),
+            "city": rng.choice(["x", "y", "z"], n),
+        }
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        settings = {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {"col_name": "name", "num_levels": 2,
+                 "comparison": {"kind": "exact"}}
+            ],
+            "blocking_rules": ["l.city = r.city"],
+            "max_iterations": 6,
+            "telemetry_dir": tmp,
+            # one injected OOM so the record shows the resilience chain:
+            # fault -> degradation -> streamed EM
+            "fault_plan": "resident_em@kind=oom",
+        }
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            linker = Splink(settings, df=df)
+            linker.get_scored_comparisons(compute_ll=True)
+        path = linker._obs.sink.path
+
+        events = read_events(path)
+        types = {e["type"] for e in events}
+        required = {"run_start", "span", "em_iteration", "metrics", "fault",
+                    "degradation"}
+        missing = required - types
+        if missing:
+            print(f"obs-smoke FAILED: missing event types {sorted(missing)}")
+            return 1
+
+        print(f"== telemetry record: {path} ({len(events)} events)\n")
+        rc = obs_cli(["summarize", path])
+        if rc != 0:
+            return rc
+        trace_out = os.path.join(tmp, "trace.json")
+        rc = obs_cli(["export-trace", path, "-o", trace_out])
+        if rc != 0:
+            return rc
+        with open(trace_out) as f:
+            trace = json.load(f)
+        slices = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        if not slices:
+            print("obs-smoke FAILED: chrome trace has no spans")
+            return 1
+        print(f"\nobs-smoke OK: {len(slices)} chrome-trace spans")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

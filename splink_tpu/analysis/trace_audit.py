@@ -21,7 +21,8 @@ against four invariants:
                backends. The CLI therefore catches the same leaks the x64
                test tier does.
   TA-CALLBACK  no host callback other than the declared ones (the EM
-               checkpoint hook's ordered io_callback is the single
+               host-hook's ordered io_callback — shared by the checkpoint
+               writer and the telemetry convergence stream — is the single
                sanctioned host round-trip in the hot loop).
   TA-HASH      identical jaxpr across two independent traces — a trace that
                differs run-to-run (dict-order iteration, fresh closures)
@@ -340,6 +341,33 @@ def _ensure_default_registry() -> None:
             max_levels=3,
             em_convergence=tol,
             compute_ll=True,
+            host_hook=True,
+        )
+        return fn, (G, params, jnp.float32(1e-4)), {}
+
+    # telemetry-enabled EM: when a sink is configured the linker routes the
+    # fused loop through run_em_checkpointed(telemetry=...), which turns on
+    # the SAME single sanctioned io_callback the checkpoint hook uses (the
+    # EM convergence stream rides it; obs/runtime.py). This spec pins that
+    # telemetry-ON adds exactly that callback and nothing else — and the
+    # plain `em_step` spec above (empty allowlist) pins that telemetry-OFF
+    # programs carry NO callback at all, i.e. telemetry is jaxpr-invisible
+    # when disabled. compute_ll=False here (telemetry does not require it),
+    # so both ll variants of the hooked program stay audited.
+    @register_kernel("em_step_telemetry", allow_callbacks=("io_callback",))
+    def _build_em_step_telemetry():
+        import jax.numpy as jnp
+
+        from ..em import run_em
+
+        G, params = _fs_inputs()
+        fn = lambda G, p, tol: run_em(  # noqa: E731
+            G,
+            p,
+            max_iterations=4,
+            max_levels=3,
+            em_convergence=tol,
+            compute_ll=False,
             host_hook=True,
         )
         return fn, (G, params, jnp.float32(1e-4)), {}

@@ -199,6 +199,7 @@ def run_em_checkpointed(
     resume_checkpoint=None,
     fault_plan=None,
     on_segment=None,
+    telemetry=None,
 ) -> EMResult:
     """Fused EM with an atomic checkpoint every ``checkpoint_every``
     updates — ONE compiled ``run_em`` execution, persisted from inside.
@@ -227,6 +228,14 @@ def run_em_checkpointed(
     must therefore stay host-side work (no jax dispatch). A hook
     exception (failed write, injected boundary fault) is re-raised after
     the program drains.
+
+    ``telemetry`` (an ``obs.runtime.RunContext``) streams one EM
+    convergence record per update through the SAME io_callback — the
+    telemetry-only caller (checkpoint_dir=None) therefore runs the
+    identical compiled program as the checkpointed one, and the parameter
+    trajectory stays bit-identical to a telemetry-off run (the callback
+    touches no dataflow). RunContext.em_update never raises, so telemetry
+    failures cannot poison the deferred-exception channel.
     """
     import numpy as np
 
@@ -339,6 +348,7 @@ def run_em_checkpointed(
         checkpoint_dir is not None
         or on_segment is not None
         or (fault_plan is not None and bool(fault_plan))
+        or telemetry is not None
     )
     deferred: list[BaseException] = []
 
@@ -355,6 +365,11 @@ def run_em_checkpointed(
             if compute_ll and not np.isnan(ll_pre):
                 ll_h[it - 1] = ll_pre
             conv = bool(conv)
+            if telemetry is not None:
+                telemetry.em_update(
+                    it, float(lam), m, u,
+                    float(ll_pre) if compute_ll else None, conv,
+                )
             if conv or it == max_iterations or it % checkpoint_every == 0:
                 # durability first: an injected kill at this boundary must
                 # find the boundary's own update already on disk

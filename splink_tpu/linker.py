@@ -120,6 +120,31 @@ except ImportError:  # pragma: no cover
     pd = None
 
 
+def _gamma_histograms(settings, G, weights=None, chunk: int = 1 << 22) -> dict:
+    """Per-comparison-column gamma-level histogram (telemetry record):
+    column name -> [count at level -1 (null), level 0, ..., level L-1].
+    ``G`` is either the per-pair gamma matrix or — with ``weights`` (the
+    pattern-count vector) — the pattern matrix. Chunked so the int64
+    promotion temporaries stay O(chunk): the streamed regime reaches here
+    with a G that is huge by definition, and observability must not
+    multiply that path's host footprint."""
+    cols = settings["comparison_columns"]
+    acc = [np.zeros(int(col["num_levels"]) + 1, np.float64) for col in cols]
+    for s in range(0, len(G), chunk):
+        Gc = G[s : s + chunk]
+        w = weights[s : s + chunk] if weights is not None else None
+        for c, col in enumerate(cols):
+            levels = int(col["num_levels"])
+            g = np.asarray(Gc[:, c], np.int64) + 1  # -1 (null) -> bin 0
+            acc[c] += np.bincount(
+                np.clip(g, 0, levels), weights=w, minlength=levels + 1
+            )[: levels + 1]
+    return {
+        comparison_column_name(col): [int(v) for v in acc[c]]
+        for c, col in enumerate(cols)
+    }
+
+
 class Splink:
     @check_types
     def __init__(
@@ -174,11 +199,16 @@ class Splink:
         self._n_left_released: int | None = None
         self.save_state_fn = save_state_fn
         self._check_args()
-        # unconditional: a later linker WITHOUT profile_dir must clear the
-        # process-wide trace flag a previous instance set
-        from .utils.profiling import set_trace_dir
+        # Per-run observability scope: stage timings and the profiler-trace
+        # target are keyed by this run's id (a later linker no longer
+        # clears or pollutes an earlier one's), and the telemetry context
+        # is live iff settings["telemetry_dir"] is set — disabled, it adds
+        # no host callbacks and compiled programs are unchanged.
+        from .obs.runtime import RunContext
+        from .utils.profiling import begin_run
 
-        set_trace_dir(self.settings.get("profile_dir") or None)
+        self._obs = RunContext.from_settings(self.settings)
+        begin_run(self._obs.run_id, self.settings.get("profile_dir") or None)
         _cache_dir = self.settings.get("compilation_cache_dir")
         if _cache_dir is None:  # resolve the schema default lazily
             _cache_dir = _cache_default
@@ -205,6 +235,20 @@ class Splink:
         self._ckpt_resume = False
 
     # ------------------------------------------------------------------
+
+    @property
+    def run_id(self) -> str:
+        """This linker's telemetry/profiling run id (the key for
+        ``utils.profiling.stage_timings(run=...)`` and the suffix of the
+        run's telemetry JSONL file name)."""
+        return self._obs.run_id
+
+    def _stage(self, name: str) -> StageTimer:
+        """A StageTimer bound to this linker's run scope: records wall
+        time under this run id, resolves this run's profile_dir, and (when
+        telemetry is enabled) emits the stage span with its
+        compile-vs-execute split and a device-memory snapshot."""
+        return StageTimer(name, run=self._obs.run_id, telemetry=self._obs)
 
     def _check_args(self):
         link_type = self.settings["link_type"]
@@ -346,18 +390,19 @@ class Splink:
             from .resilience.retry import ensure_devices
 
             ensure_devices()
-            with StageTimer("encode"):
+            with self._stage("encode"):
                 if self.settings["link_type"] == "dedupe_only":
                     self._table = encode_table(self.df, self.settings)
                 else:
                     self._table = concat_tables(self.df_l, self.df_r, self.settings)
+            self._obs.count("rows_encoded", int(self._table.n_rows))
         return self._table
 
     def _ensure_pairs(self) -> PairIndex:
         if self._pairs is None:
             table = self._ensure_encoded()
             stream = self._overlap_stream(table)
-            with StageTimer("blocking"):
+            with self._stage("blocking"):
                 self._pairs = block_using_rules(
                     self.settings,
                     table,
@@ -365,6 +410,16 @@ class Splink:
                     pair_consumer=stream.feed if stream is not None else None,
                 )
             logger.info("blocking produced %d candidate pairs", self._pairs.n_pairs)
+            self._obs.count("pairs_blocked", int(self._pairs.n_pairs))
+            if self._obs.enabled:
+                # block-size skew telemetry rides the still-warm key-code
+                # cache; freed with it just below
+                from .blocking import block_size_stats
+
+                self._obs.record(
+                    "largest_blocks",
+                    block_size_stats(self.settings, table, self._n_left),
+                )
             self._maybe_spill_pairs()
             if stream is not None:
                 self._finish_overlap(stream)
@@ -418,10 +473,10 @@ class Splink:
         from .gammas import PatternStream
 
         if isinstance(stream, PatternStream):
-            with StageTimer("gammas_patterns"):
+            with self._stage("gammas_patterns"):
                 self._P, self._pattern_counts = stream.finish()
         else:
-            with StageTimer("gammas"):
+            with self._stage("gammas"):
                 self._G, self._G_dev = stream.finish()
 
     def _maybe_spill_pairs(self) -> None:
@@ -446,7 +501,7 @@ class Splink:
                 # enough for the resident regime: decode the gamma matrix
                 # from the pattern LUT (bit-identical to recomputation —
                 # the pattern id IS the gamma vector in mixed radix)
-                with StageTimer("gammas"):
+                with self._stage("gammas"):
                     PM = self._pattern_program.patterns_matrix()
                     self._G = PM[self._P]  # fancy-index accepts uint16/int32
                 return self._G
@@ -457,7 +512,7 @@ class Splink:
                 pairs.n_pairs <= int(self.settings["max_resident_pairs"])
                 and mesh_from_settings(self.settings) is None
             )
-            with StageTimer("gammas"):
+            with self._stage("gammas"):
                 program = GammaProgram(
                     self.settings, table, float_dtype=self._float_dtype
                 )
@@ -523,7 +578,7 @@ class Splink:
             bound = self._estimate_pair_bound(table)
             if bound <= int(self.settings["max_resident_pairs"]):
                 return None
-        with StageTimer("pairgen_plan"):
+        with self._stage("pairgen_plan"):
             self._virtual = build_virtual_plan(
                 self.settings, table, self._n_left
             )
@@ -632,7 +687,7 @@ class Splink:
                     return None, self._pattern_counts, self._pattern_program
                 from .pairgen import compute_virtual_pattern_ids
 
-                with StageTimer("gammas_patterns"):
+                with self._stage("gammas_patterns"):
                     self._ensure_pattern_program()
                     want_ids = self._virtual_ids_policy()
                     pids, self._pattern_counts, n_real = (
@@ -655,7 +710,7 @@ class Splink:
             if self._P is not None:
                 # the overlap PatternStream already computed them
                 return self._P, self._pattern_counts, self._pattern_program
-            with StageTimer("gammas_patterns"):
+            with self._stage("gammas_patterns"):
                 self._pattern_program = GammaProgram(
                     self.settings, table, float_dtype=self._float_dtype
                 )
@@ -689,7 +744,7 @@ class Splink:
         (stored virtual ids / virtual recompute / materialised pairs) is
         _iter_pattern_triples — the single definition of the pair stream."""
         PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
-        with StageTimer("score_patterns"):
+        with self._stage("score_patterns"):
             for il, ir, Pk in self._iter_pattern_triples():
                 yield self._assemble_df_e(
                     PM[Pk],
@@ -823,7 +878,7 @@ class Splink:
             base_lambda = float(self.params.params["λ"])
             sums = {n: np.zeros(nt + 1) for n, (_, nt) in cols.items()}
             counts = {n: np.zeros(nt + 1) for n, (_, nt) in cols.items()}
-            with StageTimer("tf_aggregate_patterns"):
+            with self._stage("tf_aggregate_patterns"):
                 for il, ir, Pk in self._iter_pattern_triples():
                     p = p_lut[Pk]
                     for name, (tid, _nt) in cols.items():
@@ -839,7 +894,7 @@ class Splink:
                 adjusted[name] = bayes_combine(
                     [lam_t, np.full(len(lam_t), 1.0 - base_lambda)]
                 )
-            with StageTimer("score_tf_patterns"):
+            with self._stage("score_tf_patterns"):
                 for il, ir, Pk in self._iter_pattern_triples():
                     df = self._assemble_df_e(
                         PM[Pk],
@@ -868,6 +923,7 @@ class Splink:
             # release on exhaustion AND on an abandoned/closed generator —
             # the ids can be multi-GB
             self._P_virtual = None
+            self._obs.finish()
 
     def _run_em_patterns(self, compute_ll: bool) -> None:
         _, counts, program = self._ensure_pattern_ids()
@@ -884,6 +940,13 @@ class Splink:
             int(counts.sum()),
             int(seen.sum()),
         )
+        self._obs.count("pairs_gamma_scored", int(counts.sum()))
+        self._obs.gauge("gamma_patterns_distinct", int(seen.sum()))
+        if self._obs.enabled:
+            self._obs.record(
+                "gamma_histogram",
+                _gamma_histograms(self.settings, patterns, weights=counts),
+            )
         self._run_em_resident_weighted(patterns[seen], counts[seen], compute_ll)
 
     # ------------------------------------------------------------------
@@ -915,10 +978,13 @@ class Splink:
         """Score using the m/u values in the settings, without running EM
         (/root/reference/splink/__init__.py:111-119)."""
         if self._use_pattern_pipeline():
-            return self._concat_chunks(self._stream_pattern_chunks())
-        G = self._ensure_gammas()
-        df_e = self._build_df_e(G)
-        self._G_dev = None  # release the HBM copy once scoring is done
+            df_e = self._concat_chunks(self._stream_pattern_chunks())
+        else:
+            G = self._ensure_gammas()
+            df_e = self._build_df_e(G)
+            self._G_dev = None  # release the HBM copy once scoring is done
+        self._obs.count("pairs_scored_output", len(df_e))
+        self._obs.finish()
         return df_e
 
     def estimate_parameters(
@@ -969,6 +1035,7 @@ class Splink:
         finally:
             self._ckpt_dir_arg = None
             self._ckpt_resume = False
+            self._obs.finish()
         return self.params
 
     def get_scored_comparisons(self, compute_ll: bool = False):
@@ -992,11 +1059,13 @@ class Splink:
             # (same convention as _G_dev below); a later re-stream simply
             # recomputes them chunk-wise
             self._P_virtual = None
-            return df_e
-        G = self._ensure_gammas()
-        self._run_em(G, compute_ll)
-        df_e = self._build_df_e(G)
-        self._G_dev = None  # release the HBM copy once EM + scoring are done
+        else:
+            G = self._ensure_gammas()
+            self._run_em(G, compute_ll)
+            df_e = self._build_df_e(G)
+            self._G_dev = None  # release the HBM copy once EM + scoring are done
+        self._obs.count("pairs_scored_output", len(df_e))
+        self._obs.finish()
         return df_e
 
     def _run_em(self, G: np.ndarray, compute_ll: bool) -> None:
@@ -1009,6 +1078,11 @@ class Splink:
         from .resilience import active_plan, is_oom
         from .utils.logging_utils import warn_degraded
 
+        self._obs.count("pairs_gamma_scored", len(G))
+        if self._obs.enabled:
+            self._obs.record(
+                "gamma_histogram", _gamma_histograms(self.settings, G)
+            )
         if len(G) > int(self.settings["max_resident_pairs"]):
             self._run_em_streamed(G, compute_ll)
             return
@@ -1059,25 +1133,48 @@ class Splink:
         )
 
         ckpt_dir, resume, interval = self._checkpoint_config()
-        with StageTimer("em"):
+        tel = self._obs if self._obs.enabled else None
+        with self._stage("em"):
+            # inside the stage span so em_begin captures it as the parent
+            # of every em_iteration span
+            if tel is not None:
+                tel.em_begin("fused", lam0, m0, u0)
             if ckpt_dir is not None:
                 converged = self._run_em_fused_checkpointed(
                     G_dev, init, max_iterations, em_kwargs, ckpt_dir,
                     resume, interval, compute_ll,
                 )
             elif self.save_state_fn is None:
-                result = run_em(
-                    G_dev, init, max_iterations=max_iterations, **em_kwargs
-                )
+                if tel is not None:
+                    # same compiled loop with the host-hook io_callback on:
+                    # per-update convergence records stream out through it,
+                    # the dataflow (and so the trajectory) is untouched
+                    result = run_em_checkpointed(
+                        G_dev, init, max_iterations=max_iterations,
+                        telemetry=tel, **em_kwargs,
+                    )
+                else:
+                    result = run_em(
+                        G_dev, init, max_iterations=max_iterations, **em_kwargs
+                    )
                 self._replay_history(result, compute_ll)
                 converged = bool(result.converged)
             else:
                 converged = False
                 params_dev = init
-                for _ in range(max_iterations):
+                for k in range(max_iterations):
                     result = run_em(G_dev, params_dev, max_iterations=1, **em_kwargs)
                     params_dev = result.params
                     self._replay_history(result, compute_ll)
+                    if tel is not None:
+                        tel.em_update(
+                            k + 1,
+                            float(result.lam_history[1]),
+                            np.asarray(result.m_history[1]),
+                            np.asarray(result.u_history[1]),
+                            float(result.ll_history[0]) if compute_ll else None,
+                            bool(result.converged),
+                        )
                     self.save_state_fn(self.params, self.settings)
                     if bool(result.converged):
                         converged = True
@@ -1136,6 +1233,7 @@ class Splink:
             resume_checkpoint=ckpt,
             fault_plan=active_plan(self.settings),
             on_segment=on_segment,
+            telemetry=self._obs if self._obs.enabled else None,
             **em_kwargs,
         )
         # a resume that was already complete runs zero segments; catch up
@@ -1253,6 +1351,8 @@ class Splink:
                 )
                 return
 
+        tel = self._obs if self._obs.enabled else None
+
         def batches():
             for s in range(0, len(G), batch):
                 yield G[s : s + batch]
@@ -1276,7 +1376,17 @@ class Splink:
             if self.save_state_fn is not None:
                 self.save_state_fn(self.params, self.settings)
 
-        with StageTimer("em_streamed"):
+        with self._stage("em_streamed"):
+            # inside the stage span so em_begin captures it as the parent
+            # of every em_iteration span
+            if tel is not None:
+                tel.em_begin(
+                    "streamed",
+                    float(np.asarray(init.lam)),
+                    np.asarray(init.m),
+                    np.asarray(init.u),
+                    start_iteration=start_iteration,
+                )
             _, _, _, converged = run_em_streamed(
                 batches,
                 init,
@@ -1290,6 +1400,7 @@ class Splink:
                 start_iteration=start_iteration,
                 retry_policy=RetryPolicy(),
                 fault_plan=active_plan(self.settings),
+                telemetry=tel,
             )
         if checkpointer is not None:
             checkpointer.finish(converged)
@@ -1317,10 +1428,12 @@ class Splink:
                 # on an abandoned/closed generator — same convention as the
                 # one-frame path; a re-stream simply recomputes chunk-wise
                 self._P_virtual = None
+                self._obs.finish()
             return
         G = self._ensure_gammas()
         self._run_em(G, compute_ll)
         yield from self.stream_scored_comparisons_after_em()
+        self._obs.finish()
 
     def stream_scored_comparisons_after_em(self):
         """Yield scored-comparison chunks using the current parameters
@@ -1428,6 +1541,15 @@ class Splink:
                 return False
         return True
 
+    def close_telemetry(self) -> None:
+        """End this linker's telemetry record now: closes the JSONL sink
+        and unregisters it from the ambient (resilience-event) publisher,
+        so a long-lived caller holding many linkers doesn't fan every
+        later run's events into earlier records. Happens automatically
+        when the linker is garbage-collected; no-op when telemetry is
+        disabled or already closed."""
+        self._obs.close()
+
     @check_types
     def save_model_as_json(self, path: str | os.PathLike, overwrite: bool = False):
         self.params.save_params_to_json_file(path, overwrite=overwrite)
@@ -1501,7 +1623,7 @@ class Splink:
         params_dev = FSParams(
             lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)
         )
-        with StageTimer("score"):
+        with self._stage("score"):
             p, prob_m, prob_u = self._score_batched(G, params_dev)
         return self._assemble_df_e(G, il, ir, p, prob_m, prob_u)
 

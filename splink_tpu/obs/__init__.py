@@ -1,0 +1,47 @@
+"""Runtime telemetry: structured spans, metrics, EM convergence stream.
+
+The reference implementation leaned on the Spark UI for runtime visibility
+(stage timelines, shuffle sizes, skewed blocks) and on driver prints for EM
+convergence. This package is the TPU-native replacement: one machine-readable
+JSONL record per run describing where time went (compile vs execute), how EM
+converged, which blocks dominated, and which resilience events fired.
+
+Layers (each importable on its own, none imports jax at module scope):
+
+  * :mod:`.events`  — thread-safe JSONL event sink + the ambient ``publish``
+    hook the resilience stack emits through (zero-cost no-op when no sink
+    is registered).
+  * :mod:`.tracer`  — nested run -> stage -> EM-iteration spans with
+    monotonic timestamps and chrome-trace (Perfetto-loadable) export.
+  * :mod:`.metrics` — counters/gauges/histograms, the process-wide jit
+    compile monitor (``jax.monitoring`` duration listeners) and device
+    memory snapshots.
+  * :mod:`.runtime` — :class:`RunContext`, the per-linker object wiring the
+    three together; created from the ``telemetry_dir`` settings key.
+  * :mod:`.cli`     — ``python -m splink_tpu.obs summarize|export-trace``.
+
+Zero-cost contract: with no sink configured (``telemetry_dir`` empty) the
+linker adds NO host callbacks and compiled programs are unchanged — the
+trace-audit kernel registry pins this (the plain ``em_step`` kernel allows
+no callback primitive at all; the ``em_step_telemetry`` variant declares
+the single sanctioned ``io_callback``).
+
+See docs/observability.md for the event schema and CLI usage.
+"""
+
+from .events import EventSink, publish, read_events
+from .metrics import MetricsRegistry, compile_totals, install_compile_monitor
+from .runtime import RunContext
+from .tracer import Tracer, chrome_trace_from_events
+
+__all__ = [
+    "EventSink",
+    "publish",
+    "read_events",
+    "MetricsRegistry",
+    "compile_totals",
+    "install_compile_monitor",
+    "RunContext",
+    "Tracer",
+    "chrome_trace_from_events",
+]

@@ -1,0 +1,177 @@
+"""CLI: ``python -m splink_tpu.obs summarize|export-trace <run.jsonl>``.
+
+``summarize`` renders a per-stage / per-iteration report of one run's
+telemetry record; ``export-trace`` converts it to Chrome trace-event JSON
+(load at ui.perfetto.dev). This module's logic is pure stdlib and never
+initialises a jax backend or touches a device — but invoking it as
+``python -m splink_tpu.obs`` imports the ``splink_tpu`` package, whose
+top-level ``__init__`` imports jax, so the package's dependencies must be
+installed (a record copied to a dependency-free machine can still be read
+with any JSONL tooling — it is plain JSON lines).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .events import read_events
+from .tracer import chrome_trace_from_events
+
+
+def _fmt_s(v) -> str:
+    return f"{v:.3f}s" if isinstance(v, (int, float)) else "-"
+
+
+def summarize_events(events: list[dict]) -> str:
+    """Human-readable report of one run's telemetry events."""
+    if not events:
+        return "(empty telemetry record)"
+    lines: list[str] = []
+    run_id = events[0].get("run_id", "?")
+    monos = [e["mono"] for e in events if isinstance(e.get("mono"), (int, float))]
+    wall = (max(monos) - min(monos)) if monos else 0.0
+    hosts = sorted({e.get("process_index", 0) for e in events})
+    lines.append(f"run {run_id}  ({len(events)} events, {wall:.3f}s, "
+                 f"host(s) {', '.join(str(h) for h in hosts)})")
+
+    # ---- stages ----------------------------------------------------------
+    stages: dict[str, dict] = {}
+    for ev in events:
+        if ev.get("type") == "span" and ev.get("kind") == "stage":
+            s = stages.setdefault(
+                ev["name"],
+                {"count": 0, "total": 0.0, "compile": 0.0, "execute": 0.0,
+                 "compiles": 0},
+            )
+            attrs = ev.get("attrs") or {}
+            s["count"] += 1
+            s["total"] += float(ev.get("dur_s") or 0.0)
+            s["compile"] += float(attrs.get("compile_s") or 0.0)
+            s["execute"] += float(attrs.get("execute_s") or 0.0)
+            s["compiles"] += int(attrs.get("compile_count") or 0)
+    if stages:
+        lines.append("")
+        lines.append(f"{'stage':<24}{'n':>4}{'total':>10}{'compile':>10}"
+                     f"{'execute':>10}{'jits':>6}")
+        for name, s in sorted(stages.items(), key=lambda kv: -kv[1]["total"]):
+            lines.append(
+                f"{name:<24}{s['count']:>4}{s['total']:>9.3f}s"
+                f"{s['compile']:>9.3f}s{s['execute']:>9.3f}s{s['compiles']:>6}"
+            )
+
+    # ---- EM convergence --------------------------------------------------
+    iters = [e for e in events if e.get("type") == "em_iteration"]
+    if iters:
+        lines.append("")
+        lines.append(f"EM: {len(iters)} update(s)")
+        lines.append(f"{'iter':>5}{'lambda':>12}{'log_lik':>14}{'delta':>12}"
+                     f"{'conv':>6}")
+        shown = iters if len(iters) <= 12 else iters[:6] + iters[-6:]
+        prev_it = None
+        for ev in shown:
+            it = ev.get("iteration")
+            if prev_it is not None and it is not None and it > prev_it + 1:
+                lines.append(f"{'...':>5}")
+            prev_it = it
+            # any numeric field can be null: the sink sanitises non-finite
+            # floats (a diverged EM emits lam=NaN -> null), and a torn
+            # record may miss fields entirely
+            lam = ev.get("lam")
+            ll = ev.get("ll")
+            delta = ev.get("delta")
+            lines.append(
+                f"{(it if it is not None else '?'):>5}"
+                f"{(f'{lam:.6f}' if isinstance(lam, (int, float)) else '-'):>12}"
+                f"{(f'{ll:.4f}' if isinstance(ll, (int, float)) else '-'):>14}"
+                f"{(f'{delta:.2e}' if isinstance(delta, (int, float)) else '-'):>12}"
+                f"{('yes' if ev.get('converged') else ''):>6}"
+            )
+
+    # ---- resilience events ----------------------------------------------
+    res = [e for e in events
+           if e.get("type") in ("retry", "fault", "checkpoint", "degradation")]
+    if res:
+        lines.append("")
+        lines.append(f"resilience events: {len(res)}")
+        for ev in res[:20]:
+            detail = {k: v for k, v in ev.items()
+                      if k not in ("v", "type", "ts", "mono", "run_id",
+                                   "process_index", "process_count")}
+            lines.append(f"  [{ev['type']}] "
+                         + ", ".join(f"{k}={v}" for k, v in detail.items()))
+        if len(res) > 20:
+            lines.append(f"  ... {len(res) - 20} more")
+
+    # ---- metrics (last snapshot wins) ------------------------------------
+    metrics = [e for e in events if e.get("type") == "metrics"]
+    if metrics:
+        snap = metrics[-1]
+        lines.append("")
+        lines.append("metrics (final snapshot):")
+        for kind in ("counters", "gauges"):
+            for name, value in sorted((snap.get(kind) or {}).items()):
+                if isinstance(value, float):
+                    value = round(value, 6)
+                lines.append(f"  {name} = {value}")
+        for name, h in sorted((snap.get("histograms") or {}).items()):
+            lines.append(
+                f"  {name}: n={h.get('count')} sum={_fmt_s(h.get('sum'))} "
+                f"min={_fmt_s(h.get('min'))} max={_fmt_s(h.get('max'))}"
+            )
+        for name in sorted(snap.get("records") or {}):
+            lines.append(f"  record: {name}")
+
+    # ---- memory ----------------------------------------------------------
+    mem = [e for e in events if e.get("type") == "memory"]
+    if mem:
+        lines.append("")
+        lines.append("device memory (peak bytes_in_use per stage):")
+        for ev in mem:
+            peaks = [d.get("peak_bytes_in_use") or d.get("bytes_in_use") or 0
+                     for d in ev.get("devices") or []]
+            if peaks:
+                lines.append(f"  {ev.get('stage')}: {max(peaks):,}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m splink_tpu.obs",
+        description="Inspect splink_tpu telemetry records (JSONL)",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    p_sum = sub.add_parser("summarize", help="per-stage/per-iteration report")
+    p_sum.add_argument("path", help="telemetry JSONL file")
+    p_exp = sub.add_parser(
+        "export-trace",
+        help="convert to Chrome trace-event JSON (ui.perfetto.dev)",
+    )
+    p_exp.add_argument("path", help="telemetry JSONL file")
+    p_exp.add_argument(
+        "-o", "--output", default=None,
+        help="output path (default: <path>.trace.json; '-' for stdout)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        events = read_events(args.path)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.command == "summarize":
+        print(summarize_events(events))
+        return 0
+
+    trace = chrome_trace_from_events(events)
+    out = args.output or (args.path + ".trace.json")
+    if out == "-":
+        json.dump(trace, sys.stdout)
+        print()
+    else:
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        print(f"wrote {len(trace['traceEvents'])} trace events to {out}")
+    return 0

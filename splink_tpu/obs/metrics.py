@@ -1,0 +1,134 @@
+"""Metrics registry + jit compile monitor + device memory snapshots.
+
+Three metric kinds (counters, gauges, histogram summaries) plus free-form
+``records`` for structured payloads that are data, not scalars (per-column
+gamma histograms, largest-block tables). The registry is plain host-side
+Python — nothing here touches the jax dataflow.
+
+The compile monitor hangs one process-global listener on
+``jax.monitoring``'s duration stream (``/jax/core/compile/*``: jaxpr trace,
+MLIR lowering, backend compile). jax offers registration only — listeners
+cannot be removed individually — so it is installed once, lazily, the first
+time a telemetry-enabled run needs it, and accumulates process totals;
+run/stage attribution is done by snapshot deltas (``compile_totals`` before
+and after). This is what splits stage wall time into compile vs execute —
+the cold-start number the Spark UI showed as query-planning time.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+
+logger = logging.getLogger("splink_tpu")
+
+
+class MetricsRegistry:
+    """Counters, gauges, histogram summaries and structured records."""
+
+    def __init__(self):
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, dict] = {}
+        self.records: dict[str, object] = {}
+
+    def count(self, name: str, n: float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        h = self.histograms.setdefault(
+            name, {"count": 0, "sum": 0.0, "min": math.inf, "max": -math.inf}
+        )
+        h["count"] += 1
+        h["sum"] += float(value)
+        h["min"] = min(h["min"], float(value))
+        h["max"] = max(h["max"], float(value))
+
+    def record(self, name: str, payload) -> None:
+        self.records[name] = payload
+
+    def snapshot(self) -> dict:
+        """One JSON-ready dict of everything recorded so far."""
+        hists = {}
+        for name, h in self.histograms.items():
+            hists[name] = {
+                "count": h["count"],
+                "sum": h["sum"],
+                "min": h["min"] if math.isfinite(h["min"]) else None,
+                "max": h["max"] if math.isfinite(h["max"]) else None,
+                "mean": (h["sum"] / h["count"]) if h["count"] else None,
+            }
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "histograms": hists,
+            "records": dict(self.records),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Compile monitor
+# ---------------------------------------------------------------------------
+
+_COMPILE_LOCK = threading.Lock()
+_COMPILE = {"count": 0, "seconds": 0.0}
+_MONITOR_INSTALLED = False
+
+
+def install_compile_monitor() -> None:
+    """Install the process-global jax compile listener (idempotent)."""
+    global _MONITOR_INSTALLED
+    if _MONITOR_INSTALLED:
+        return
+    import jax
+
+    def _on_duration(name: str, secs: float, **_kw) -> None:
+        if not name.startswith("/jax/core/compile"):
+            return
+        with _COMPILE_LOCK:
+            _COMPILE["seconds"] += secs
+            if name.endswith("backend_compile_duration"):
+                _COMPILE["count"] += 1
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _MONITOR_INSTALLED = True
+
+
+def compile_totals() -> tuple[int, float]:
+    """(backend compiles, total compile seconds) accumulated so far in this
+    process. (0, 0.0) until the monitor is installed."""
+    with _COMPILE_LOCK:
+        return _COMPILE["count"], _COMPILE["seconds"]
+
+
+def device_memory_snapshot() -> list[dict]:
+    """Per-device memory stats where the backend reports them (TPU/GPU);
+    empty on backends without ``memory_stats`` (CPU). Never raises — this
+    is called at stage boundaries on the production path."""
+    try:
+        import jax
+
+        out = []
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:  # noqa: BLE001 - per-device probe may not exist
+                stats = None
+            if not stats:
+                continue
+            out.append(
+                {
+                    "device": str(d),
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit"),
+                }
+            )
+        return out
+    except Exception as e:  # noqa: BLE001 - telemetry must never kill a run
+        logger.debug("device memory snapshot unavailable: %s", e)
+        return []

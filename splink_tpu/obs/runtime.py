@@ -1,0 +1,275 @@
+"""RunContext: the per-linker telemetry object.
+
+One RunContext per ``Splink`` instance, created from the settings. When the
+``telemetry_dir`` key is empty the context is *disabled*: every method is a
+single attribute check and returns immediately, no sink exists, and the
+linker adds no host callbacks to compiled programs (the trace-audit
+registry pins the jaxprs). When enabled it owns:
+
+  * an :class:`~.events.EventSink` writing
+    ``<telemetry_dir>/run_<run_id>.jsonl`` (registered as an ambient sink
+    so resilience events land in the same file);
+  * a :class:`~.tracer.Tracer` for run/stage/EM-iteration spans, with the
+    per-stage compile-vs-execute split from the compile monitor;
+  * a :class:`~.metrics.MetricsRegistry` snapshotted into the record at
+    the end of each public linker call.
+
+Every emitting method is wrapped to never raise: a telemetry bug must not
+take down the run it observes.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import time
+import uuid
+import weakref
+from contextlib import contextmanager
+
+from .events import EventSink, register_ambient
+from .metrics import (
+    MetricsRegistry,
+    compile_totals,
+    device_memory_snapshot,
+    install_compile_monitor,
+)
+from .tracer import Tracer
+
+logger = logging.getLogger("splink_tpu")
+
+
+def _never_raise(fn):
+    """Telemetry emission must never break the run it observes."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        try:
+            return fn(self, *args, **kwargs)
+        except Exception as e:  # noqa: BLE001 - observability is best-effort
+            logger.warning("telemetry %s failed: %s", fn.__name__, e)
+            return None
+
+    return wrapper
+
+
+class RunContext:
+    """Telemetry scope for one linker run (see module docstring)."""
+
+    def __init__(
+        self,
+        run_id: str | None = None,
+        sink: EventSink | None = None,
+        memory_snapshots: bool = True,
+        config_hash: str = "",
+    ):
+        self.run_id = run_id or uuid.uuid4().hex[:12]
+        self.sink = sink
+        self.memory_snapshots = memory_snapshots
+        self.tracer = Tracer()
+        self.metrics = MetricsRegistry()
+        self._t0 = time.monotonic()
+        # EM stream state: parent span + previous params for the host-side
+        # delta/max-movement computation (the io_callback hook hands us the
+        # new params; the dataflow is untouched)
+        self._em_parent: int | None = None
+        self._em_prev = None
+        self._em_last_mono: float | None = None
+        if sink is not None:
+            install_compile_monitor()
+            register_ambient(sink)
+            sink.emit("run_start", config_hash=config_hash)
+            # The ambient registry holds a strong reference to the sink, so
+            # without this a dropped linker would keep receiving (and
+            # misattributing) every later run's resilience events, and file
+            # handles would accumulate for the life of the process. Closing
+            # unregisters; close() is idempotent, so an explicit close()
+            # before collection is also fine.
+            self._finalizer = weakref.finalize(self, sink.close)
+
+    @property
+    def enabled(self) -> bool:
+        return self.sink is not None
+
+    @classmethod
+    def from_settings(cls, settings: dict) -> "RunContext":
+        """Build the run's context from (completed or partial) settings;
+        disabled unless ``telemetry_dir`` is set."""
+        run_id = uuid.uuid4().hex[:12]
+        tdir = settings.get("telemetry_dir") or ""
+        sink = None
+        if tdir:
+            try:
+                from ..parallel.distributed import host_tags
+
+                tags = host_tags()
+                path = os.path.join(
+                    os.path.expanduser(tdir), f"run_{run_id}.jsonl"
+                )
+                sink = EventSink(path, run_id, tags)
+            except Exception as e:  # noqa: BLE001 - telemetry must not block init
+                logger.warning("telemetry disabled (sink init failed): %s", e)
+                sink = None
+        ctx = cls(
+            run_id=run_id,
+            sink=sink,
+            memory_snapshots=bool(settings.get("telemetry_memory", True)),
+        )
+        return ctx
+
+    # -- stage spans (driven by utils.profiling.StageTimer) ---------------
+
+    @_never_raise
+    def stage_enter(self, stage: str):
+        if not self.enabled:
+            return None
+        sid = self.tracer.begin(stage, kind="stage")
+        return (sid, compile_totals())
+
+    @_never_raise
+    def stage_exit(self, token, stage: str, elapsed: float, failed: bool = False):
+        if not self.enabled or token is None:
+            return
+        sid, (c0_count, c0_secs) = token
+        c1_count, c1_secs = compile_totals()
+        compile_s = max(c1_secs - c0_secs, 0.0)
+        span = self.tracer.end(
+            sid,
+            compile_count=c1_count - c0_count,
+            compile_s=compile_s,
+            execute_s=max(elapsed - compile_s, 0.0),
+            failed=failed,
+        )
+        self.sink.emit("span", **span)
+        self.metrics.observe(f"stage_s.{stage}", elapsed)
+        self.metrics.count("compile_count", c1_count - c0_count)
+        self.metrics.count("compile_s", compile_s)
+        self.metrics.count("execute_s", max(elapsed - compile_s, 0.0))
+        if self.memory_snapshots:
+            devices = device_memory_snapshot()
+            if devices:
+                self.sink.emit("memory", stage=stage, devices=devices)
+                peak = max(d.get("peak_bytes_in_use") or 0 for d in devices)
+                if peak:
+                    self.metrics.gauge("peak_bytes_in_use", peak)
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Standalone span context (bench.py and non-StageTimer callers)."""
+        token = self.stage_enter(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException:
+            self.stage_exit(token, name, time.perf_counter() - t0, failed=True)
+            raise
+        self.stage_exit(token, name, time.perf_counter() - t0)
+
+    # -- EM convergence stream --------------------------------------------
+
+    @_never_raise
+    def em_begin(self, mode: str, lam0, m0, u0, start_iteration: int = 0):
+        if not self.enabled:
+            return
+        import numpy as np
+
+        self._em_parent = self.tracer.current_id()
+        self._em_prev = (np.asarray(m0, float), np.asarray(u0, float))
+        self._em_last_mono = time.monotonic()
+        self.sink.emit(
+            "em_start", mode=mode, lam=float(lam0),
+            start_iteration=int(start_iteration),
+        )
+
+    @_never_raise
+    def em_update(self, it, lam, m, u, ll=None, converged=False):
+        """One completed EM update (host side of the ``run_em`` host-hook
+        io_callback, or the streamed driver's per-pass callback). Emits an
+        iteration span (bounded by callback arrivals) plus the convergence
+        record: lambda, log-likelihood (under the pre-update params) and
+        ``delta`` — the max absolute m/u parameter movement, recomputed
+        host-side from the streamed params."""
+        if not self.enabled:
+            return
+        import math
+
+        import numpy as np
+
+        now = time.monotonic()
+        it = int(it)
+        m = np.asarray(m, float)
+        u = np.asarray(u, float)
+        delta = None
+        if self._em_prev is not None and self._em_prev[0].shape == m.shape:
+            delta = float(
+                max(
+                    np.max(np.abs(m - self._em_prev[0])),
+                    np.max(np.abs(u - self._em_prev[1])),
+                )
+            )
+        ll_val = None
+        if ll is not None:
+            ll_f = float(ll)
+            ll_val = ll_f if math.isfinite(ll_f) else None
+        t0 = self._em_last_mono if self._em_last_mono is not None else now
+        span = self.tracer.emit_closed(
+            f"em_iteration_{it}", "em_iteration", t0, now,
+            parent=self._em_parent, iteration=it,
+        )
+        self.sink.emit("span", **span)
+        self.sink.emit(
+            "em_iteration",
+            iteration=it,
+            lam=float(lam),
+            ll=ll_val,
+            delta=delta,
+            converged=bool(converged),
+        )
+        self.metrics.count("em_updates")
+        self.metrics.gauge("em_lam", float(lam))
+        if delta is not None:
+            self.metrics.gauge("em_delta", delta)
+        self._em_prev = (m, u)
+        self._em_last_mono = now
+
+    # -- metrics convenience (no-ops when disabled) ------------------------
+
+    def count(self, name: str, n: float = 1) -> None:
+        if self.enabled:
+            self.metrics.count(name, n)
+
+    def gauge(self, name: str, value: float) -> None:
+        if self.enabled:
+            self.metrics.gauge(name, value)
+
+    def observe(self, name: str, value: float) -> None:
+        if self.enabled:
+            self.metrics.observe(name, value)
+
+    def record(self, name: str, payload) -> None:
+        if self.enabled:
+            self.metrics.record(name, payload)
+
+    # -- run completion ----------------------------------------------------
+
+    @_never_raise
+    def finish(self):
+        """Emit the metrics snapshot and a run span. Called at the end of
+        each public linker entry point; safe to call repeatedly (summaries
+        are cumulative — readers take the LAST metrics/run events). The
+        sink stays open: later calls on the same linker append to the same
+        record."""
+        if not self.enabled:
+            return
+        self.sink.emit("metrics", **self.metrics.snapshot())
+        span = self.tracer.emit_closed(
+            "run", "run", self._t0, time.monotonic(), parent=None
+        )
+        self.sink.emit("span", **span)
+
+    def close(self) -> None:
+        """Close the sink now (unregisters it from the ambient publisher).
+        Otherwise happens automatically when the context is collected."""
+        if self.sink is not None:
+            self.sink.close()

@@ -168,6 +168,25 @@ def validate_resume_topology(
         )
 
 
+def host_tags() -> dict:
+    """Per-host identity tags stamped on every telemetry event
+    (``process_index`` / ``process_count``), so a multi-controller run's
+    merged JSONL records attribute each event to its controller.
+
+    Deliberately does NOT call ``jax.process_count()`` unless the
+    multi-controller runtime is already up: that call initialises the XLA
+    backend, and telemetry sinks are created at linker construction —
+    before ``initialize_multihost`` callers may have wired the cluster.
+    A single-process run IS process 0 of 1, so the fallback is exact.
+    """
+    if not distributed_is_initialized():
+        return {"process_index": 0, "process_count": 1}
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+    }
+
+
 def global_pair_slice(n_pairs_global: int) -> slice:
     """The half-open range of global pair indices this host is responsible
     for feeding. Hosts stream disjoint slices; the psum in the EM stats makes

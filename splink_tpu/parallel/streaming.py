@@ -51,6 +51,7 @@ def run_em_streamed(
     start_iteration: int = 0,
     retry_policy=None,
     fault_plan=None,
+    telemetry=None,
 ):
     """EM over a re-iterable stream of gamma batches.
 
@@ -88,6 +89,10 @@ def run_em_streamed(
             ``batch_fetch`` (per batch) and ``em_iteration`` (per update)
             injection sites; None resolves the process's active plan
             (SPLINK_TPU_FAULTS).
+        telemetry: optional ``obs.runtime.RunContext`` — emits one EM
+            convergence record per pass (the streamed loop is host-driven,
+            so this adds no host callback to any compiled program) plus a
+            pass counter.
 
     Returns (params, histories, n_updates, converged) mirroring run_em.
     """
@@ -168,6 +173,12 @@ def run_em_streamed(
         if compute_ll:
             ll_hist.append(ll_total)
         converged_now = delta < em_convergence
+        if telemetry is not None:
+            telemetry.em_update(
+                it, float(params.lam), params.m, params.u,
+                ll_total if compute_ll else None, converged_now,
+            )
+            telemetry.count("em_stream_passes")
         if on_iteration is not None:
             # the convergence flag rides along so a checkpoint written at
             # the converging iteration records converged=True — a resume

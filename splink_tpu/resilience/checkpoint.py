@@ -168,6 +168,14 @@ def save_checkpoint(directory: str | os.PathLike, ckpt: EMCheckpoint) -> str:
     logger.debug(
         "checkpoint saved: %s (iteration %d)", final, ckpt.iteration
     )
+    from ..obs.events import publish
+
+    publish(
+        "checkpoint",
+        path=final,
+        iteration=int(ckpt.iteration),
+        converged=bool(ckpt.converged),
+    )
     return final
 
 

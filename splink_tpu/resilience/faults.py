@@ -126,6 +126,12 @@ class FaultPlan:
         for ev in self.events:
             if ev.matches(site, coords):
                 ev.times -= 1
+                # emit BEFORE raising/killing: the telemetry record must
+                # show the fault that a kill prevents any later code from
+                # reporting (the sink flushes per event)
+                from ..obs.events import publish
+
+                publish("fault", site=site, kind=ev.kind, coords=dict(coords))
                 if ev.kind == "kill":
                     logger.warning(
                         "fault injection: SIGKILL self at %s %s", site, coords

@@ -55,6 +55,8 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "max_resident_pairs",
     "spill_dir",
     "profile_dir",
+    "telemetry_dir",
+    "telemetry_memory",
     # NOTE: compilation_cache_dir is deliberately NOT auto-filled. The
     # linker must be able to tell a user-set value (opts in on any
     # backend) from the schema default (accelerator backends only), and

@@ -34,6 +34,13 @@ def warn_degraded(from_mode: str, to_mode: str, reason: str, **info) -> None:
         "degrade", **{"from": from_mode, "to": to_mode, "reason": reason}, **info
     )
     logger.warning("%s", line)
+    from ..obs.events import publish
+
+    publish(
+        "degradation",
+        **{"from": from_mode, "to": to_mode, "reason": reason},
+        **info,
+    )
     warnings.warn(
         f"execution degraded from {from_mode} to {to_mode}: {reason}",
         DegradationWarning,

@@ -1,27 +1,85 @@
-"""Per-stage wall-clock timing and optional jax profiler trace hooks."""
+"""Per-stage wall-clock timing and optional jax profiler trace hooks.
+
+Timings and profiler-trace targets are keyed by RUN, not process: each
+linker registers its own run scope at construction (:func:`begin_run`), so
+two linkers in one process no longer interleave their stage timings or
+clobber each other's ``profile_dir`` (the process-global ``_TIMINGS`` /
+``_TRACE_DIR`` of earlier builds). ``stage_timings()`` keeps its historical
+signature and returns the CURRENT run's timings; pass ``run=`` to read a
+specific linker's (``Splink._obs.run_id``).
+
+StageTimers constructed outside any run scope (ad-hoc profiling, tests)
+land in a default scope, which behaves exactly like the old process-global
+one.
+"""
 
 from __future__ import annotations
 
 import contextlib
+import os
 import time
-from collections import defaultdict
 
-_TIMINGS: dict[str, list[float]] = defaultdict(list)
+# run key -> stage -> [seconds]; "" is the default (no-linker) scope
+_DEFAULT_RUN = ""
+_TIMINGS: dict[str, dict[str, list[float]]] = {_DEFAULT_RUN: {}}
 
-# Process-wide profiler target (set from settings["profile_dir"] by the
-# linker): device-heavy stages then capture a perfetto/tensorboard trace
-# under <dir>/<stage>. One flag -> utilisation data for an EM pass, the
-# analogue of inspecting a Spark UI stage timeline.
-_TRACE_DIR: str | None = None
+# Per-run profiler target (from settings["profile_dir"]): device-heavy
+# stages then capture a perfetto/tensorboard trace under <dir>/<stage>. One
+# flag -> utilisation data for an EM pass, the analogue of inspecting a
+# Spark UI stage timeline.
+_TRACE_DIRS: dict[str, str | None] = {_DEFAULT_RUN: None}
+_CURRENT_RUN = _DEFAULT_RUN
 _TRACED_STAGES = {"gammas", "gammas_patterns", "em", "em_streamed"}
-_TRACE_ACTIVE = False  # jax.profiler.trace cannot nest
+# jax.profiler.trace cannot nest — ONE process-wide flag regardless of run
+_TRACE_ACTIVE = False
+
+
+# Retained run scopes are bounded: a long-lived service constructing one
+# linker per request must not grow _TIMINGS forever (the per-process leak
+# this module's run-scoping was built to fix). Oldest completed scopes are
+# evicted FIFO past this cap; the default scope and the current run are
+# never evicted.
+_MAX_RETAINED_RUNS = 64
+
+
+def begin_run(run_id: str, trace_dir: str | None = None) -> str:
+    """Open (and make current) a run scope with fresh timings. Called by
+    the linker at construction; a later linker beginning its own run leaves
+    this one's timings and trace dir untouched (until it ages past the
+    ``_MAX_RETAINED_RUNS`` eviction window)."""
+    global _CURRENT_RUN
+    _TIMINGS[run_id] = {}
+    _TRACE_DIRS[run_id] = trace_dir or None
+    _CURRENT_RUN = run_id
+    while len(_TIMINGS) > _MAX_RETAINED_RUNS + 1:  # +1: the default scope
+        oldest = next(
+            (k for k in _TIMINGS if k not in (_DEFAULT_RUN, _CURRENT_RUN)),
+            None,
+        )
+        if oldest is None:  # pragma: no cover - cap >= 1 prevents this
+            break
+        _TIMINGS.pop(oldest, None)
+        _TRACE_DIRS.pop(oldest, None)
+    return run_id
+
+
+def discard_run(run_id: str) -> None:
+    """Drop a run scope's recorded state (tests / long-lived processes)."""
+    global _CURRENT_RUN
+    if run_id == _DEFAULT_RUN:
+        _TIMINGS[_DEFAULT_RUN] = {}
+        _TRACE_DIRS[_DEFAULT_RUN] = None
+        return
+    _TIMINGS.pop(run_id, None)
+    _TRACE_DIRS.pop(run_id, None)
+    if _CURRENT_RUN == run_id:
+        _CURRENT_RUN = _DEFAULT_RUN
 
 
 def set_trace_dir(path: str | None) -> None:
     """Enable (or disable with None) jax profiler traces for device-heavy
-    stages. Called by the linker when settings["profile_dir"] is set."""
-    global _TRACE_DIR
-    _TRACE_DIR = path
+    stages of the CURRENT run scope."""
+    _TRACE_DIRS[_CURRENT_RUN] = path or None
 
 
 class StageTimer(contextlib.AbstractContextManager):
@@ -31,42 +89,83 @@ class StageTimer(contextlib.AbstractContextManager):
 
         with StageTimer("blocking"):
             ...
+
+    Args:
+        stage: stage name the elapsed time is recorded under.
+        trace_dir: capture a jax profiler trace of the stage here
+            (overrides the run's profile_dir resolution).
+        run: run scope to record into (default: the current scope).
+        telemetry: optional ``obs.runtime.RunContext`` — the stage is also
+            emitted as a telemetry span with its compile/execute split and
+            a device-memory snapshot at the boundary.
     """
 
-    def __init__(self, stage: str, trace_dir: str | None = None):
+    def __init__(
+        self,
+        stage: str,
+        trace_dir: str | None = None,
+        run: str | None = None,
+        telemetry=None,
+    ):
         self.stage = stage
-        if trace_dir is None and _TRACE_DIR and stage in _TRACED_STAGES:
-            import os
-
-            trace_dir = os.path.join(_TRACE_DIR, stage)
+        self.run = _CURRENT_RUN if run is None else run
+        self.telemetry = telemetry
+        if trace_dir is None:
+            run_dir = _TRACE_DIRS.get(self.run)
+            if run_dir and stage in _TRACED_STAGES:
+                trace_dir = os.path.join(run_dir, stage)
         self.trace_dir = trace_dir
         self._trace = None
+        self._token = None
 
     def __enter__(self):
         global _TRACE_ACTIVE
         if self.trace_dir and not _TRACE_ACTIVE:
             import jax
 
-            self._trace = jax.profiler.trace(self.trace_dir)
-            self._trace.__enter__()
+            trace = jax.profiler.trace(self.trace_dir)
+            trace.__enter__()
+            # only mark active once the profiler actually started: a failed
+            # trace.__enter__ must not leave the flag stuck True
+            self._trace = trace
             _TRACE_ACTIVE = True
+        if self.telemetry is not None:
+            self._token = self.telemetry.stage_enter(self.stage)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         global _TRACE_ACTIVE
         self.elapsed = time.perf_counter() - self._t0
-        _TIMINGS[self.stage].append(self.elapsed)
-        if self._trace is not None:
-            self._trace.__exit__(*exc)
-            _TRACE_ACTIVE = False
+        _TIMINGS.setdefault(self.run, {}).setdefault(self.stage, []).append(
+            self.elapsed
+        )
+        try:
+            if self._trace is not None:
+                trace, self._trace = self._trace, None
+                try:
+                    trace.__exit__(*exc)
+                finally:
+                    # exception-safe: a raising profiler exit must still
+                    # release the process-wide flag or no later stage could
+                    # ever trace again
+                    _TRACE_ACTIVE = False
+        finally:
+            if self.telemetry is not None:
+                self.telemetry.stage_exit(
+                    self._token, self.stage, self.elapsed,
+                    failed=exc[0] is not None,
+                )
         return False
 
 
-def stage_timings() -> dict[str, list[float]]:
-    """All recorded stage timings for this process (stage -> list of seconds)."""
-    return dict(_TIMINGS)
+def stage_timings(run: str | None = None) -> dict[str, list[float]]:
+    """Recorded stage timings (stage -> list of seconds) for the current
+    run scope, or for ``run`` when given."""
+    key = _CURRENT_RUN if run is None else run
+    return {k: list(v) for k, v in _TIMINGS.get(key, {}).items()}
 
 
-def reset_timings() -> None:
-    _TIMINGS.clear()
+def reset_timings(run: str | None = None) -> None:
+    key = _CURRENT_RUN if run is None else run
+    _TIMINGS[key] = {}

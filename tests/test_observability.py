@@ -419,3 +419,139 @@ def test_compilation_cache_dir_applies(tmp_path):
         from jax._src import compilation_cache as _cc
 
         _cc.reset_cache()
+
+
+# ----------------------------------------------------------------------
+# Run-scoped profiling (utils/profiling.py): timings and trace dirs are
+# keyed by run id — two linkers in one process no longer interleave
+# timings or clobber each other's profile_dir.
+# ----------------------------------------------------------------------
+
+
+def _tiny_df(n=60, seed=0):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["a", "b", "c"], n),
+            "city": rng.choice(["x", "y"], n),
+        }
+    )
+
+
+def _tiny_settings(**over):
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "name", "comparison": {"kind": "exact"}}
+        ],
+        "blocking_rules": ["l.city = r.city"],
+        "max_iterations": 2,
+    }
+    s.update(over)
+    return s
+
+
+def test_timings_scoped_per_linker_run():
+    """Two linkers record into separate run scopes; stage_timings() reads
+    the CURRENT run and stage_timings(run=...) a specific linker's."""
+    from splink_tpu import Splink
+    from splink_tpu.utils.profiling import stage_timings
+
+    a = Splink(_tiny_settings(), df=_tiny_df(seed=1))
+    a.get_scored_comparisons()
+    t_a = stage_timings(run=a.run_id)
+    assert "em" in t_a and len(t_a["em"]) == 1
+
+    # constructing linker B opens (and makes current) a FRESH scope
+    b = Splink(_tiny_settings(), df=_tiny_df(seed=2))
+    assert stage_timings() == {}
+    b.get_scored_comparisons()
+    assert len(stage_timings(run=b.run_id)["em"]) == 1
+    # A's record is untouched by B's run (the old process-global _TIMINGS
+    # would have interleaved them)
+    assert stage_timings(run=a.run_id) == t_a
+
+    # interleaved construction: A2 built BEFORE B2 runs still records into
+    # its own scope when driven afterwards
+    a2 = Splink(_tiny_settings(), df=_tiny_df(seed=3))
+    b2 = Splink(_tiny_settings(), df=_tiny_df(seed=4))
+    b2.get_scored_comparisons()
+    a2.get_scored_comparisons()
+    assert len(stage_timings(run=a2.run_id)["em"]) == 1
+    assert len(stage_timings(run=b2.run_id)["em"]) == 1
+
+
+def test_later_linker_does_not_clear_earlier_trace_dir(tmp_path):
+    """A later linker WITHOUT profile_dir must not disable an earlier
+    linker's trace capture (the old process-global _TRACE_DIR did:
+    linker.py cleared it unconditionally on every construction)."""
+    import os
+
+    from splink_tpu import Splink
+
+    a = Splink(_tiny_settings(profile_dir=str(tmp_path)), df=_tiny_df(seed=5))
+    Splink(_tiny_settings(), df=_tiny_df(seed=6))  # no profile_dir
+    a.get_scored_comparisons()
+    found = [
+        os.path.join(root, f)
+        for root, _dirs, files in os.walk(tmp_path)
+        for f in files
+    ]
+    assert found, "later linker clobbered the first linker's profile_dir"
+
+
+def test_stage_timer_does_not_nest_profiler_traces(tmp_path):
+    """jax.profiler.trace cannot nest: an inner StageTimer with a trace
+    dir must skip tracing while an outer trace is active (and trace again
+    once it is released)."""
+    from splink_tpu.utils import profiling
+    from splink_tpu.utils.profiling import StageTimer
+
+    outer_dir = str(tmp_path / "outer")
+    inner_dir = str(tmp_path / "inner")
+    with StageTimer("outer", trace_dir=outer_dir) as outer:
+        assert outer._trace is not None and profiling._TRACE_ACTIVE
+        with StageTimer("inner", trace_dir=inner_dir) as inner:
+            assert inner._trace is None  # skipped: a trace is active
+        assert profiling._TRACE_ACTIVE  # inner exit didn't release the flag
+    assert not profiling._TRACE_ACTIVE
+    with StageTimer("after", trace_dir=str(tmp_path / "after")) as after:
+        assert after._trace is not None
+    assert not profiling._TRACE_ACTIVE
+
+
+def test_stage_timer_trace_active_exception_safety(tmp_path):
+    """_TRACE_ACTIVE is released when the stage body raises, and even when
+    the profiler's own __exit__ raises — otherwise no later stage could
+    ever trace again."""
+    import pytest
+
+    from splink_tpu.utils import profiling
+    from splink_tpu.utils.profiling import StageTimer
+
+    with pytest.raises(RuntimeError, match="boom"):
+        with StageTimer("failing", trace_dir=str(tmp_path / "t1")):
+            raise RuntimeError("boom")
+    assert not profiling._TRACE_ACTIVE
+
+    class _ExplodingTrace:
+        def __exit__(self, *exc):
+            raise OSError("profiler write failed")
+
+    # simulate a profiler whose own __exit__ raises WITHOUT opening a real
+    # jax trace (overwriting a live trace object would leak the singleton
+    # profiler session into later tests)
+    timer = StageTimer("bad_exit")
+    with pytest.raises(OSError, match="profiler write failed"):
+        with timer:
+            profiling._TRACE_ACTIVE = True
+            timer._trace = _ExplodingTrace()
+    assert not profiling._TRACE_ACTIVE
+    # timing was still recorded for the failing stage
+    from splink_tpu.utils.profiling import stage_timings
+
+    assert "bad_exit" in stage_timings()

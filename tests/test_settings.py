@@ -159,3 +159,57 @@ def test_backend_key_is_read_and_checked():
     # and the accepted value flows through
     linker = Splink({**s, "backend": "jax"}, df=df)
     assert linker.settings["backend"] == "jax"
+
+
+def test_observability_defaults_filled():
+    """profile_dir and the telemetry keys complete from the schema (the
+    schema is the single source of truth for their defaults)."""
+    s = complete_settings_dict(_minimal())
+    assert s["profile_dir"] == ""
+    assert s["telemetry_dir"] == ""
+    assert s["telemetry_memory"] is True
+
+
+def test_observability_keys_validate_types():
+    """Schema validation rejects wrongly-typed observability keys and
+    accepts correctly-typed ones."""
+    for bad in (
+        {"profile_dir": 5},
+        {"telemetry_dir": 5},
+        {"telemetry_dir": ["x"]},
+        {"telemetry_memory": "yes"},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    validate_settings(
+        _minimal(
+            profile_dir="/tmp/prof",
+            telemetry_dir="/tmp/tel",
+            telemetry_memory=False,
+        )
+    )
+
+
+def test_telemetry_settings_flow_into_run_context(tmp_path):
+    """telemetry_dir turns the linker's RunContext on; telemetry_memory
+    flows through; no telemetry_dir -> disabled context."""
+    import pandas as pd
+
+    from splink_tpu import Splink
+
+    df = pd.DataFrame({"unique_id": [0, 1], "a": ["x", "x"]})
+    base = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [{"col_name": "a", "comparison": {"kind": "exact"}}],
+        "blocking_rules": ["l.a = r.a"],
+    }
+    off = Splink(dict(base), df=df)
+    assert off._obs.enabled is False
+    on = Splink(
+        {**base, "telemetry_dir": str(tmp_path), "telemetry_memory": False},
+        df=df,
+    )
+    assert on._obs.enabled is True
+    assert on._obs.memory_snapshots is False
+    assert on._obs.sink.path.startswith(str(tmp_path))
+    on._obs.close()

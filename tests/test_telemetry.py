@@ -1,0 +1,411 @@
+"""Telemetry subsystem (splink_tpu/obs): JSONL run records, span tracer,
+metrics registry, EM convergence stream, resilience events, CLI round-trip
+— and the zero-cost / bit-identical contracts the ISSUE pins:
+
+  * telemetry-enabled e2e run -> run/stage/iteration spans, metrics, EM
+    convergence records, and resilience events under fault injection, all
+    in one JSONL file;
+  * the EM parameter trajectory is bit-identical with telemetry on or off
+    (the convergence stream rides an io_callback that touches no dataflow);
+  * with no sink configured nothing is written and no ambient sink exists
+    (the jaxpr-level half of zero-cost is pinned by test_trace_audit /
+    test_codebase_clean via the em_step vs em_step_telemetry kernels).
+"""
+
+import glob
+import json
+import os
+import warnings
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu import Splink
+from splink_tpu.obs.cli import main as obs_cli
+from splink_tpu.obs.events import read_events
+from splink_tpu.utils.logging_utils import DegradationWarning
+
+
+def people_df(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "name": rng.choice(["ann", "bob", "cat", "dan"], n),
+            "city": rng.choice(["x", "y", "z"], n),
+        }
+    )
+
+
+def settings(**over):
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "name", "num_levels": 2, "comparison": {"kind": "exact"}}
+        ],
+        "blocking_rules": ["l.city = r.city"],
+        "max_iterations": 6,
+    }
+    s.update(over)
+    return s
+
+
+def run_events(linker):
+    """The telemetry events this linker wrote."""
+    return read_events(linker._obs.sink.path)
+
+
+def test_e2e_record_has_spans_metrics_em_and_resilience(tmp_path):
+    """Acceptance: one e2e run under fault injection produces run/stage/
+    iteration spans, metrics, EM convergence records and resilience
+    events, and both CLI commands round-trip the file."""
+    from splink_tpu.resilience.faults import reset_plans
+
+    reset_plans()
+    linker = Splink(
+        settings(
+            telemetry_dir=str(tmp_path),
+            fault_plan="resident_em@kind=oom",
+        ),
+        df=people_df(),
+    )
+    with pytest.warns(DegradationWarning):
+        df_e = linker.get_scored_comparisons(compute_ll=True)
+    assert len(df_e)
+
+    events = run_events(linker)
+    types = {e["type"] for e in events}
+    assert {"run_start", "span", "em_iteration", "em_start", "metrics"} <= types
+    # resilience chain under fault injection: the injected OOM plus the
+    # resident -> streamed degradation it triggers
+    assert "fault" in types and "degradation" in types
+
+    # spans: run + stages + EM iterations, all on the same run id
+    assert {e["run_id"] for e in events} == {linker.run_id}
+    spans = [e for e in events if e["type"] == "span"]
+    kinds = {e["kind"] for e in spans}
+    assert {"run", "stage", "em_iteration"} <= kinds
+    stage_names = {e["name"] for e in spans if e["kind"] == "stage"}
+    assert {"encode", "blocking", "em_streamed"} <= stage_names
+    for e in spans:
+        assert e["t1"] >= e["t0"] and e["dur_s"] >= 0
+
+    # EM convergence stream: monotone iterations, lambda + delta recorded,
+    # log-likelihood present (compute_ll=True), final update converged
+    iters = [e for e in events if e["type"] == "em_iteration"]
+    assert [e["iteration"] for e in iters] == list(range(1, len(iters) + 1))
+    assert all(0 <= e["lam"] <= 1 for e in iters)
+    assert all(e["delta"] is not None for e in iters)
+    assert any(e["ll"] is not None for e in iters)
+    assert iters[-1]["converged"] is True
+
+    # metrics snapshot: counters, compile split, and the block/gamma records
+    snap = [e for e in events if e["type"] == "metrics"][-1]
+    c = snap["counters"]
+    assert c["rows_encoded"] == 200
+    assert c["pairs_blocked"] == len(df_e)
+    assert c["pairs_scored_output"] == len(df_e)
+    assert c["em_updates"] == len(iters)
+    assert c["compile_count"] > 0 and c["compile_s"] > 0
+    gh = snap["records"]["gamma_histogram"]
+    assert sum(gh["name"]) == len(df_e)  # every pair lands in one level bin
+    blocks = snap["records"]["largest_blocks"]
+    assert blocks[0]["rule"] == "l.city = r.city"
+    assert blocks[0]["n_groups"] == 3  # cities x, y, z
+    assert sum(blocks[0]["top_group_rows"]) == 200
+
+    # per-host tagging (single controller: process 0 of 1)
+    assert all(e["process_index"] == 0 and e["process_count"] == 1 for e in events)
+
+    # CLI round-trip: summarize and chrome-trace export
+    path = linker._obs.sink.path
+    assert obs_cli(["summarize", path]) == 0
+    out = str(tmp_path / "trace.json")
+    assert obs_cli(["export-trace", path, "-o", out]) == 0
+    trace = json.load(open(out))
+    names = {e["name"] for e in trace["traceEvents"] if e.get("ph") == "X"}
+    assert {"run", "encode", "blocking", "em_streamed"} <= names
+
+
+def test_em_trajectory_bit_identical_with_telemetry(tmp_path):
+    """The convergence stream must not perturb the dataflow: parameter
+    history and scores are bit-identical with telemetry on vs off."""
+    df = people_df(seed=3)
+    a = Splink(settings(), df=df)
+    out_a = a.get_scored_comparisons(compute_ll=True)
+    b = Splink(settings(telemetry_dir=str(tmp_path)), df=df)
+    out_b = b.get_scored_comparisons(compute_ll=True)
+
+    assert len(a.params.param_history) == len(b.params.param_history)
+    for pa, pb in zip(a.params.param_history, b.params.param_history):
+        assert pa == pb
+    np.testing.assert_array_equal(
+        out_a.match_probability.to_numpy(), out_b.match_probability.to_numpy()
+    )
+    # and the streamed record agrees with the installed history
+    iters = [e for e in run_events(b) if e["type"] == "em_iteration"]
+    assert len(iters) == len(b.params.param_history)
+    assert iters[-1]["lam"] == pytest.approx(float(b.params.params["λ"]), rel=1e-6)
+
+
+def test_disabled_telemetry_writes_nothing(tmp_path):
+    """No telemetry_dir -> no sink, no ambient registration, no files."""
+    from splink_tpu.obs import events as ev
+
+    before = list(ev._AMBIENT)
+    linker = Splink(settings(), df=people_df())
+    assert linker._obs.enabled is False
+    assert linker._obs.sink is None
+    linker.get_scored_comparisons()
+    assert list(ev._AMBIENT) == before
+    assert not glob.glob(str(tmp_path / "*.jsonl"))
+
+
+def test_checkpoint_events_in_record(tmp_path):
+    """Checkpointed EM publishes structured checkpoint events into the
+    same run record."""
+    ckpt = tmp_path / "ckpt"
+    tel = tmp_path / "tel"
+    linker = Splink(settings(telemetry_dir=str(tel)), df=people_df(seed=5))
+    linker.estimate_parameters(checkpoint_dir=str(ckpt))
+    events = run_events(linker)
+    ckpts = [e for e in events if e["type"] == "checkpoint"]
+    assert ckpts, "no checkpoint events published"
+    assert ckpts[-1]["converged"] is True
+    assert os.path.exists(ckpts[-1]["path"])
+    # estimate_parameters is EM-only: the record still has stage spans + EM
+    assert any(e["type"] == "em_iteration" for e in events)
+
+
+def streamed_settings(**over):
+    """Settings that land in the streamed-EM regime: a custom comparison
+    kernel disqualifies the pattern pipeline, and the max_resident_pairs
+    floor pushes the gamma matrix out of the resident path."""
+    import splink_tpu
+
+    def _tel_name_exact(ctx, col_settings):
+        import jax.numpy as jnp
+
+        c = ctx.col("name")
+        eq = (c.chars_l == c.chars_r).all(axis=1)
+        return jnp.where(c.null, jnp.int8(-1), eq.astype(jnp.int8))
+
+    splink_tpu.register_comparison("tel_name_exact", _tel_name_exact)
+    s = settings(max_resident_pairs=1024, **over)
+    s["comparison_columns"] = list(s["comparison_columns"]) + [
+        {
+            "custom_name": "name_custom",
+            "custom_columns_used": ["name"],
+            "num_levels": 2,
+            "comparison": {"kind": "custom", "fn": "tel_name_exact"},
+        }
+    ]
+    return s
+
+
+def test_streamed_em_emits_convergence_records(tmp_path):
+    """The streamed regime produces per-pass EM records — the streamed
+    driver emits host-side (no compiled-program change at all)."""
+    linker = Splink(
+        streamed_settings(telemetry_dir=str(tmp_path)), df=people_df(seed=7)
+    )
+    linker.get_scored_comparisons()
+    events = run_events(linker)
+    assert any(
+        e["type"] == "em_start" and e["mode"] == "streamed" for e in events
+    )
+    assert any(e["type"] == "em_iteration" for e in events)
+    snap = [e for e in events if e["type"] == "metrics"][-1]
+    assert snap["counters"]["em_stream_passes"] >= 1
+
+
+def test_retry_events_published(tmp_path):
+    """A transient injected fault in the streamed pass publishes a retry
+    event (and the pass succeeds on the retry, bit-identically)."""
+    from splink_tpu.resilience.faults import reset_plans
+
+    reset_plans()
+    linker = Splink(
+        streamed_settings(
+            telemetry_dir=str(tmp_path),
+            fault_plan="batch_fetch@iter=1:batch=0",
+        ),
+        df=people_df(seed=9),
+    )
+    linker.get_scored_comparisons()
+    events = run_events(linker)
+    faults = [e for e in events if e["type"] == "fault"]
+    retries = [e for e in events if e["type"] == "retry"]
+    assert faults and faults[0]["site"] == "batch_fetch"
+    assert retries and retries[0]["attempt"] == 1
+
+
+def test_dropped_linker_stops_receiving_ambient_events(tmp_path):
+    """A collected (or explicitly closed) linker's sink unregisters from
+    the ambient publisher: later runs' resilience events no longer land in
+    — and misattribute to — the earlier run's record, and file handles
+    don't accumulate."""
+    import gc
+
+    from splink_tpu.obs import events as ev
+    from splink_tpu.obs.events import publish
+
+    a = Splink(settings(telemetry_dir=str(tmp_path / "a")), df=people_df())
+    path_a = a._obs.sink.path
+    assert a._obs.sink in ev._AMBIENT
+    del a
+    gc.collect()
+    publish("retry", label="late", attempt=1)
+    assert all(e["type"] != "retry" for e in read_events(path_a))
+
+    b = Splink(settings(telemetry_dir=str(tmp_path / "b")), df=people_df())
+    path_b = b._obs.sink.path
+    b.close_telemetry()  # explicit close, before collection
+    assert b._obs.sink not in ev._AMBIENT
+    publish("retry", label="late2", attempt=1)
+    assert all(e["type"] != "retry" for e in read_events(path_b))
+
+
+def test_summarize_handles_null_numeric_fields(tmp_path):
+    """A diverged EM emits lam=NaN, which the sink sanitises to null; the
+    summarize CLI must render it, not crash (it exists for exactly these
+    pathological runs)."""
+    from splink_tpu.obs.events import EventSink
+
+    p = tmp_path / "run_div.jsonl"
+    sink = EventSink(p, "div")
+    sink.emit("em_iteration", iteration=1, lam=float("nan"), ll=None,
+              delta=None, converged=False)
+    sink.emit("em_iteration", iteration=None, lam=0.5, converged=False)
+    sink.close()
+    assert obs_cli(["summarize", str(p)]) == 0
+
+
+def test_block_stats_bound_matches_estimator():
+    """block_size_stats and estimate_pair_upper_bound share one per-rule
+    definition: their pair bounds must agree."""
+    from splink_tpu.blocking import block_size_stats, estimate_pair_upper_bound
+    from splink_tpu.data import encode_table
+    from splink_tpu.settings import complete_settings_dict
+
+    s = complete_settings_dict(settings())
+    table = encode_table(people_df(), s)
+    stats = block_size_stats(s, table, None)
+    assert sum(e["pair_bound"] for e in stats) == estimate_pair_upper_bound(
+        s, table, None
+    )
+
+
+def test_em_iteration_spans_parented_to_stage(tmp_path):
+    """em_iteration spans link to the enclosing em stage span."""
+    linker = Splink(settings(telemetry_dir=str(tmp_path)), df=people_df())
+    linker.get_scored_comparisons()
+    events = run_events(linker)
+    spans = [e for e in events if e["type"] == "span"]
+    [em_stage] = [e for e in spans if e["kind"] == "stage" and e["name"] == "em"]
+    iter_spans = [e for e in spans if e["kind"] == "em_iteration"]
+    assert iter_spans
+    assert all(e["parent_id"] == em_stage["span_id"] for e in iter_spans)
+
+
+def test_sink_failure_disables_not_raises(tmp_path):
+    """A sink whose file dies mid-run disables itself; the run completes."""
+    linker = Splink(settings(telemetry_dir=str(tmp_path)), df=people_df())
+    linker._obs.sink._f.close()  # simulate the file handle dying
+    df_e = linker.get_scored_comparisons()  # must not raise
+    assert len(df_e)
+    assert linker._obs.sink._failed is True
+
+
+def test_summarize_empty_and_corrupt_lines(tmp_path):
+    """read_events skips torn lines (SIGKILL mid-write); summarize copes
+    with an empty record."""
+    p = tmp_path / "run_x.jsonl"
+    p.write_text('{"v":1,"run_id":"x","type":"run_start","ts":1,"mono":1}\n{"torn')
+    events = read_events(p)
+    assert len(events) == 1
+    assert obs_cli(["summarize", str(p)]) == 0
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert obs_cli(["summarize", str(empty)]) == 0
+
+
+def test_chrome_trace_from_events_structure():
+    from splink_tpu.obs.tracer import chrome_trace_from_events
+
+    events = [
+        {"type": "span", "kind": "stage", "name": "em", "t0": 1.0, "t1": 2.5,
+         "dur_s": 1.5, "attrs": {"compile_s": 0.5}, "process_index": 0},
+        {"type": "em_iteration", "iteration": 1, "lam": 0.3, "mono": 2.0,
+         "process_index": 0},
+    ]
+    trace = chrome_trace_from_events(events)
+    slices = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    instants = [e for e in trace["traceEvents"] if e.get("ph") == "i"]
+    assert slices[0]["name"] == "em" and slices[0]["dur"] == pytest.approx(1.5e6)
+    assert slices[0]["ts"] == pytest.approx(1.0e6)
+    assert instants and instants[0]["args"]["iteration"] == 1
+
+
+def test_metrics_registry_and_compile_monitor():
+    from splink_tpu.obs.metrics import (
+        MetricsRegistry,
+        compile_totals,
+        install_compile_monitor,
+    )
+
+    r = MetricsRegistry()
+    r.count("a")
+    r.count("a", 2)
+    r.gauge("g", 7.5)
+    r.observe("h", 1.0)
+    r.observe("h", 3.0)
+    r.record("blob", {"x": [1, 2]})
+    snap = r.snapshot()
+    assert snap["counters"]["a"] == 3
+    assert snap["gauges"]["g"] == 7.5
+    assert snap["histograms"]["h"] == {
+        "count": 2, "sum": 4.0, "min": 1.0, "max": 3.0, "mean": 2.0,
+    }
+    assert snap["records"]["blob"] == {"x": [1, 2]}
+
+    import jax
+    import jax.numpy as jnp
+
+    install_compile_monitor()
+    c0, s0 = compile_totals()
+    jax.jit(lambda x: x * 3 + 1).lower(jnp.ones(17)).compile()
+    c1, s1 = compile_totals()
+    assert c1 > c0 and s1 > s0
+
+
+def test_event_sanitisation(tmp_path):
+    """numpy scalars/arrays and non-finite floats serialise to strict JSON."""
+    from splink_tpu.obs.events import EventSink
+
+    sink = EventSink(tmp_path / "s.jsonl", "r1")
+    sink.emit(
+        "x",
+        a=np.float32(1.5),
+        b=np.arange(3),
+        c=float("nan"),
+        d=np.bool_(True),
+        e={"k": np.int64(7)},
+    )
+    sink.close()
+    [ev] = read_events(tmp_path / "s.jsonl")
+    assert ev["a"] == 1.5 and ev["b"] == [0, 1, 2] and ev["c"] is None
+    assert ev["d"] is True and ev["e"]["k"] == 7
+
+
+def test_trace_audit_pins_telemetry_jaxpr_contract():
+    """Trace-audit half of zero-cost: the telemetry-off EM kernel allows NO
+    callback primitive and the telemetry-on variant exactly one
+    io_callback — both audit clean, and the off-kernel's jaxpr is
+    unaffected by this PR (the registry would fail otherwise)."""
+    from splink_tpu.analysis.trace_audit import run_audit
+
+    findings, audited = run_audit(["em_step", "em_step_telemetry"])
+    assert audited == 2
+    assert not findings, "\n".join(f.format() for f in findings)
