@@ -1,14 +1,26 @@
-.PHONY: test lint tpu-smoke obs-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines tpu-smoke obs-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
 	python -m pytest tests/ -x -q
 
 # Static analysis gate: jaxlint AST pass over the package + jaxpr audit of
-# the kernel registry (splink_tpu/analysis/). Exit 1 on any unsuppressed
-# finding; tests/test_codebase_clean.py enforces the same gate in tier-1.
+# the kernel registry + SPMD partition-safety audit of the shard registry
+# on the forced 8-virtual-device CPU mesh (splink_tpu/analysis/). Exit 1 on
+# any unsuppressed finding, undeclared collective, or cost-budget drift;
+# tests/test_codebase_clean.py enforces the same gate in tier-1. (The CLI
+# pins JAX_PLATFORMS/XLA_FLAGS itself for --shard-audit; set here too so
+# the whole invocation — including the jaxpr audit — runs the same config.)
 lint:
-	python -m splink_tpu.analysis splink_tpu/ --audit
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m splink_tpu.analysis splink_tpu/ --audit --shard-audit
+
+# Intentional refresh of the committed per-kernel cost/collective budgets
+# (splink_tpu/analysis/shard_baselines.json) after an accepted perf change
+# or a new shard kernel. Review the JSON diff like a benchmark result.
+shard-baselines:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m splink_tpu.analysis --shard-audit --update-baselines
 
 # Hardware smoke tier: real TPU lowering of Pallas kernels + pipeline.
 # Separate invocation because tests/conftest.py pins its process to CPU.

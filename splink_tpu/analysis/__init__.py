@@ -1,23 +1,35 @@
-"""Static-analysis + jaxpr-audit framework gating CI.
+"""Static-analysis + jaxpr/SPMD-audit framework gating CI.
 
-Two layers, one finding model:
+Three layers, one finding model:
 
   * :mod:`.jaxlint` — AST lint pass over JAX hazard classes (host calls and
     syncs on traced values, Python branches on tracers, unpinned dtypes,
     float64 leaks, import-time device work, recompile hazards, donated
-    buffer reuse), with ``# jaxlint: disable=RULE`` suppressions.
+    buffer reuse, multi-controller divergence, per-host RNG, sync-per-batch
+    loops, mesh-axis literals), with ``# jaxlint: disable=RULE``
+    suppressions.
   * :mod:`.trace_audit` — traces every kernel in the declared registry and
     asserts jaxpr-level invariants (const budget, dtype width, callback
     allowlist, trace determinism).
+  * :mod:`.shard_audit` — lowers the sharded kernels on a forced 8-device
+    mesh and asserts SPMD partition safety (declared shardings, exact
+    collective budgets, padding-weight threading, cost/memory baselines).
 
-CLI: ``python -m splink_tpu.analysis splink_tpu/ [--audit] [--json]``;
-``make lint`` runs both layers, and tests/test_codebase_clean.py gates
-tier-1 on a clean run.
+CLI: ``python -m splink_tpu.analysis splink_tpu/ [--audit] [--shard-audit]
+[--json]``; ``make lint`` runs all three layers, and
+tests/test_codebase_clean.py gates tier-1 on a clean run.
 """
 
 from .findings import Finding, Report
 from .jaxlint import lint_paths, lint_source
 from .rules import RULES, rule
+from .shard_audit import (
+    SHARD_REGISTRY,
+    audit_shard_kernel,
+    register_shard_kernel,
+    run_shard_audit,
+    update_baselines,
+)
 from .trace_audit import REGISTRY, audit_kernel, register_kernel, run_audit
 
 __all__ = [
@@ -31,4 +43,9 @@ __all__ = [
     "audit_kernel",
     "register_kernel",
     "run_audit",
+    "SHARD_REGISTRY",
+    "audit_shard_kernel",
+    "register_shard_kernel",
+    "run_shard_audit",
+    "update_baselines",
 ]

@@ -1,18 +1,40 @@
-"""CLI: ``python -m splink_tpu.analysis [paths...] [--audit] [--json]``.
+"""CLI: ``python -m splink_tpu.analysis [paths...] [--audit] [--shard-audit]
+[--json]``.
 
 Exit codes: 0 clean, 1 findings, 2 usage error. The lint layer itself is
 pure stdlib AST work (no tracing, no device); the jaxpr audit (``--audit``)
-traces the kernel registry and needs a working jax backend (CPU suffices).
+traces the kernel registry and needs a working jax backend (CPU suffices);
+the shard audit (``--shard-audit``) additionally needs an 8-device mesh —
+the CLI forces the virtual 8-device CPU host platform itself when the
+backend is not yet initialised, so a bare ``python -m splink_tpu.analysis
+--shard-audit`` works anywhere ``make lint`` does.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from .findings import Report
 from .jaxlint import lint_paths
 from .rules import RULES
+
+
+def _force_virtual_mesh() -> None:
+    """Pin the process to the 8-virtual-device CPU platform the shard
+    baselines are recorded on. Must run before first backend use (imports
+    are fine — XLA reads the flags at client init); mirrors
+    tests/conftest.py, which does the same for the test tier."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def main(argv=None) -> int:
@@ -40,22 +62,46 @@ def main(argv=None) -> int:
         help="comma-separated kernel names to audit (implies --audit)",
     )
     parser.add_argument(
+        "--shard-audit",
+        action="store_true",
+        help="also run the SPMD partition-safety audit (8-device mesh)",
+    )
+    parser.add_argument(
+        "--shard-kernels",
+        help="comma-separated shard kernel names (implies --shard-audit)",
+    )
+    parser.add_argument(
+        "--update-baselines",
+        action="store_true",
+        help="re-measure the shard registry and rewrite "
+        "shard_baselines.json (implies --shard-audit)",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
     args = parser.parse_args(argv)
+    shard_requested = (
+        args.shard_audit or args.shard_kernels or args.update_baselines
+    )
 
     if args.list_rules:
         for spec in sorted(RULES.values(), key=lambda s: s.id):
             print(f"{spec.id}  {spec.title}\n       {spec.doc}")
         return 0
 
-    if not args.paths and not (args.audit or args.audit_kernels):
+    if not args.paths and not (
+        args.audit or args.audit_kernels or shard_requested
+    ):
         parser.print_usage(sys.stderr)
         print(
-            "error: give at least one path to lint, or --audit",
+            "error: give at least one path to lint, or --audit / "
+            "--shard-audit",
             file=sys.stderr,
         )
         return 2
+
+    if shard_requested:
+        _force_virtual_mesh()
 
     rules = (
         [r.strip() for r in args.rules.split(",") if r.strip()]
@@ -83,6 +129,28 @@ def main(argv=None) -> int:
             return 2
         report.extend(audit_findings)
         report.kernels_audited = audited
+
+    if shard_requested:
+        from .shard_audit import run_shard_audit, update_baselines
+
+        shard_kernels = (
+            [k.strip() for k in args.shard_kernels.split(",") if k.strip()]
+            if args.shard_kernels
+            else None
+        )
+        try:
+            if args.update_baselines:
+                new = update_baselines(shard_kernels)
+                print(
+                    f"wrote {len(new['kernels'])} kernel baseline(s)",
+                    file=sys.stderr,
+                )
+            shard_findings, shard_audited = run_shard_audit(shard_kernels)
+        except KeyError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        report.extend(shard_findings)
+        report.shard_kernels_audited = shard_audited
 
     print(report.format_json() if args.json else report.format_text())
     return 0 if report.clean else 1

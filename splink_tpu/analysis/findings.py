@@ -39,6 +39,7 @@ class Report:
     findings: list[Finding] = field(default_factory=list)
     files_checked: int = 0
     kernels_audited: int = 0
+    shard_kernels_audited: int = 0
 
     def extend(self, findings) -> None:
         self.findings.extend(findings)
@@ -54,10 +55,13 @@ class Report:
 
     def format_text(self) -> str:
         lines = [f.format() for f in self.sorted()]
-        lines.append(
+        tail = (
             f"{len(self.findings)} finding(s) in {self.files_checked} "
             f"file(s), {self.kernels_audited} kernel(s) audited"
         )
+        if self.shard_kernels_audited:
+            tail += f", {self.shard_kernels_audited} shard kernel(s) audited"
+        lines.append(tail)
         return "\n".join(lines)
 
     def format_json(self) -> str:
@@ -66,6 +70,7 @@ class Report:
                 "findings": [asdict(f) for f in self.sorted()],
                 "files_checked": self.files_checked,
                 "kernels_audited": self.kernels_audited,
+                "shard_kernels_audited": self.shard_kernels_audited,
                 "clean": self.clean,
             },
             indent=2,

@@ -1,5 +1,6 @@
 """jaxlint: AST lint pass over JAX hazard classes (layer 1 of the analysis
-framework; layer 2 is the jaxpr-level :mod:`trace_audit`).
+framework; layer 2 is the jaxpr-level :mod:`trace_audit`, layer 3 the SPMD
+:mod:`shard_audit`).
 
 The pipeline is a compiler — settings compile into jitted programs — and the
 hazards that break compiled pipelines are not syntax errors but *silent*

@@ -55,6 +55,7 @@ def iter_rules(only: Iterable[str] | None = None) -> Iterator[tuple[str, Callabl
 # importing the rule modules populates RULES
 from . import (  # noqa: E402,F401
     control_flow,
+    distributed,
     donation,
     dtypes,
     host_calls,

@@ -1,0 +1,396 @@
+"""JL009-JL012: distributed-execution hazards the AST can see.
+
+The shard audit (layer 3) checks what GSPMD compiles; these rules catch the
+multi-controller bugs that never reach a compiler — they live in host driver
+code. Each host in a multi-controller run executes the same Python program,
+and the collectives only work because every process reaches them in the
+same order with the same shapes:
+
+  JL009 — ``jax.process_index()``-dependent branching that reaches a
+          collective or a checkpoint write. A branch that diverges per host
+          either deadlocks (some processes enter the collective, some
+          don't) or corrupts persisted state. The sanctioned single-writer
+          checkpoint pattern suppresses with a justification.
+  JL010 — per-host RNG key derivation. A PRNG seeded from
+          ``process_index`` / pid / wall clock gives every host a different
+          stream with no reproducibility story; derive per-host keys from a
+          SHARED seed with ``jax.random.fold_in(key, process_index)``.
+  JL011 — scalar host sync (``float()`` / ``int()`` / ``.item()`` /
+          ``jax.device_get``) inside a host loop that also dispatches
+          device work. One sync per dispatched batch serialises jax's
+          async pipeline — the streamed EM keeps per-batch values on
+          device and reduces once per pass for exactly this reason.
+  JL012 — mesh-axis string literals. ``PartitionSpec("data")`` written
+          inline bypasses ``parallel.mesh.DATA_AXIS``; when the axis is
+          ever renamed or a second mesh dimension appears, literal call
+          sites silently stop matching the mesh and GSPMD replicates.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..jaxlint import _bound_names
+from . import rule
+
+# callables whose reach under divergent control flow deadlocks or corrupts
+# (matched on the canonical name's last segment)
+_COLLECTIVE_TAILS = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "psum_scatter",
+    "all_gather",
+    "all_to_all",
+    "process_allgather",
+    "broadcast_one_to_all",
+    "sync_global_devices",
+    "all_sum_stats",
+}
+_CKPT_TAILS = {"save_checkpoint"}
+
+_PROCESS_ID_CALLS = {"jax.process_index"}
+
+_RNG_CTORS = {
+    "jax.random.PRNGKey",
+    "jax.random.key",
+    "numpy.random.default_rng",
+    "numpy.random.seed",
+    "numpy.random.RandomState",
+}
+_PER_HOST_SEEDS = {
+    "jax.process_index",
+    "os.getpid",
+    "time.time",
+    "time.time_ns",
+    "uuid.uuid4",
+    "uuid.uuid1",
+}
+
+_SYNC_BUILTINS = ("float", "int", "bool")
+_SYNC_METHODS = ("item", "tolist")
+
+
+def _tail(canon: str | None) -> str:
+    return canon.rsplit(".", 1)[-1] if canon else ""
+
+
+def _mentions_any_call(mod, node: ast.expr, canons: set) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and mod.canonical(n.func) in canons:
+            return True
+    return False
+
+
+def _mentions_name(node: ast.expr, names: set) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in names for n in ast.walk(node)
+    )
+
+
+class _DerivedNames:
+    """Per-scope ``jax.process_index()``-derived name tracking.
+
+    A name counts as process-derived at a use site only when it was
+    assigned from a process_index-involving expression in the SAME
+    function or one of its lexical ancestors (closures — em.py's
+    ``is_writer`` read inside the nested ``_save`` — still resolve, but an
+    unrelated function reusing the same name elsewhere in the module does
+    not false-fire). Resolution runs to a fixpoint so
+    ``is_writer = jax.process_index() == 0; lead = is_writer and ...``
+    chains mark both names."""
+
+    def __init__(self, mod):
+        self.mod = mod
+        # scope key: the enclosing FunctionDef node, or None for module
+        # level; value: that scope's own assignment statements
+        self._assigns: dict = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                self._assigns.setdefault(
+                    mod.enclosing_fn(node), []
+                ).append(node)
+        self._cache: dict = {}
+
+    def _chain(self, node: ast.AST) -> tuple:
+        """(module, outer fn, ..., innermost fn) scope keys for a node."""
+        chain = []
+        fn = self.mod.enclosing_fn(node)
+        while fn is not None:
+            chain.append(fn)
+            fn = self.mod.enclosing_fn(fn)
+        return (None, *reversed(chain))
+
+    def at(self, node: ast.AST) -> set:
+        """The derived-name set visible at ``node``."""
+        chain = self._chain(node)
+        if chain in self._cache:
+            return self._cache[chain]
+        stmts = [s for scope in chain for s in self._assigns.get(scope, [])]
+        derived: set = set()
+        for _ in range(8):
+            added = False
+            for stmt in stmts:
+                value = stmt.value
+                if value is None:
+                    continue
+                if not (
+                    _mentions_any_call(self.mod, value, _PROCESS_ID_CALLS)
+                    or _mentions_name(value, derived)
+                ):
+                    continue
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for t in targets:
+                    for name in _bound_names(t):
+                        if name not in derived:
+                            derived.add(name)
+                            added = True
+            if not added:
+                break
+        self._cache[chain] = derived
+        return derived
+
+
+def _stmt_block_after(mod, stmt: ast.stmt) -> list:
+    """The statements following ``stmt`` in its enclosing block."""
+    parent = mod.parents.get(stmt)
+    if parent is None:
+        return []
+    for attr in ("body", "orelse", "finalbody"):
+        block = getattr(parent, attr, None)
+        if isinstance(block, list) and stmt in block:
+            idx = block.index(stmt)
+            return block[idx + 1 :]
+    return []
+
+
+def _exits_block(body: list) -> bool:
+    return any(
+        isinstance(s, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+        for s in body
+    )
+
+
+@rule(
+    "JL009",
+    "process_index-divergent reach of a collective or checkpoint write",
+    "per-host branches around collectives deadlock; around writes, corrupt",
+)
+def check_process_divergence(mod):
+    derived = _DerivedNames(mod)
+
+    def divergent_test(node: ast.If) -> bool:
+        return _mentions_any_call(mod, node.test, _PROCESS_ID_CALLS) or (
+            _mentions_name(node.test, derived.at(node))
+        )
+
+    def hazardous_calls(nodes):
+        for stmt in nodes:
+            for n in ast.walk(stmt):
+                if not isinstance(n, ast.Call):
+                    continue
+                tail = _tail(mod.canonical(n.func))
+                if tail in _COLLECTIVE_TAILS:
+                    yield n, "collective"
+                elif tail in _CKPT_TAILS:
+                    yield n, "checkpoint write"
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.If) or not divergent_test(node):
+            continue
+        # (a) the hazard sits inside the divergent branch
+        reached = list(node.body) + list(node.orelse)
+        # (b) guard-return form: `if not is_writer: return` diverges every
+        # statement AFTER the if
+        if _exits_block(node.body):
+            reached += _stmt_block_after(mod, node)
+        for call, kind in hazardous_calls(reached):
+            tail = _tail(mod.canonical(call.func))
+            yield mod.finding(
+                "JL009",
+                call,
+                f"{kind} '{tail}' reached under jax.process_index()-"
+                "dependent control flow — hosts diverge here in a "
+                "multi-controller run",
+                "make every process execute the call (collectives), or "
+                "document the single-writer design with a suppression",
+            )
+
+
+@rule(
+    "JL010",
+    "per-host RNG key not folded from a shared seed",
+    "process_index/pid/clock seeds give irreproducible per-host streams",
+)
+def check_per_host_rng(mod):
+    derived = _DerivedNames(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func)
+        if canon not in _RNG_CTORS:
+            continue
+        seed_exprs = list(node.args) + [kw.value for kw in node.keywords]
+        for expr in seed_exprs:
+            if _mentions_any_call(mod, expr, _PER_HOST_SEEDS) or (
+                _mentions_name(expr, derived.at(node))
+            ):
+                yield mod.finding(
+                    "JL010",
+                    node,
+                    f"{canon} seeded from a per-host value — every "
+                    "controller gets an unrelated stream",
+                    "seed from the SHARED run seed and derive per-host "
+                    "keys with jax.random.fold_in(key, "
+                    "jax.process_index())",
+                )
+                break
+
+
+def _device_local_names(mod, info) -> set:
+    """Names in a host function assigned from device-namespace expressions
+    (fixpoint over chains), i.e. values whose read forces a device sync."""
+    names: set = set()
+    stmts = [
+        n
+        for n in ast.walk(info.node)
+        if mod.enclosing_fn(n) is info.node
+        and isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+    ]
+    for _ in range(8):
+        added = False
+        for stmt in stmts:
+            value = stmt.value
+            if value is None:
+                continue
+            has_device = any(
+                isinstance(n, ast.Call)
+                and mod.is_device_ns(mod.canonical(n.func))
+                for n in ast.walk(value)
+            ) or _mentions_name(value, names)
+            if not has_device:
+                continue
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            for t in targets:
+                for name in _bound_names(t):
+                    if name not in names:
+                        names.add(name)
+                        added = True
+        if not added:
+            break
+    return names
+
+
+@rule(
+    "JL011",
+    "scalar host sync inside a device-dispatching loop",
+    "float()/.item() per dispatched batch serialises jax's async pipeline",
+)
+def check_sync_in_dispatch_loop(mod):
+    for info in mod.fns.values():
+        if info.traced:
+            continue  # syncs under tracing are JL003's subject
+        device_names = _device_local_names(mod, info)
+
+        def is_device_expr(expr: ast.expr) -> bool:
+            return any(
+                (
+                    isinstance(n, ast.Call)
+                    and mod.is_device_ns(mod.canonical(n.func))
+                )
+                or (isinstance(n, ast.Name) and n.id in device_names)
+                for n in ast.walk(expr)
+            )
+
+        for node in ast.walk(info.node):
+            if mod.enclosing_fn(node) is not info.node:
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            loop = mod.in_loop(node)
+            if loop is None:
+                continue
+            # the loop must itself dispatch device work — a loop that only
+            # reads back results is data egress, not a pipeline stall
+            dispatches = any(
+                isinstance(n, ast.Call)
+                and mod.is_device_ns(mod.canonical(n.func))
+                and n is not node
+                for n in ast.walk(loop)
+            )
+            if not dispatches:
+                continue
+            synced = None
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _SYNC_BUILTINS
+                and node.func.id not in mod.aliases
+                and node.args
+                and is_device_expr(node.args[0])
+            ):
+                synced = f"{node.func.id}()"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS
+                and is_device_expr(node.func.value)
+            ):
+                synced = f".{node.func.attr}()"
+            elif mod.canonical(node.func) == "jax.device_get" and any(
+                is_device_expr(a) for a in node.args
+            ):
+                synced = "jax.device_get()"
+            if synced:
+                yield mod.finding(
+                    "JL011",
+                    node,
+                    f"{synced} forces a device sync inside a loop that "
+                    f"also dispatches device work "
+                    f"('{info.qualname}') — one stall per iteration",
+                    "keep per-iteration values on device and reduce/read "
+                    "once per pass (see run_em_streamed's ll handling)",
+                )
+
+
+@rule(
+    "JL012",
+    "mesh-axis string literal bypassing mesh.DATA_AXIS",
+    "inline axis names desynchronise from the mesh definition on rename",
+)
+def check_axis_literals(mod):
+    def str_consts(expr: ast.expr):
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            yield expr
+        elif isinstance(expr, (ast.Tuple, ast.List)):
+            for e in expr.elts:
+                yield from str_consts(e)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func) or ""
+        tail = _tail(canon)
+        literal_sites = []
+        if tail == "PartitionSpec":
+            for arg in node.args:
+                literal_sites.extend(str_consts(arg))
+        elif tail == "Mesh" and len(node.args) >= 2:
+            literal_sites.extend(str_consts(node.args[1]))
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                literal_sites.extend(str_consts(kw.value))
+        for lit in literal_sites:
+            yield mod.finding(
+                "JL012",
+                lit,
+                f"mesh axis written as the literal {lit.value!r} in "
+                f"{tail}(...)",
+                "import and use parallel.mesh.DATA_AXIS (one definition, "
+                "every sharding agrees)",
+            )

@@ -1,7 +1,8 @@
 """trace_audit: jaxpr-level audit of the declared kernel registry (layer 2).
 
 The AST linter sees what the source *says*; this layer checks what the
-compiler will actually *run*. Every kernel in the registry — the EM step,
+compiler will actually *run* on one device (:mod:`shard_audit` — layer 3 —
+re-checks the sharded kernels under a multi-device mesh). Every kernel in the registry — the EM step,
 the gamma batch, the string kernels, the TF adjustment, the streamed pass —
 is traced with abstract-shaped example inputs and its jaxpr is asserted
 against four invariants:
@@ -42,9 +43,10 @@ stays cheap and the registry can reference heavyweight modules.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable
 
 from .findings import Finding
@@ -73,6 +75,20 @@ class KernelSpec:
     allow_dtypes: frozenset = DEFAULT_ALLOWED_DTYPES
     allow_callbacks: tuple = ()
     const_budget_bytes: int = DEFAULT_CONST_BUDGET
+    # per-spec memo of the build result and the first trace. Audits are
+    # idempotent reads, so re-running one (the tier-1 gate plus the CLI in
+    # a single process) must not re-pay builder or trace cost — this is
+    # what keeps `make lint` wall-clock flat as the registry grows. A
+    # single slot suffices: audit_kernel always builds/traces under the
+    # forced-x64 tier, and the x64-off shard tier has its own specs
+    # (sharing only the module-level shared_* input builders below).
+    cache: dict = field(default_factory=dict)
+
+    def built(self):
+        """Builder output, memoised."""
+        if "build" not in self.cache:
+            self.cache["build"] = self.build()
+        return self.cache["build"]
 
 
 REGISTRY: dict[str, KernelSpec] = {}
@@ -167,16 +183,21 @@ def audit_kernel(spec: KernelSpec) -> list[Finding]:
         # on, so without this the CLI (`make lint`, x64 off) would pass a
         # kernel that the x64 test tier rejects.
         with enable_x64():
-            fn, args, kwargs = spec.build()
+            fn, args, kwargs = spec.built()
             # Each trace goes through a FRESH wrapper object AND the jit
             # trace caches are dropped in between: jax caches traces on
             # function identity (for jit-wrapped kernels even a fresh outer
             # lambda still hits pjit's cached inner jaxpr), so without both
             # steps the determinism check would compare a value with
-            # itself.
-            closed = jax.make_jaxpr(lambda *a, **k: fn(*a, **k))(
-                *args, **kwargs
-            )
+            # itself. The FIRST trace is memoised on the spec (repeated
+            # audits in one process — the tier-1 gate plus the CLI tests —
+            # reuse it); the second is always fresh, so TA-HASH keeps
+            # comparing two independently produced jaxprs.
+            closed = spec.cache.get("trace")
+            if closed is None:
+                closed = spec.cache["trace"] = jax.make_jaxpr(
+                    lambda *a, **k: fn(*a, **k)
+                )(*args, **kwargs)
             jax.clear_caches()
             closed2 = jax.make_jaxpr(lambda *a, **k: fn(*a, **k))(
                 *args, **kwargs
@@ -271,6 +292,81 @@ def run_audit(names=None) -> tuple[list[Finding], int]:
 
 
 # ---------------------------------------------------------------------------
+# Shared example-input builders. Module level (not buried in the registry
+# closure) and memoised, so the x64-on jaxpr tier here and the x64-off
+# shard-audit tier (shard_audit.py) build the FS inputs and the gamma
+# program ONCE per process: every dtype is pinned, so the abstract avals
+# are identical across tiers and safe to share.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def shared_fs_inputs():
+    """(G, params) example inputs for the EM-family kernels (pinned
+    int8/float32 — x64-independent)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.fellegi_sunter import FSParams
+
+    rng = np.random.default_rng(0)
+    G = jnp.asarray(rng.integers(-1, 3, size=(128, 3)).astype(np.int8))
+    params = FSParams(
+        lam=jnp.float32(0.3),
+        m=jnp.asarray(np.full((3, 3), 1.0 / 3, np.float32)),
+        u=jnp.asarray(np.full((3, 3), 1.0 / 3, np.float32)),
+    )
+    return G, params
+
+
+@functools.lru_cache(maxsize=1)
+def shared_gamma_program():
+    """One GammaProgram for the gamma-family specs across BOTH audit tiers
+    (builders use it read-only; rebuilding costs encode_table + program
+    construction each time)."""
+    import jax.numpy as jnp
+    import pandas as pd
+
+    from ..data import encode_table
+    from ..gammas import GammaProgram
+    from ..settings import complete_settings_dict
+
+    df = pd.DataFrame(
+        {
+            "unique_id": range(6),
+            "name": ["martha", "marhta", "mx", None, "anna", "bob"],
+            "city": ["x", "y", "x", "y", None, "x"],
+            "amount": [1.0, 1.01, 5.0, None, 2.0, 3.0],
+        }
+    )
+    settings = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {"col_name": "name", "num_levels": 3},
+                {
+                    "col_name": "city",
+                    "num_levels": 2,
+                    "comparison": {"kind": "exact"},
+                },
+                {
+                    "col_name": "amount",
+                    "data_type": "numeric",
+                    "num_levels": 3,
+                    "comparison": {
+                        "kind": "numeric_perc",
+                        "thresholds": [0.01, 0.2],
+                    },
+                },
+            ],
+            "blocking_rules": ["l.unique_id = r.unique_id"],
+        }
+    )
+    table = encode_table(df, settings)
+    return GammaProgram(settings, table, float_dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
 # Default registry: the pipeline's hot kernels.
 # ---------------------------------------------------------------------------
 
@@ -283,26 +379,7 @@ def _ensure_default_registry() -> None:
         return
     _defaults_registered = True
 
-    def _fs_inputs():
-        import jax.numpy as jnp
-        import numpy as np
-
-        from ..models.fellegi_sunter import FSParams
-
-        rng = np.random.default_rng(0)
-        G = jnp.asarray(
-            rng.integers(-1, 3, size=(128, 3)).astype(np.int8)
-        )
-        params = FSParams(
-            lam=jnp.float32(0.3),
-            m=jnp.asarray(
-                np.full((3, 3), 1.0 / 3, np.float32)
-            ),
-            u=jnp.asarray(
-                np.full((3, 3), 1.0 / 3, np.float32)
-            ),
-        )
-        return G, params
+    _fs_inputs = shared_fs_inputs
 
     # make_jaxpr would trace every argument, including the jit wrapper's
     # static ones — each builder therefore closes the statics into a lambda
@@ -389,53 +466,7 @@ def _ensure_default_registry() -> None:
         G, params = _fs_inputs()
         return score_pairs, (G, params), {}
 
-    # one shared program for the three gamma-family specs (builders use it
-    # read-only; rebuilding costs encode_table + program construction each)
-    import functools
-
-    @functools.lru_cache(maxsize=1)
-    def _gamma_program():
-        import jax.numpy as jnp
-        import pandas as pd
-
-        from ..data import encode_table
-        from ..gammas import GammaProgram
-        from ..settings import complete_settings_dict
-
-        df = pd.DataFrame(
-            {
-                "unique_id": range(6),
-                "name": ["martha", "marhta", "mx", None, "anna", "bob"],
-                "city": ["x", "y", "x", "y", None, "x"],
-                "amount": [1.0, 1.01, 5.0, None, 2.0, 3.0],
-            }
-        )
-        settings = complete_settings_dict(
-            {
-                "link_type": "dedupe_only",
-                "comparison_columns": [
-                    {"col_name": "name", "num_levels": 3},
-                    {
-                        "col_name": "city",
-                        "num_levels": 2,
-                        "comparison": {"kind": "exact"},
-                    },
-                    {
-                        "col_name": "amount",
-                        "data_type": "numeric",
-                        "num_levels": 3,
-                        "comparison": {
-                            "kind": "numeric_perc",
-                            "thresholds": [0.01, 0.2],
-                        },
-                    },
-                ],
-                "blocking_rules": ["l.unique_id = r.unique_id"],
-            }
-        )
-        table = encode_table(df, settings)
-        program = GammaProgram(settings, table, float_dtype=jnp.float32)
-        return program
+    _gamma_program = shared_gamma_program
 
     @register_kernel("gamma_batch")
     def _build_gamma_batch():

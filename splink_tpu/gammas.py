@@ -81,25 +81,22 @@ class PairColumn:
     null_r: jnp.ndarray | None = None  # (b,) bool: right side null
 
 
-_BYTE_ORDER_CACHE: dict[str, bool] = {}
+def _u32_bytes_le(lanes):
+    """(..., k) uint32 -> (..., k, 4) uint8 in little-endian byte order.
 
-
-def _bitcast_reverses_bytes() -> bool:
-    """Whether lax.bitcast_convert_type(uint32 -> uint8) yields bytes in the
-    opposite order from a host-side little-endian numpy .view(uint32) pack.
-
-    XLA documents the bit order of width-changing bitcasts as implementation
-    defined, so probe it once per backend with a known word instead of
-    assuming.
+    Width-changing bitcasts carry two costs the elementwise shift+mask form
+    avoids: XLA documents their bit order as implementation defined (the old
+    code probed the backend with a known word and conditionally reversed),
+    and GSPMD cannot partition them along a sharded dimension — under a
+    sharded pair axis the bitcast all-gathered the WHOLE batch onto every
+    device (shard_audit SA-COLL pins the gamma kernels all-gather-free).
+    Shifts are elementwise, so the byte order is deterministic everywhere
+    and the op partitions trivially.
     """
-    backend = jax.default_backend()
-    if backend not in _BYTE_ORDER_CACHE:
-        word = np.array([0x04030201], dtype=np.uint32)  # bytes 1,2,3,4 LE
-        out = np.asarray(
-            jax.lax.bitcast_convert_type(jnp.asarray(word), jnp.uint8)
-        ).ravel()
-        _BYTE_ORDER_CACHE[backend] = bool((out == [4, 3, 2, 1]).all())
-    return _BYTE_ORDER_CACHE[backend]
+    shifts = jnp.arange(0, 32, 8, dtype=jnp.uint32)
+    return ((lanes[..., None] >> shifts) & jnp.uint32(0xFF)).astype(jnp.uint8)
+
+
 
 
 class _StringField:
@@ -432,13 +429,11 @@ class PairContext:
         layout: dict,
         rows_l,
         rows_r,
-        reverse_bytes: bool,
         two_phase_div: int | None = None,
     ):
         self._layout = layout
         self._rows_l = rows_l
         self._rows_r = rows_r
-        self._reverse = reverse_bytes
         # Two-phase JW: survivor capacity = batch // two_phase_div (None =
         # exact kernels everywhere). Each two-phase column records a
         # did-its-survivors-overflow flag here; the kernel returns their
@@ -463,9 +458,7 @@ class PairContext:
     def _string_side(self, f: _StringField, rows):
         lanes = rows[:, f.chars]
         if f.kind == "ascii":
-            chars = jax.lax.bitcast_convert_type(lanes, jnp.uint8)
-            if self._reverse:
-                chars = chars[..., ::-1]
+            chars = _u32_bytes_le(lanes)
             chars = chars.reshape(rows.shape[0], -1)[:, : f.width]
         else:
             chars = lanes
@@ -476,9 +469,15 @@ class PairContext:
     def _numeric_side(self, f: _NumericField, rows):
         lanes = rows[:, f.val]
         if f.f64:
-            if self._reverse:
-                lanes = lanes[:, ::-1]
-            val = jax.lax.bitcast_convert_type(lanes, jnp.float64)
+            # Assemble the f64 from its two little-endian u32 words with a
+            # SAME-width u64 bitcast: the width-changing u32[2]->f64 bitcast
+            # is unpartitionable under GSPMD (it all-gathers the sharded
+            # batch) and its word order is implementation defined.
+            lo = lanes[:, 0].astype(jnp.uint64)
+            hi = lanes[:, 1].astype(jnp.uint64)
+            val = jax.lax.bitcast_convert_type(
+                lo | (hi << jnp.uint64(32)), jnp.float64
+            )
         else:
             val = jax.lax.bitcast_convert_type(lanes[:, 0], jnp.float32)
         word = rows[:, f.null_lane]
@@ -781,7 +780,6 @@ class GammaProgram:
         )
         self._packed = jnp.asarray(packed)
         self._layout = layout
-        reverse = _bitcast_reverses_bytes()
 
         cols = settings["comparison_columns"]
 
@@ -794,7 +792,7 @@ class GammaProgram:
             def _gamma_body(packed, idx_l, idx_r):
                 rows_l = packed[idx_l]
                 rows_r = packed[idx_r]
-                ctx = PairContext(layout, rows_l, rows_r, reverse, two_phase_div)
+                ctx = PairContext(layout, rows_l, rows_r, two_phase_div)
                 gammas = [_spec_gamma(c, ctx) for c in cols]
                 return jnp.stack(gammas, axis=1), ctx.overflow_count()
 

@@ -27,17 +27,33 @@ def make_mesh(n_devices: int | None = None, axis_name: str = DATA_AXIS) -> Mesh:
 def mesh_from_settings(settings: dict) -> Mesh | None:
     """Build the mesh described by the settings ``mesh`` dict, or None.
 
-    ``{"data": 8}`` means: shard the pair axis over 8 devices. An empty dict
-    (the default) means single-device execution.
+    ``{"data": N}`` means: shard the pair axis over N devices. ``{"data":
+    1}`` is an EXPLICIT single-device mesh — the sharded code path with one
+    shard (useful for exercising mesh plumbing anywhere), not the same as
+    the empty dict / absent key, which selects the unsharded single-device
+    path.
     """
     spec = settings.get("mesh") or {}
     if not spec:
         return None
+    supported = (
+        f"the supported form is {{{DATA_AXIS!r}: N}} — a 1-D mesh over the "
+        f"pair axis with 1 <= N <= jax.device_count()"
+    )
     if list(spec.keys()) != [DATA_AXIS]:
+        raise ValueError(f"unsupported mesh spec {spec!r}; {supported}")
+    n = spec[DATA_AXIS]
+    if isinstance(n, bool) or not isinstance(n, int) or n < 1:
         raise ValueError(
-            f"Only a 1-D {{'data': N}} mesh is supported for EM; got {spec!r}"
+            f"unsupported mesh size {n!r} in {spec!r}; {supported}"
         )
-    return make_mesh(spec[DATA_AXIS])
+    available = len(jax.devices())
+    if n > available:
+        raise ValueError(
+            f"mesh spec {spec!r} requests {n} devices but only {available} "
+            f"are visible; {supported}"
+        )
+    return make_mesh(n)
 
 
 def pair_sharding(mesh: Mesh) -> NamedSharding:
@@ -57,9 +73,8 @@ def shard_pairs(mesh: Mesh, *arrays):
     """Pad the leading axis to a multiple of the mesh size and device_put with
     pair sharding. Returns (padded_arrays..., weights) where weights is 1.0
     for real rows and 0.0 for padding — thread it into EM so padding rows
-    contribute nothing (gamma padding value -1 + weight 0)."""
-    import numpy as np
-
+    contribute nothing (gamma padding value -1 + weight 0; shard_audit
+    SA-PAD statically pins that the stats kernels consume the weights)."""
     n = arrays[0].shape[0]
     n_dev = mesh.devices.size
     n_pad = pad_to_multiple(max(n, n_dev), n_dev)

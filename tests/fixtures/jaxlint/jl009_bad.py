@@ -1,0 +1,26 @@
+"""JL009 bad twin: process_index-dependent branching that reaches a
+collective (deadlock) and a checkpoint write (corruption)."""
+
+import jax
+from jax.experimental import multihost_utils
+
+from splink_tpu.resilience.checkpoint import save_checkpoint
+
+
+def divergent_collective(stats):
+    if jax.process_index() == 0:
+        # only process 0 enters the allgather: everyone else never arrives
+        stats = multihost_utils.process_allgather(stats)
+    return stats
+
+
+def divergent_via_derived_name(ckpt_dir, state):
+    is_lead = jax.process_index() == 0
+    if not is_lead:
+        return
+    save_checkpoint(ckpt_dir, state)  # guard-return form still diverges
+
+
+def suppressed_single_writer(ckpt_dir, state):
+    if jax.process_index() == 0:
+        save_checkpoint(ckpt_dir, state)  # jaxlint: disable=JL009

@@ -1,0 +1,18 @@
+"""JL009 good twin: every process executes the collective; branching on
+process_count (uniform across hosts) is not divergence."""
+
+import jax
+from jax.experimental import multihost_utils
+
+
+def uniform_collective(stats):
+    # all processes reach the allgather unconditionally
+    return multihost_utils.process_allgather(stats)
+
+
+def count_gated_collective(stats):
+    # process_count() is identical on every host — the branch cannot
+    # diverge between controllers
+    if jax.process_count() == 1:
+        return stats
+    return multihost_utils.process_allgather(stats)

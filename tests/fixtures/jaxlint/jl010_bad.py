@@ -1,0 +1,29 @@
+"""JL010 bad twin: per-host RNG streams nobody can reproduce."""
+
+import os
+import time
+
+import jax
+
+
+def per_host_key():
+    return jax.random.PRNGKey(jax.process_index())  # unrelated per host
+
+
+def derived_seed_key():
+    host_seed = 1000 + jax.process_index()
+    return jax.random.key(host_seed)
+
+
+def wall_clock_key():
+    return jax.random.PRNGKey(int(time.time()))  # irreproducible
+
+
+def pid_rng():
+    import numpy as np
+
+    return np.random.default_rng(os.getpid())
+
+
+def suppressed_key():
+    return jax.random.PRNGKey(jax.process_index())  # jaxlint: disable=JL010

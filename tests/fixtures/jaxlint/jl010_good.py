@@ -1,0 +1,13 @@
+"""JL010 good twin: per-host keys folded from one shared seed — every
+host's stream is a pure function of (run seed, process index)."""
+
+import jax
+
+
+def folded_per_host_key(shared_seed: int):
+    key = jax.random.PRNGKey(shared_seed)
+    return jax.random.fold_in(key, jax.process_index())
+
+
+def shared_key(shared_seed: int):
+    return jax.random.PRNGKey(shared_seed)

@@ -1,0 +1,37 @@
+"""JL011 bad twin: scalar host syncs inside a batch-dispatch loop — every
+iteration stalls the async pipeline before the next batch launches."""
+
+import jax
+import jax.numpy as jnp
+
+
+def sync_per_batch(batches, params):
+    total = 0.0
+    for batch in batches:
+        ll = jnp.sum(jnp.log(batch * params))
+        total += float(ll)  # one full pipeline stall per micro-batch
+    return total
+
+
+def item_per_batch(batches):
+    outs = []
+    for batch in batches:
+        s = jnp.sum(batch)
+        outs.append(s.item())  # same stall via .item()
+    return outs
+
+
+def device_get_per_batch(batches):
+    outs = []
+    for batch in batches:
+        s = jnp.sum(batch)
+        outs.append(jax.device_get(s))
+    return outs
+
+
+def suppressed_sync(batches, params):
+    total = 0.0
+    for batch in batches:
+        ll = jnp.sum(batch * params)
+        total += float(ll)  # jaxlint: disable=JL011
+    return total

@@ -1,0 +1,21 @@
+"""JL011 good twin: per-batch values stay on device; one reduce + one read
+per pass (the run_em_streamed ll pattern)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def reduce_once_per_pass(batches, params):
+    parts = []
+    for batch in batches:
+        parts.append(jnp.sum(jnp.log(batch * params)))  # stays on device
+    return float(jnp.sum(jnp.stack(parts)))  # single sync, outside the loop
+
+
+def bulk_egress(batches, params):
+    # materialising each batch's OUTPUT is data egress, not a scalar
+    # convergence read — reading results out is what the pipeline is for
+    outs = []
+    for batch in batches:
+        outs.append(np.asarray(batch * params))
+    return outs

@@ -1,0 +1,26 @@
+"""JL012 bad twin: mesh-axis names written as string literals — a rename of
+the mesh axis silently stops matching these call sites."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def literal_pspec():
+    return PartitionSpec("data")
+
+
+def literal_mesh():
+    return Mesh(np.array(jax.devices()), ("data",))
+
+
+def literal_axis_kwarg(mesh):
+    return Mesh(np.array(jax.devices()), axis_names=("data",))
+
+
+def literal_sharding(mesh):
+    return NamedSharding(mesh, PartitionSpec("data", None))
+
+
+def suppressed_pspec():
+    return PartitionSpec("data")  # jaxlint: disable=JL012

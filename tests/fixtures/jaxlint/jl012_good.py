@@ -1,0 +1,24 @@
+"""JL012 good twin: the axis name has ONE definition — parallel.mesh —
+and every sharding imports it."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from splink_tpu.parallel.mesh import DATA_AXIS
+
+
+def named_pspec():
+    return PartitionSpec(DATA_AXIS)
+
+
+def named_mesh():
+    return Mesh(np.array(jax.devices()), (DATA_AXIS,))
+
+
+def named_sharding(mesh):
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, PartitionSpec())

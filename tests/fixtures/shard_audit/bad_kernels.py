@@ -1,0 +1,59 @@
+"""Shard-audit bad fixtures: each kernel trips one SA-* invariant.
+
+These register into a module-local ``REGISTRY`` (never the package one), so
+the fixture corpus can be audited on demand without poisoning the clean
+gate. Every kernel also collects an SA-COST missing-baseline finding when
+audited with empty baselines — fixture kernels are deliberately never
+committed to shard_baselines.json.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from splink_tpu.analysis.shard_audit import audit_mesh, register_shard_kernel
+from splink_tpu.parallel.mesh import pair_sharding, replicated
+
+REGISTRY: dict = {}
+
+
+# SA-SPEC: the "widened PartitionSpec" — a pair-axis array placed with the
+# replicated sharding, so every device holds (and processes) the full batch.
+@register_shard_kernel("widened_pspec", n_pairs=512, registry=REGISTRY)
+def _build_widened_pspec():
+    mesh = audit_mesh()
+    G = jax.device_put(np.zeros((512, 3), np.int8), replicated(mesh))
+    fn = lambda G: G.astype(jnp.float32) * 2.0  # noqa: E731
+    return fn, (G,), {}
+
+
+# SA-COLL: a reduction over the sharded pair axis in a kernel whose
+# collective allowlist is empty — GSPMD must insert an all-reduce the
+# budget forbids (the declared-collective-free scoring/gamma contract).
+@register_shard_kernel("undeclared_collective", n_pairs=512, registry=REGISTRY)
+def _build_undeclared_collective():
+    mesh = audit_mesh()
+    x = jax.device_put(
+        np.ones((512, 3), np.float32), pair_sharding(mesh)
+    )
+    fn = lambda x: jnp.sum(x, axis=0)  # noqa: E731  cross-shard reduce
+    return fn, (x,), {}
+
+
+# SA-PAD: a stats-style kernel that accepts the shard_pairs padding
+# weights but never threads them into the reduction — padded rows count.
+@register_shard_kernel(
+    "dropped_weights", n_pairs=512,
+    allow_collectives=("all-reduce",), pad_weights_argnum=1,
+    registry=REGISTRY,
+)
+def _build_dropped_weights():
+    mesh = audit_mesh()
+    G = jax.device_put(
+        np.zeros((512, 3), np.int8), pair_sharding(mesh)
+    )
+    w = jax.device_put(np.ones(512, np.float32), pair_sharding(mesh))
+    fn = lambda G, w: jnp.sum(  # noqa: E731  w ignored: padding leaks in
+        G.astype(jnp.float32), axis=0
+    )
+    return fn, (G, w), {}

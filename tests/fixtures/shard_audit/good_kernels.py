@@ -1,0 +1,58 @@
+"""Shard-audit good fixtures: the clean twins of bad_kernels.py.
+
+Audited with baselines measured in-test (measure_shard_kernel), these pass
+every SA-* invariant.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from splink_tpu.analysis.shard_audit import audit_mesh, register_shard_kernel
+from splink_tpu.parallel.mesh import pair_sharding, replicated
+
+REGISTRY: dict = {}
+
+
+# pair-axis array carries the pair sharding; elementwise kernel — zero
+# collectives, output stays sharded
+@register_shard_kernel("pair_sharded_map", n_pairs=512, registry=REGISTRY)
+def _build_pair_sharded_map():
+    mesh = audit_mesh()
+    G = jax.device_put(
+        np.zeros((512, 3), np.int8), pair_sharding(mesh)
+    )
+    fn = lambda G: G.astype(jnp.float32) * 2.0  # noqa: E731
+    return fn, (G,), {}
+
+
+# cross-shard reduction with the all-reduce DECLARED and the padding
+# weights threaded through it
+@register_shard_kernel(
+    "weighted_reduce", n_pairs=512,
+    allow_collectives=("all-reduce",), pad_weights_argnum=1,
+    registry=REGISTRY,
+)
+def _build_weighted_reduce():
+    mesh = audit_mesh()
+    G = jax.device_put(
+        np.zeros((512, 3), np.int8), pair_sharding(mesh)
+    )
+    w = jax.device_put(np.ones(512, np.float32), pair_sharding(mesh))
+    fn = lambda G, w: jnp.sum(  # noqa: E731
+        G.astype(jnp.float32) * w[:, None], axis=0
+    )
+    return fn, (G, w), {}
+
+
+# replicated scalar/parameter inputs are fine — only pair-axis arrays must
+# shard
+@register_shard_kernel("replicated_params_map", n_pairs=512, registry=REGISTRY)
+def _build_replicated_params_map():
+    mesh = audit_mesh()
+    x = jax.device_put(
+        np.ones((512,), np.float32), pair_sharding(mesh)
+    )
+    scale = jax.device_put(jnp.float32(3.0), replicated(mesh))
+    fn = lambda x, s: x * s  # noqa: E731
+    return fn, (x, scale), {}

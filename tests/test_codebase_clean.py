@@ -1,17 +1,21 @@
-"""The CI gate: splink_tpu/ itself must lint clean AND every registered
-kernel must pass the jaxpr audit. This is the tier-1 enforcement of the
-discipline both analysis layers encode — a new hazard anywhere in the
-package (or a kernel regression that bakes in a constant / leaks float64 /
-adds an undeclared callback) fails the suite, not just ``make lint``.
+"""The CI gate: splink_tpu/ itself must lint clean, every registered kernel
+must pass the jaxpr audit, AND every sharded kernel must pass the SPMD
+partition-safety audit against its committed budgets. This is the tier-1
+enforcement of the discipline all three analysis layers encode — a new
+hazard anywhere in the package (or a kernel regression that bakes in a
+constant / leaks float64 / adds an undeclared callback / replicates a pair
+array / grows a silent all-gather / blows a cost budget) fails the suite,
+not just ``make lint``.
 
-The audit forces x64 on while tracing (unpinned constructors only reveal
-themselves as int64/float64 under x64), so this gate and ``make lint``
-check the identical configuration.
+The jaxpr audit forces x64 ON while tracing (unpinned constructors only
+reveal themselves as int64/float64 under x64); the shard audit forces x64
+OFF while lowering (budgets are recorded for the production-width program)
+— so this gate and ``make lint`` check identical configurations.
 """
 
 import os
 
-from splink_tpu.analysis import lint_paths, run_audit
+from splink_tpu.analysis import lint_paths, run_audit, run_shard_audit
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO_ROOT, "splink_tpu")
@@ -39,4 +43,51 @@ def test_bad_fixtures_fail_the_gate():
     report = lint_paths([fixtures])
     assert not report.clean
     fired = {f.rule for f in report.findings}
-    assert fired >= {f"JL00{i}" for i in range(1, 9)}
+    assert fired >= {f"JL{i:03d}" for i in range(1, 13)}
+
+
+def test_shard_registry_audits_clean():
+    # layer 3: every sharded kernel holds SA-SPEC/COLL/PAD and its
+    # committed cost/collective budgets (shard_baselines.json)
+    findings, audited = run_shard_audit()
+    assert audited >= 8
+    assert not findings, "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_budget_drift_fails_with_a_diff_style_message():
+    # the SA-COST gate must render baseline-vs-measured, not just "failed"
+    from splink_tpu.analysis.shard_audit import (
+        SHARD_REGISTRY,
+        audit_shard_kernel,
+        load_baselines,
+    )
+
+    baseline = dict(load_baselines()["kernels"]["em_stats_sharded"])
+    baseline["flops"] = float(baseline["flops"]) * 10
+    counts = dict(baseline.get("collectives", {}))
+    counts["all-reduce"] = counts.get("all-reduce", 0) + 2
+    baseline["collectives"] = counts
+    findings = audit_shard_kernel(
+        SHARD_REGISTRY["em_stats_sharded"], baseline
+    )
+    rendered = "\n".join(f.format() for f in findings)
+    assert "flops: baseline" in rendered and "measured" in rendered
+    assert "budget drift" in rendered  # the missing-psum diff
+    assert "em_stats_sharded" in rendered
+
+
+def test_bad_shard_fixtures_fail_the_gate():
+    # falsifiability for layer 3: a widened PartitionSpec, an undeclared
+    # collective and dropped padding weights all trip the same gate
+    import importlib
+    import sys
+
+    fixtures = os.path.join(
+        os.path.dirname(__file__), "fixtures", "shard_audit"
+    )
+    if fixtures not in sys.path:
+        sys.path.insert(0, fixtures)
+    registry = importlib.import_module("bad_kernels").REGISTRY
+    findings, _ = run_shard_audit(registry=registry, baselines={})
+    fired = {f.rule for f in findings}
+    assert fired >= {"SA-SPEC", "SA-COLL", "SA-PAD", "SA-COST"}

@@ -28,8 +28,8 @@ def _lint_file(path):
 
 
 def test_rule_catalog_complete():
-    # the advertised 8 hazard classes, each with title + doc for the CLI
-    assert RULE_IDS == [f"JL00{i}" for i in range(1, 9)]
+    # the advertised 12 hazard classes, each with title + doc for the CLI
+    assert RULE_IDS == [f"JL{i:03d}" for i in range(1, 13)]
     for spec in RULES.values():
         assert spec.title and spec.doc
 
@@ -58,6 +58,50 @@ def test_rule_fires_on_bad_twin_only(rule_id):
         f"good twin {good} not clean: "
         + "; ".join(f.format() for f in good_findings)
     )
+
+
+def test_jl009_derived_names_are_scope_local():
+    # a name derived from process_index in one function must not poison an
+    # unrelated function reusing the same name
+    source = (
+        "import jax\n"
+        "from jax.experimental import multihost_utils\n"
+        "\n"
+        "\n"
+        "def a():\n"
+        "    lead = jax.process_index() == 0\n"
+        "    return lead\n"
+        "\n"
+        "\n"
+        "def b(cfg, x):\n"
+        "    lead = cfg.is_primary\n"
+        "    if lead:\n"
+        "        return multihost_utils.process_allgather(x)\n"
+        "    return x\n"
+    )
+    assert lint_source("x.py", source) == []
+
+
+def test_jl009_closure_derived_name_still_fires():
+    # ...but a closure reading the OUTER function's derived name (the
+    # em.py single-writer shape) is still caught
+    source = (
+        "import jax\n"
+        "from splink_tpu.resilience.checkpoint import save_checkpoint\n"
+        "\n"
+        "\n"
+        "def outer(ckpt_dir, state):\n"
+        "    is_writer = jax.process_index() == 0\n"
+        "\n"
+        "    def save():\n"
+        "        if not is_writer:\n"
+        "            return\n"
+        "        save_checkpoint(ckpt_dir, state)\n"
+        "\n"
+        "    return save\n"
+    )
+    findings = lint_source("x.py", source)
+    assert [f.rule for f in findings] == ["JL009"]
 
 
 def test_file_level_suppression():

@@ -13,7 +13,7 @@ import pandas as pd
 import pytest
 
 from splink_tpu.data import encode_table
-from splink_tpu.gammas import PairContext, _bitcast_reverses_bytes, pack_table
+from splink_tpu.gammas import PairContext, pack_table
 
 
 def _settings(cols):
@@ -48,7 +48,7 @@ def _ctx(table, float_dtype=jnp.float32):
     dev = jnp.asarray(packed)
     idx_l = jnp.asarray(np.array([0, 1, 2, 3], np.int32))
     idx_r = jnp.asarray(np.array([3, 2, 1, 0], np.int32))
-    return PairContext(layout, dev[idx_l], dev[idx_r], _bitcast_reverses_bytes())
+    return PairContext(layout, dev[idx_l], dev[idx_r])
 
 
 def test_string_fields_roundtrip(table):
@@ -110,7 +110,7 @@ def test_many_numeric_columns_null_bits():
     packed, layout = pack_table(enc)
     dev = jnp.asarray(packed)
     idx = jnp.asarray(np.arange(6, dtype=np.int32))
-    ctx = PairContext(layout, dev[idx], dev[idx], _bitcast_reverses_bytes())
+    ctx = PairContext(layout, dev[idx], dev[idx])
     for i in range(n_cols):
         pc = ctx.col(f"n{i}")
         np.testing.assert_array_equal(

@@ -1,0 +1,227 @@
+"""shard_audit layer: each SA-* invariant catches its crafted offender
+(fixtures/shard_audit/bad_kernels.py), the clean twins pass, budget drift
+renders diff-style, and the registry machinery behaves."""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+from splink_tpu.analysis.shard_audit import (
+    SHARD_REGISTRY,
+    ShardKernelSpec,
+    audit_shard_kernel,
+    load_baselines,
+    measure_shard_kernel,
+    register_shard_kernel,
+    run_shard_audit,
+    update_baselines,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "shard_audit")
+
+
+def _fixture_registry(name):
+    if FIXTURES not in sys.path:
+        sys.path.insert(0, FIXTURES)
+    return importlib.import_module(name).REGISTRY
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# bad corpus: every invariant falsified
+# ---------------------------------------------------------------------------
+
+
+def test_bad_corpus_trips_every_invariant():
+    registry = _fixture_registry("bad_kernels")
+    findings, audited = run_shard_audit(registry=registry, baselines={})
+    assert audited == 3
+    fired = set(_rules(findings))
+    # SA-COST fires as missing-baseline (fixtures are never committed)
+    assert fired >= {"SA-SPEC", "SA-COLL", "SA-PAD", "SA-COST"}
+
+
+def test_widened_partition_spec_is_a_spec_finding():
+    registry = _fixture_registry("bad_kernels")
+    findings = audit_shard_kernel(registry["widened_pspec"], baseline=None)
+    spec_findings = [f for f in findings if f.rule == "SA-SPEC"]
+    assert spec_findings, _rules(findings)
+    # file:kernel:invariant shape — the acceptance-criteria finding format
+    line = spec_findings[0].format()
+    assert "bad_kernels.py" in line and ":widened_pspec:" in line
+    assert "SA-SPEC" in line
+
+
+def test_undeclared_collective_is_a_coll_finding():
+    registry = _fixture_registry("bad_kernels")
+    findings = audit_shard_kernel(
+        registry["undeclared_collective"], baseline=None
+    )
+    coll = [f for f in findings if f.rule == "SA-COLL"]
+    assert coll and "all-reduce" in coll[0].message
+
+
+def test_dropped_weights_is_a_pad_finding():
+    registry = _fixture_registry("bad_kernels")
+    findings = audit_shard_kernel(registry["dropped_weights"], baseline=None)
+    assert "SA-PAD" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# good corpus: measure -> audit round-trips clean
+# ---------------------------------------------------------------------------
+
+
+def test_good_corpus_passes_with_measured_baselines():
+    registry = _fixture_registry("good_kernels")
+    baselines = {
+        "kernels": {
+            name: measure_shard_kernel(spec)
+            for name, spec in registry.items()
+        }
+    }
+    findings, audited = run_shard_audit(
+        registry=registry, baselines=baselines
+    )
+    assert audited == 3
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# budget drift
+# ---------------------------------------------------------------------------
+
+
+def _good_spec_and_baseline(name="weighted_reduce"):
+    registry = _fixture_registry("good_kernels")
+    spec = registry[name]
+    return spec, measure_shard_kernel(spec)
+
+
+def test_cost_drift_fails_with_diff_style_message():
+    spec, baseline = _good_spec_and_baseline()
+    drifted = dict(baseline)
+    drifted["flops"] = float(baseline.get("flops", 100.0)) * 10 + 100
+    findings = audit_shard_kernel(spec, drifted)
+    cost = [f for f in findings if f.rule == "SA-COST"]
+    assert cost, _rules(findings)
+    msg = cost[0].message
+    assert "baseline" in msg and "measured" in msg and "%" in msg
+    assert "flops" in msg
+
+
+def test_cost_within_tolerance_passes():
+    spec, baseline = _good_spec_and_baseline()
+    nudged = dict(baseline)
+    if "flops" in nudged:
+        nudged["flops"] = nudged["flops"] * 1.05  # inside the 25% band
+    assert audit_shard_kernel(spec, nudged) == []
+
+
+def test_deleted_psum_budget_drift_is_a_coll_finding():
+    spec, baseline = _good_spec_and_baseline()
+    drifted = dict(baseline)
+    counts = dict(drifted.get("collectives", {}))
+    counts["all-reduce"] = counts.get("all-reduce", 0) + 1  # one psum gone
+    drifted["collectives"] = counts
+    findings = audit_shard_kernel(spec, drifted)
+    coll = [f for f in findings if f.rule == "SA-COLL"]
+    assert coll and "budget drift" in coll[0].message
+
+
+def test_missing_baseline_is_a_cost_finding():
+    spec, _ = _good_spec_and_baseline()
+    findings = audit_shard_kernel(spec, baseline=None)
+    assert _rules(findings) == ["SA-COST"]
+    assert "shard-baselines" in findings[0].hint
+
+
+# ---------------------------------------------------------------------------
+# registry + driver machinery
+# ---------------------------------------------------------------------------
+
+
+def test_duplicate_registration_rejected():
+    reg: dict = {}
+
+    @register_shard_kernel("dup_probe", n_pairs=8, registry=reg)
+    def _b():
+        return (lambda x: x), (1.0,), {}
+
+    with pytest.raises(ValueError):
+
+        @register_shard_kernel("dup_probe", n_pairs=8, registry=reg)
+        def _b2():
+            return (lambda x: x), (1.0,), {}
+
+
+def test_unknown_kernel_rejected():
+    with pytest.raises(KeyError):
+        run_shard_audit(["no_such_kernel"], baselines={})
+
+
+def test_build_failure_is_a_finding_not_a_crash():
+    spec = ShardKernelSpec(
+        name="broken", build=lambda: (_ for _ in ()).throw(RuntimeError("x")),
+        n_pairs=8,
+    )
+    findings = audit_shard_kernel(spec, baseline=None)
+    assert "SA-ERROR" in _rules(findings)
+
+
+def test_lowering_is_cached_on_the_spec():
+    calls = {"n": 0}
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from splink_tpu.parallel.mesh import pair_sharding
+
+        calls["n"] += 1
+        mesh_ = __import__(
+            "splink_tpu.analysis.shard_audit", fromlist=["audit_mesh"]
+        ).audit_mesh()
+        x = jax.device_put(
+            np.ones(64, np.float32), pair_sharding(mesh_)
+        )
+        return (lambda x: x * jnp.float32(2)), (x,), {}
+
+    spec = ShardKernelSpec(name="cache_probe", build=build, n_pairs=64)
+    baseline = measure_shard_kernel(spec)
+    assert audit_shard_kernel(spec, baseline) == []
+    assert audit_shard_kernel(spec, baseline) == []
+    assert calls["n"] == 1  # built + lowered once across repeated audits
+
+
+# ---------------------------------------------------------------------------
+# committed package baselines
+# ---------------------------------------------------------------------------
+
+
+def test_committed_baselines_cover_the_whole_registry():
+    baselines = load_baselines()
+    findings, audited = run_shard_audit()
+    assert audited >= 8
+    names = set(baselines.get("kernels", {}))
+    assert names >= set(SHARD_REGISTRY), (
+        "run `make shard-baselines` for new kernels"
+    )
+
+
+def test_update_baselines_round_trip(tmp_path):
+    path = str(tmp_path / "baselines.json")
+    new = update_baselines(["em_stats_sharded"], path=path)
+    with open(path) as fh:
+        on_disk = json.load(fh)
+    assert on_disk == new
+    rec = on_disk["kernels"]["em_stats_sharded"]
+    assert rec["collectives"].get("all-reduce", 0) >= 1  # the stats psums
+    assert rec.get("flops", 0) > 0

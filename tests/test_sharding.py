@@ -119,10 +119,147 @@ def test_streamed_em_sharded_batches():
 
 def test_mesh_from_settings():
     assert mesh_from_settings({"mesh": {}}) is None
+    assert mesh_from_settings({}) is None
     mesh = mesh_from_settings({"mesh": {"data": 8}})
     assert mesh.devices.size == 8
     with pytest.raises(ValueError):
         mesh_from_settings({"mesh": {"model": 2}})
+
+
+def test_mesh_from_settings_explicit_single_device():
+    # {"data": 1} is a REAL one-device mesh (the sharded code path with one
+    # shard), distinct from the empty dict's unsharded path
+    mesh = mesh_from_settings({"mesh": {"data": 1}})
+    assert mesh is not None
+    assert mesh.devices.size == 1
+
+
+def test_mesh_from_settings_error_reports_supported_form():
+    for bad in (
+        {"mesh": {"model": 2}},
+        {"mesh": {"data": 0}},
+        {"mesh": {"data": -3}},
+        {"mesh": {"data": "eight"}},
+        {"mesh": {"data": True}},
+        {"mesh": {"data": 9}},  # more than the 8 visible devices
+    ):
+        with pytest.raises(ValueError, match="supported form"):
+            mesh_from_settings(bad)
+
+
+def test_linker_explicit_single_device_mesh_matches_unsharded():
+    import pandas as pd
+
+    from splink_tpu import Splink
+
+    rng = np.random.default_rng(11)
+    df = pd.DataFrame(
+        {
+            "unique_id": range(80),
+            "name": rng.choice(["ann", "bob", "cat"], 80),
+            "dob": rng.choice(["x", "y"], 80),
+        }
+    )
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "name", "comparison": {"kind": "exact"}},
+        ],
+        "blocking_rules": ["l.dob = r.dob"],
+        "max_iterations": 4,
+        "float64": True,
+    }
+    plain = Splink(dict(s), df=df).get_scored_comparisons()
+    meshed = Splink(dict(s, mesh={"data": 1}), df=df).get_scored_comparisons()
+    np.testing.assert_allclose(
+        plain.match_probability.to_numpy(),
+        meshed.match_probability.to_numpy(),
+        rtol=1e-12,
+    )
+
+
+@pytest.mark.parametrize("ndev", [2, 4, 8])
+def test_shard_pairs_padding_semantics(ndev):
+    """Uneven n_pairs across 2/4/8-way meshes: the shard_pairs padding rows
+    contribute EXACTLY nothing to the EM sufficient statistics.
+
+    Bit-identity is asserted where it is mathematically owed — the stats
+    must not change by one ulp when the padding rows' CONTENT changes
+    (weight 0 annihilates them exactly) — and the sharded aggregate matches
+    the unsharded path up to cross-shard reduction-order drift only (a
+    different summation tree legitimately rounds differently; under f64
+    that drift is bounded far below 1e-12)."""
+    from splink_tpu.models.fellegi_sunter import FSParams as FS
+    from splink_tpu.parallel.mesh import pair_sharding
+    from splink_tpu.parallel.streaming import _batch_stats
+
+    rng = np.random.default_rng(31)
+    n = 10_007  # never a multiple of 2/4/8
+    G = rng.integers(-1, 3, size=(n, 3)).astype(np.int8)
+    params = FS(
+        lam=jnp.asarray(0.3),
+        m=jnp.asarray(np.tile([0.2, 0.5, 0.3], (3, 1))),
+        u=jnp.asarray(np.tile([0.5, 0.3, 0.2], (3, 1))),
+    )
+    mesh = make_mesh(ndev)
+    G_dev, w = shard_pairs(mesh, G)
+    n_pad = G_dev.shape[0]
+    assert n_pad % ndev == 0 and n_pad >= n
+    w_host = np.asarray(w)
+    assert (w_host[:n] == 1.0).all() and (w_host[n:] == 0.0).all()
+    wf = w.astype(params.m.dtype)
+
+    stats, ll = _batch_stats(G_dev, params, 3, wf, True)
+
+    # (a) bit-identity under padding-content change: refill the padding
+    # rows with every distinct gamma value; not one output bit may move
+    for fill in (0, 1, 2):
+        G_alt = np.concatenate(
+            [G, np.full((n_pad - n, 3), fill, np.int8)]
+        )
+        G_alt_dev = jax.device_put(G_alt, pair_sharding(mesh))
+        stats_alt, ll_alt = _batch_stats(G_alt_dev, params, 3, wf, True)
+        for a, b in zip(stats, stats_alt):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert float(ll) == float(ll_alt)
+
+    # (b) an all-padding batch (weights identically 0) produces exact-zero
+    # statistics — nothing for the M-step to absorb
+    zero_w = jnp.zeros(n_pad, params.m.dtype)
+    stats_zero, _ = _batch_stats(G_dev, params, 3, zero_w, True)
+    for leaf in stats_zero:
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.zeros_like(np.asarray(leaf))
+        )
+
+    # (c) the sharded aggregate equals the unsharded one up to reduction
+    # order; the EM trajectories then agree to the same precision
+    ref_stats, ref_ll = _batch_stats(jnp.asarray(G), params, 3, None, True)
+    for a, b in zip(stats, ref_stats):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-12, atol=0
+        )
+    np.testing.assert_allclose(float(ll), float(ref_ll), rtol=1e-12)
+
+    ref_em = run_em(
+        jnp.asarray(G), params, max_iterations=6, max_levels=3,
+        em_convergence=0.0,
+    )
+    shard_em = run_em(
+        G_dev, params, max_iterations=6, max_levels=3, em_convergence=0.0,
+        weights=wf,
+    )
+    np.testing.assert_allclose(
+        np.asarray(shard_em.params.m), np.asarray(ref_em.params.m),
+        rtol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(shard_em.params.u), np.asarray(ref_em.params.u),
+        rtol=1e-12,
+    )
+    assert float(shard_em.params.lam) == pytest.approx(
+        float(ref_em.params.lam), rel=1e-12
+    )
 
 
 def test_linker_with_mesh_setting():

@@ -153,6 +153,43 @@ def test_trace_failure_is_a_finding_not_a_crash():
     assert _rules(findings) == ["TA-ERROR"]
 
 
+def test_builder_and_first_trace_cached_across_audits():
+    # the trace-cache satellite: repeated audits of one spec (the tier-1
+    # gate plus the CLI in one process) build and first-trace ONCE
+    calls = {"n": 0}
+
+    def build():
+        import jax.numpy as jnp
+
+        calls["n"] += 1
+        return (lambda x: x * 2), (jnp.zeros(4, jnp.float32),), {}
+
+    spec = _spec(build)
+    assert audit_kernel(spec) == []
+    assert audit_kernel(spec) == []
+    assert calls["n"] == 1
+    assert "trace" in spec.cache  # first trace memoised
+
+
+def test_shared_builders_cached_across_tiers():
+    # the x64-on jaxpr tier and the x64-off shard tier share one gamma
+    # program / FS input build per process
+    from splink_tpu.analysis.shard_audit import run_shard_audit
+    from splink_tpu.analysis.trace_audit import (
+        run_audit,
+        shared_fs_inputs,
+        shared_gamma_program,
+    )
+
+    run_audit(["gamma_batch", "em_step"])
+    misses_g = shared_gamma_program.cache_info().misses
+    misses_f = shared_fs_inputs.cache_info().misses
+    assert misses_g == 1 and misses_f == 1
+    run_shard_audit(["gamma_batch_sharded", "em_stats_sharded"])
+    assert shared_gamma_program.cache_info().misses == 1  # no rebuild
+    assert shared_fs_inputs.cache_info().misses == 1
+
+
 def test_duplicate_registration_rejected():
     @register_kernel("test_dup_kernel_xyz")
     def _build():
