@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines tpu-smoke obs-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -35,6 +35,12 @@ tpu-smoke:
 obs-smoke:
 	python scripts/obs_smoke.py
 
+# Serving smoke: build a LinkageIndex from the fixture corpus, serve 100
+# queries through the micro-batching service, assert serve<->offline score
+# parity (bit-identical) and zero steady-state recompiles (docs/serving.md).
+serve-smoke:
+	python scripts/serve_smoke.py
+
 bench:
 	python bench.py
 
@@ -42,4 +48,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke bench
+all: lint test tpu-smoke serve-smoke bench

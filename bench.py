@@ -248,6 +248,99 @@ def _bench_virtual_qgram(df):
         return {"virtual_qgram_error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def bench_serve():
+    """Online-serving benchmark (`python bench.py serve`): train a small
+    model over the fixture corpus, freeze it into a LinkageIndex, warm
+    every bucket combination, then push micro-batched query traffic
+    through the LinkageService and report steady-state latency percentiles
+    + throughput. The compile counter proves the bucket contract: warmup
+    compiles == bucket combinations, steady state == ZERO."""
+    _probe_device_init()
+    import jax
+
+    from splink_tpu import Splink
+    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.serve import LinkageService, QueryEngine
+
+    install_compile_monitor()
+    n_rows = int(os.environ.get("SPLINK_TPU_BENCH_SERVE_ROWS", 200_000))
+    n_queries = int(os.environ.get("SPLINK_TPU_BENCH_SERVE_QUERIES", 2000))
+    rng = np.random.default_rng(0)
+    df = _make_df(rng, n_rows)
+
+    settings = dict(SETTINGS)
+    settings["max_iterations"] = 5
+    settings["serve_top_k"] = 5
+    # the bench offers the whole query set as one burst; admission control
+    # (tested separately) would shed half of it at the default depth, so
+    # size the queue to the burst and measure pure serving throughput
+    settings["serve_queue_depth"] = n_queries
+    linker = Splink(settings, df=df)
+    t0 = time.perf_counter()
+    linker.estimate_parameters()
+    train_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    index = linker.export_index()
+    build_s = time.perf_counter() - t0
+
+    engine = QueryEngine(index)
+    t0 = time.perf_counter()
+    warm = engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    c_warm, _ = compile_totals()
+
+    records = df.sample(
+        n=min(n_queries, len(df)), replace=n_queries > len(df),
+        random_state=0,
+    ).to_dict(orient="records")
+    while len(records) < n_queries:
+        records.extend(records[: n_queries - len(records)])
+    svc = LinkageService(engine, deadline_ms=2.0)
+    # phase 1 — closed loop: one request in flight at a time. Latency here
+    # is the TRUE per-request number (coalescing deadline + one bucketed
+    # dispatch), no queueing ahead of it.
+    seq_lat = []
+    for r in records[:100]:
+        t0 = time.perf_counter()
+        svc.query(dict(r), timeout=60)
+        seq_lat.append((time.perf_counter() - t0) * 1000.0)
+    seq_p50, seq_p99 = np.percentile(np.asarray(seq_lat), [50, 99])
+    # phase 2 — open burst: the whole query set offered at once; the
+    # headline is throughput (per-request latency includes queueing).
+    t0 = time.perf_counter()
+    futures = [svc.submit(dict(r)) for r in records]
+    for f in futures:
+        f.result()
+    wall = time.perf_counter() - t0
+    svc.close()
+    c_end, _ = compile_totals()
+    summary = svc.latency_summary()
+
+    print(json.dumps({
+        "metric": "serve_queries_per_sec",
+        "value": round(n_queries / wall, 1),
+        "unit": "queries/sec",
+        "n_reference_rows": n_rows,
+        "n_queries": n_queries,
+        "top_k": engine.top_k,
+        "train_seconds": round(train_s, 3),
+        "index_build_seconds": round(build_s, 3),
+        "warmup_seconds": round(warmup_s, 3),
+        "warmup_combinations": warm["combinations"],
+        "warmup_compiles": warm["compiles"],
+        "steady_state_compiles": c_end - c_warm,
+        "sequential_p50_ms": round(float(seq_p50), 3),
+        "sequential_p99_ms": round(float(seq_p99), 3),
+        "p50_ms": round(summary.get("p50_ms", 0.0), 3),
+        "p95_ms": round(summary.get("p95_ms", 0.0), 3),
+        "p99_ms": round(summary.get("p99_ms", 0.0), 3),
+        "shed": summary["shed"],
+        "batches": summary["batches"],
+        "device": str(jax.devices()[0]),
+    }))
+
+
 def main():
     _probe_device_init()
     import jax
@@ -478,4 +571,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "serve" in sys.argv[1:]:
+        bench_serve()
+    else:
+        main()

@@ -1,0 +1,114 @@
+"""Serving smoke (`make serve-smoke`): build an index from the test
+fixture corpus, push 100 queries through the micro-batching service, and
+assert the two serving contracts end to end:
+
+  1. serve<->offline parity — every served score is BIT-identical to
+     get_scored_comparisons on the same pair;
+  2. zero steady-state recompiles — after QueryEngine.warmup() the
+     jax.monitoring compile counter stays flat across all traffic.
+
+Exits nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import numpy as np
+    import pandas as pd
+
+    from splink_tpu import Splink
+    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.serve import LinkageService, QueryEngine, load_index
+
+    install_compile_monitor()
+    rng = np.random.default_rng(7)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    n = 200
+    df = pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+    settings = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.surname = r.surname"],
+        "max_iterations": 5,
+        "serve_top_k": 64,
+        "serve_query_buckets": [16, 128],
+        "serve_candidate_buckets": [64, 256],
+        "serve_deadline_ms": 2,
+    }
+    linker = Splink(settings, df=df)
+    df_e = linker.get_scored_comparisons()
+    offline = {
+        (r["unique_id_l"], r["unique_id_r"]): np.float32(
+            r["match_probability"]
+        )
+        for _, r in df_e.iterrows()
+    }
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        linker.export_index(tmp)
+        index = load_index(tmp)
+
+    engine = QueryEngine(index)
+    warm = engine.warmup()
+    assert warm["compiles"] == warm["combinations"] == 4, warm
+    c0, _ = compile_totals()
+
+    records = df.head(100).to_dict(orient="records")
+    checked = 0
+    with LinkageService(engine, queue_depth=128) as svc:
+        futures = [svc.submit(dict(r)) for r in records]
+        for rec, fut in zip(records, futures):
+            res = fut.result(timeout=120)
+            assert not res.shed
+            q = rec["unique_id"]
+            for uid, p in res.matches:
+                if uid == q:
+                    continue
+                key = (min(q, uid), max(q, uid))
+                assert key in offline, f"served pair {key} missing offline"
+                assert offline[key] == np.float32(p), (
+                    f"parity violation on {key}: "
+                    f"offline {offline[key]!r} vs served {p!r}"
+                )
+                checked += 1
+        summary = svc.latency_summary()
+    c1, _ = compile_totals()
+    assert checked > 200, f"only {checked} pairs cross-checked"
+    assert c1 - c0 == 0, (
+        f"steady-state serving performed {c1 - c0} recompiles"
+    )
+    print(
+        "serve-smoke OK: "
+        f"{checked} pair scores bit-identical to offline, "
+        f"{summary['served']} queries served "
+        f"(p50 {summary['p50_ms']:.1f} ms, p99 {summary['p99_ms']:.1f} ms), "
+        "0 steady-state recompiles"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -816,6 +816,37 @@ def _ensure_default_registry() -> None:
             {},
         )
 
+    # Online-serving scoring kernel (serve/engine.make_score_topk_fn)
+    # sharded over the QUERY axis — the serving analogue of the pair axis:
+    # the query-side row expansion is a static broadcast (deliberately NOT
+    # an index gather, which GSPMD would all-gather under a sharded query
+    # axis), candidate gathers read the replicated reference table with
+    # sharded indices, and top-k runs along the replicated candidate axis.
+    # ZERO collectives — multi-chip serving divides query batches cleanly.
+    @register_shard_kernel("serve_score_topk_sharded", n_pairs=64)
+    def _build_serve_score_sharded():
+        import jax
+        import numpy as np
+
+        from ..parallel.mesh import pair_sharding, replicated
+        from ..serve.engine import make_score_topk_fn
+
+        mesh = audit_mesh()
+        program = shared_gamma_program()
+        _, params_small = shared_fs_inputs()
+        fn = make_score_topk_fn(
+            program._layout, program.settings["comparison_columns"], k=4
+        )
+        shard, rep = pair_sharding(mesh), replicated(mesh)
+        packed_q = jax.device_put(
+            np.zeros((64, program._packed.shape[1]), np.uint32), shard
+        )
+        packed_ref = jax.device_put(program._packed, rep)
+        cand = jax.device_put(np.zeros((64, 8), np.int32), shard)
+        valid = jax.device_put(np.zeros((64, 8), bool), shard)
+        params = jax.device_put(params_small, rep)
+        return fn, (packed_q, packed_ref, cand, valid, params), {}
+
     # String similarity is per-pair elementwise: zero collectives, output
     # sharded.
     @register_shard_kernel("jaro_winkler_sharded", n_pairs=64)

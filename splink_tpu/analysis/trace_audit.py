@@ -591,3 +591,56 @@ def _ensure_default_registry() -> None:
         tid = jnp.asarray(np.zeros(512, np.int32))
         adjusted = jnp.zeros(n_seg, jnp.float32)
         return _device_token_gather_fn(n_seg), (tid, tid, adjusted), {}
+
+    # ----- online-serving hot path (splink_tpu/serve/engine.py) -----
+    # The serving kernels run per REQUEST, so the x64 tier doubles as the
+    # latency-hygiene gate: a dtype leak or embedded constant here costs
+    # every query, not just one batch.
+
+    @register_kernel("serve_encode_query")
+    def _build_serve_encode():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..serve.engine import make_encode_query_fn
+
+        packed = jnp.asarray(np.zeros((32, 8), np.uint32))
+        qb = jnp.asarray(np.zeros((2, 32), np.int32))
+        return make_encode_query_fn(), (packed, qb, jnp.int32(20)), {}
+
+    @register_kernel("serve_candidate_gather")
+    def _build_serve_gather():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..serve.engine import make_candidate_gather_fn
+
+        fn = make_candidate_gather_fn(n_rules=2, capacity=16)
+        qb = jnp.asarray(np.zeros((2, 32), np.int32))
+        starts = tuple(jnp.asarray(np.zeros(4, np.int32)) for _ in range(2))
+        sizes = tuple(jnp.asarray(np.ones(4, np.int32)) for _ in range(2))
+        rows = tuple(jnp.asarray(np.zeros(8, np.int32)) for _ in range(2))
+        row_bucket = tuple(
+            jnp.asarray(np.zeros(6, np.int32)) for _ in range(2)
+        )
+        return fn, (qb, starts, sizes, rows, row_bucket), {}
+
+    @register_kernel("serve_score_topk")
+    def _build_serve_score():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..serve.engine import make_score_topk_fn
+
+        program = _gamma_program()
+        _, params = _fs_inputs()
+        fn = make_score_topk_fn(
+            program._layout, program.settings["comparison_columns"], k=4
+        )
+        packed_q = jnp.asarray(np.zeros((16, program._packed.shape[1]),
+                                        np.uint32))
+        cand = jnp.asarray(np.zeros((16, 8), np.int32))
+        valid = jnp.asarray(np.zeros((16, 8), bool))
+        # the packed reference table as an explicit argument — the same
+        # no-embedded-constant design TA-CONST pins for gamma_batch
+        return fn, (packed_q, program._packed, cand, valid, params), {}

@@ -1554,6 +1554,23 @@ class Splink:
     def save_model_as_json(self, path: str | os.PathLike, overwrite: bool = False):
         self.params.save_params_to_json_file(path, overwrite=overwrite)
 
+    def export_index(self, path: str | os.PathLike | None = None):
+        """Freeze this linker into an online-serving artifact
+        (:class:`splink_tpu.serve.LinkageIndex`): the encoded input table
+        as the packed reference matrix, a per-blocking-rule hash-bucket
+        index, the CURRENT parameters (train first — or load a model) and
+        the term-frequency tables. With ``path`` the artifact is also
+        persisted (atomic, versioned, hash-bound — docs/serving.md);
+        either way the built index is returned, ready for
+        ``splink_tpu.serve.QueryEngine``."""
+        from .serve.index import build_index
+
+        with self._stage("export_index"):
+            index = build_index(self)
+            if path is not None:
+                index.save(path)
+        return index
+
     # ------------------------------------------------------------------
     # Output assembly
     # ------------------------------------------------------------------

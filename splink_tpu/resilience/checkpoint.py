@@ -122,6 +122,51 @@ def checkpoint_path(directory: str | os.PathLike) -> str:
     return os.path.join(directory, CHECKPOINT_NAME)
 
 
+def fsync_dir(directory: str | os.PathLike) -> None:
+    """fsync a directory so a rename into it is durable. Best-effort: not
+    every filesystem allows opening a directory for sync."""
+    try:
+        dfd = os.open(os.fspath(directory), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # pragma: no cover - not all filesystems allow it
+        pass
+
+
+def atomic_write_bytes(path: str | os.PathLike, data: bytes) -> str:
+    """Atomically write ``data`` to ``path``: temp file in the same
+    directory, flush + fsync, os.replace over the final name, fsync the
+    directory. A reader never observes a torn file; a crash mid-write
+    leaves any previous version intact. Shared by the EM checkpoint writer
+    and the serving-index artifact (serve/index.py)."""
+    path = os.fspath(path)
+    directory = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".", suffix=".tmp", dir=directory
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(directory)
+    return path
+
+
+def atomic_write_json(path: str | os.PathLike, payload: dict) -> str:
+    """Atomic JSON write (see :func:`atomic_write_bytes`)."""
+    return atomic_write_bytes(path, json.dumps(payload).encode())
+
+
 def save_checkpoint(directory: str | os.PathLike, ckpt: EMCheckpoint) -> str:
     """Atomically persist a checkpoint; returns the final path."""
     directory = os.fspath(directory)
@@ -141,30 +186,7 @@ def save_checkpoint(directory: str | os.PathLike, ckpt: EMCheckpoint) -> str:
         "histories": ckpt.histories,
         "extra": ckpt.extra,
     }
-    fd, tmp = tempfile.mkstemp(
-        prefix=CHECKPOINT_NAME + ".", suffix=".tmp", dir=directory
-    )
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(payload, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, final)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-    # fsync the directory so the rename itself is durable
-    try:
-        dfd = os.open(directory, os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-    except OSError:  # pragma: no cover - not all filesystems allow it
-        pass
+    atomic_write_json(final, payload)
     logger.debug(
         "checkpoint saved: %s (iteration %d)", final, ckpt.iteration
     )

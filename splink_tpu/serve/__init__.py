@@ -1,0 +1,49 @@
+"""Online linkage serving: frozen index artifact, shape-bucketed query
+engine, micro-batching front-end.
+
+The offline pipeline answers "score every candidate pair of these tables";
+this package answers "which reference records match THIS record, now":
+
+    linker = Splink(settings, df=reference_df)
+    linker.estimate_parameters()
+    index = linker.export_index("index_dir")         # frozen artifact
+
+    # in the serving process
+    from splink_tpu.serve import load_index, QueryEngine, LinkageService
+    engine = QueryEngine(load_index("index_dir"))
+    engine.warmup()                                   # compile every bucket
+    with LinkageService(engine) as svc:
+        result = svc.query({"first_name": "amelia", "surname": "smith",
+                            "dob": "1987"})
+
+See docs/serving.md for the artifact format, bucket policy and latency
+tuning knobs, and ``python -m splink_tpu.serve`` for the CLI.
+"""
+
+from .bucketing import BucketPolicy, bucket_for
+from .engine import QueryEngine
+from .index import (
+    IndexMismatchError,
+    LinkageIndex,
+    QueryBatch,
+    ServeIndexError,
+    ServeRule,
+    build_index,
+    load_index,
+)
+from .service import LinkageService, QueryResult
+
+__all__ = [
+    "BucketPolicy",
+    "bucket_for",
+    "QueryEngine",
+    "LinkageIndex",
+    "QueryBatch",
+    "ServeRule",
+    "ServeIndexError",
+    "IndexMismatchError",
+    "build_index",
+    "load_index",
+    "LinkageService",
+    "QueryResult",
+]

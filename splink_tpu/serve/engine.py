@@ -1,0 +1,434 @@
+"""Shape-bucketed jitted query engine over a :class:`LinkageIndex`.
+
+The hot path is ONE fused jitted program per (query-bucket, candidate-
+bucket) shape combination, composed from three kernels (each registered in
+the analysis layers — ``serve_encode_query`` / ``serve_candidate_gather`` /
+``serve_score_topk`` in :mod:`..analysis.trace_audit`, the scoring kernel
+also sharded in :mod:`..analysis.shard_audit`):
+
+  encode_query       padding hygiene on the uploaded (donated) query
+                     buffers: rows past the batch's real length are zeroed
+                     and their rule buckets forced to -1 on device, so the
+                     host can reuse pinned upload buffers without a memset
+                     and stale bytes can never alias a candidate.
+  candidate_gather   device hash-bucket lookup: each query's per-rule
+                     bucket id dereferences the index's CSR
+                     (starts/sizes/rows_sorted) into a padded (Q, C)
+                     candidate matrix; sequential-rule dedup is an
+                     elementwise mask over the per-row bucket ids (a pair
+                     produced by an earlier rule is invalid here, the
+                     device twin of blocking.py's ``AND NOT
+                     ifnull(previous_rule, false)``).
+  score_topk         two packed-row reads (query side: a static broadcast;
+                     reference side: one gather), the comparison kernels
+                     via the shared :func:`gammas._spec_gamma` dispatch
+                     (exact bodies — bit-identical to the offline
+                     program), log-space Fellegi-Sunter scoring, and a
+                     partition-safe row-wise top-k per query
+                     (``lax.top_k`` all-gathers under a sharded query
+                     axis; see :func:`_top_k_rowwise`).
+
+Inside the fused program no scalar ever syncs to the host (JL011-clean):
+the driver dispatches the batch and fetches the packed results once.
+Shapes come from :mod:`.bucketing`; after the policy's warmup pass the jit
+cache holds every (Q, C) combination and steady-state serving performs
+zero recompiles (proven by the ``jax.monitoring`` compile counter in
+``obs.metrics``).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..utils.logging_utils import warn_degraded
+
+logger = logging.getLogger("splink_tpu")
+
+
+# ---------------------------------------------------------------------------
+# Kernel factories (pure jax; traced under jit by the engine and under the
+# analysis registries)
+# ---------------------------------------------------------------------------
+
+
+def make_encode_query_fn():
+    """(packed_q, qbuckets, valid) -> (packed_q, qbuckets) with padding rows
+    zeroed / bucket -1 on device (see module docstring)."""
+    import jax.numpy as jnp
+
+    def encode_query(packed_q, qbuckets, valid):
+        rows = jnp.arange(packed_q.shape[0], dtype=jnp.int32)
+        packed_q = jnp.where(
+            (rows < valid)[:, None], packed_q, jnp.uint32(0)
+        )
+        cols = jnp.arange(qbuckets.shape[1], dtype=jnp.int32)
+        qbuckets = jnp.where(
+            (cols < valid)[None, :], qbuckets, jnp.int32(-1)
+        )
+        return packed_q, qbuckets
+
+    return encode_query
+
+
+def make_candidate_gather_fn(n_rules: int, capacity: int):
+    """Device hash-bucket candidate decode for ``n_rules`` rules into a
+    padded (Q, ``capacity``) candidate matrix.
+
+    Per query, rule r's bucket contributes its rows at slots
+    [offset_r, offset_r + size_r) where offset_r is the running sum of the
+    earlier rules' bucket sizes — the same emission order as offline
+    blocking. A candidate whose row falls in an EARLIER rule's bucket for
+    this query is masked invalid (sequential-rule dedup)."""
+    import jax.numpy as jnp
+
+    def candidate_gather(qbuckets, starts, sizes, rows, row_bucket):
+        q_n = qbuckets.shape[1]
+        slot = jnp.arange(capacity, dtype=jnp.int32)[None, :]  # (1, C)
+        cand = jnp.zeros((q_n, capacity), jnp.int32)
+        valid = jnp.zeros((q_n, capacity), bool)
+        offset = jnp.zeros((q_n, 1), jnp.int32)
+        for r in range(n_rules):
+            qb = qbuckets[r][:, None]  # (Q, 1)
+            has = qb >= 0
+            qb0 = jnp.where(has, qb, 0)
+            cnt = jnp.where(has, sizes[r][qb0], 0)  # (Q, 1)
+            local = slot - offset  # (Q, C)
+            in_r = (local >= 0) & (local < cnt)
+            limit = jnp.int32(rows[r].shape[0] - 1)
+            pos = jnp.clip(starts[r][qb0] + local, 0, jnp.maximum(limit, 0))
+            cand_r = rows[r][pos]
+            dup = jnp.zeros(in_r.shape, bool)
+            for j in range(r):
+                qbj = qbuckets[j][:, None]
+                dup = dup | ((qbj >= 0) & (row_bucket[j][cand_r] == qbj))
+            cand = jnp.where(in_r, cand_r, cand)
+            valid = valid | (in_r & ~dup)
+            offset = offset + cnt
+        n_cand = jnp.sum(valid, axis=1, dtype=jnp.int32)
+        return cand, valid, n_cand
+
+    return candidate_gather
+
+
+def _top_k_rowwise(scores, k: int):
+    """(Q, C) -> ((Q, k) values, (Q, k) int32 indices), ``lax.top_k``
+    semantics (descending, ties by ascending index) built from k max/mask
+    passes. ``lax.top_k`` itself is unpartitionable under GSPMD — it
+    all-gathers a query-sharded score matrix onto every device (the
+    shard_audit SA-COLL gate caught exactly that) — while per-row max
+    reductions along the replicated candidate axis partition trivially.
+    k is small (the serving top-k), so k passes beat a gathered sort."""
+    import jax.numpy as jnp
+
+    c = scores.shape[1]
+    col = jnp.arange(c, dtype=jnp.int32)[None, :]
+    masked = scores
+    vals, idxs = [], []
+    for _ in range(k):
+        m = jnp.max(masked, axis=1, keepdims=True)  # (Q, 1)
+        # first index attaining the max (top_k's tie order); int32
+        # throughout — jnp.argmax would emit int64 under x64
+        i = jnp.min(
+            jnp.where(masked == m, col, jnp.int32(c)), axis=1
+        )
+        i = jnp.minimum(i, jnp.int32(c - 1))
+        vals.append(m[:, 0])
+        idxs.append(i)
+        masked = jnp.where(col == i[:, None], jnp.asarray(-2.0, scores.dtype), masked)
+    return jnp.stack(vals, axis=1), jnp.stack(idxs, axis=1)
+
+
+def make_score_topk_fn(layout: dict, comparison_columns, k: int):
+    """(packed_q, packed_ref, cand, valid, params) -> (top_p, top_rows,
+    top_valid): gammas via the shared comparison dispatch (exact bodies),
+    Fellegi-Sunter match probabilities, masked top-k per query. Invalid
+    slots score an impossible -1 so they can never displace a real
+    candidate; ``top_valid`` reports which of the k slots are real."""
+    import jax.numpy as jnp
+
+    from ..gammas import PairContext, _spec_gamma
+    from ..models.fellegi_sunter import match_probability
+
+    cols = tuple(comparison_columns)
+
+    def score_topk(packed_q, packed_ref, cand, valid, params):
+        q_n, capacity = cand.shape
+        # query side: static repeat (broadcast + reshape), NOT an index
+        # gather — same row order as packed_q[repeat(arange(Q), C)] but
+        # partitions trivially when the query axis is sharded (a computed-
+        # index gather of a sharded operand would all-gather it; the
+        # shard_audit SA-COLL budget pins this kernel collective-free)
+        rows_l = jnp.repeat(packed_q, capacity, axis=0)
+        rflat = cand.reshape(-1)
+        rows_r = packed_ref[rflat]
+        ctx = PairContext(layout, rows_l, rows_r, None)
+        G = jnp.stack([_spec_gamma(c, ctx) for c in cols], axis=1)
+        p = match_probability(G, params)
+        scores = jnp.where(
+            valid.reshape(-1), p, jnp.asarray(-1.0, p.dtype)
+        ).reshape(q_n, capacity)
+        top_p, top_i = _top_k_rowwise(scores, k)
+        top_rows = jnp.take_along_axis(cand, top_i, axis=1)
+        top_valid = jnp.take_along_axis(valid, top_i, axis=1)
+        # a row with fewer than k valid candidates re-picks slot 0 with the
+        # -2 mask sentinel once real entries are exhausted; the score guard
+        # keeps such duplicates from reading slot 0's valid flag (real
+        # probabilities are >= 0, invalid slots -1, re-picks -2)
+        top_valid = top_valid & (top_p > -0.5)
+        return top_p, top_rows, top_valid
+
+    return score_topk
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+class QueryEngine:
+    """Low-latency query interface over a resident :class:`LinkageIndex`.
+
+    One engine owns one index's device residency and one jit cache. Use
+    :meth:`warmup` (via a :class:`~.bucketing.BucketPolicy`) before taking
+    traffic so steady-state batches never compile.
+    """
+
+    def __init__(self, index, *, top_k: int | None = None, policy=None,
+                 telemetry=None):
+        from .bucketing import BucketPolicy
+
+        self.index = index
+        settings = index.settings
+        self.top_k = int(
+            top_k
+            if top_k is not None
+            else settings.get("serve_top_k", 5) or 5
+        )
+        self.policy = policy or BucketPolicy.from_settings(settings)
+        if self.top_k > self.policy.candidate_buckets[-1]:
+            raise ValueError(
+                f"serve_top_k={self.top_k} exceeds the largest candidate "
+                f"bucket ({self.policy.candidate_buckets[-1]}); widen "
+                "serve_candidate_buckets — top-k cannot exceed the padded "
+                "candidate capacity"
+            )
+        self._obs = telemetry
+        self._kernel = None
+        self._donate = None
+        self._warmed: set[tuple[int, int]] = set()
+        # float64 serving needs process-wide x64, same semantics as the
+        # linker's float64 setting (jax silently downcasts otherwise)
+        if index.dtype == "float64":
+            import jax
+
+            if jax.default_backend() == "tpu":  # pragma: no cover - no TPU CI
+                raise ValueError(
+                    "index was built for float64 but the TPU backend has no "
+                    "float64 support; rebuild with float64 off"
+                )
+            if not jax.config.jax_enable_x64:
+                jax.config.update("jax_enable_x64", True)
+                logger.info(
+                    "float64 serving index: enabled jax x64 mode "
+                    "(process-wide)"
+                )
+
+    # -- kernel ---------------------------------------------------------
+
+    def _fused_kernel(self):
+        """The ONE jitted program (built lazily, stable identity so the jit
+        cache persists across batches). ``capacity`` is a static argument:
+        each (capacity, shapes) combination compiles once and is reused."""
+        if self._kernel is None:
+            import functools
+
+            import jax
+
+            index = self.index
+            n_rules = len(index.rules)
+            encode = make_encode_query_fn()
+            layout = index.layout
+            cols = tuple(index.settings["comparison_columns"])
+            k = self.top_k
+            score = make_score_topk_fn(layout, cols, k)
+
+            def fused(
+                capacity, packed_q, qbuckets, valid,
+                starts, sizes, rows, row_bucket, packed_ref, params,
+            ):
+                gather = make_candidate_gather_fn(n_rules, capacity)
+                packed_q, qbuckets = encode(packed_q, qbuckets, valid)
+                cand, cvalid, n_cand = gather(
+                    qbuckets, starts, sizes, rows, row_bucket
+                )
+                top_p, top_rows, top_valid = score(
+                    packed_q, packed_ref, cand, cvalid, params
+                )
+                return top_p, top_rows, top_valid, n_cand
+
+            # donate the per-request buffers (query rows + buckets); the
+            # CPU backend ignores donation with a warning, so gate it
+            donate = ()
+            if jax.default_backend() not in ("cpu",):
+                donate = (1, 2)
+            self._donate = donate
+            self._kernel = functools.partial(
+                jax.jit, static_argnums=(0,), donate_argnums=donate
+            )(fused)
+        return self._kernel
+
+    # -- query paths ----------------------------------------------------
+
+    def encode(self, df):
+        """Host-side query encode (see LinkageIndex.encode_queries)."""
+        return self.index.encode_queries(df)
+
+    def query_arrays(self, df):
+        """Score a query DataFrame; returns
+        ``(top_p, top_rows, top_valid, n_candidates)`` numpy arrays of
+        shape (n, k) / (n,). ``top_rows`` are reference ROW indices; map
+        through ``index.unique_id`` for ids (``query`` does)."""
+        batch = self.encode(df)
+        out_p = np.full((batch.n, self.top_k), -1.0, self.index.float_dtype)
+        out_rows = np.zeros((batch.n, self.top_k), np.int32)
+        out_valid = np.zeros((batch.n, self.top_k), bool)
+        out_ncand = np.zeros(batch.n, np.int64)
+        pos = 0
+        for q_pad, start, stop in self.policy.iter_query_chunks(batch.n):
+            p, r, v, nc = self._run_chunk(batch, start, stop, q_pad)
+            out_p[start:stop] = p[: stop - start]
+            out_rows[start:stop] = r[: stop - start]
+            out_valid[start:stop] = v[: stop - start]
+            out_ncand[start:stop] = nc[: stop - start]
+            pos = stop
+        assert pos == batch.n
+        return out_p, out_rows, out_valid, out_ncand
+
+    def _run_chunk(self, batch, start: int, stop: int, q_pad: int):
+        """One bucketed device dispatch: pad the chunk to ``q_pad`` queries
+        and its candidate axis to a policy bucket, run the fused kernel,
+        fetch once."""
+        import jax.numpy as jnp
+
+        index = self.index
+        n = stop - start
+        qb = batch.qbuckets[:, start:stop]
+        counts = index.candidate_counts(qb)
+        need = max(int(counts.max(initial=0)), self.top_k, 1)
+        capacity = self.policy.candidate_bucket(need)
+        if capacity is None:
+            capacity = self.policy.candidate_buckets[-1]
+            warn_degraded(
+                "serve_candidates",
+                "truncated",
+                f"largest candidate block needs {need} slots but the "
+                f"largest candidate bucket is {capacity}; blocks are "
+                "truncated to the bucket (top-k over the truncated set)",
+                queries=n,
+            )
+        # pinned upload buffers are reused without a host memset: the
+        # encode_query kernel zeroes padding rows on device
+        packed_pad = np.empty((q_pad, index.n_lanes), np.uint32)
+        packed_pad[:n] = batch.packed[start:stop]
+        qb_pad = np.empty((len(index.rules), q_pad), np.int32)
+        qb_pad[:, :n] = qb
+        dev = index.device_state()
+        kernel = self._fused_kernel()
+        top_p, top_rows, top_valid, n_cand = kernel(
+            capacity,
+            jnp.asarray(packed_pad),
+            jnp.asarray(qb_pad),
+            np.int32(n),
+            dev["starts"],
+            dev["sizes"],
+            dev["rows"],
+            dev["row_bucket"],
+            dev["packed"],
+            dev["params"],
+        )
+        self._warmed.add((q_pad, capacity))
+        # the single host fetch for this batch
+        return (
+            np.asarray(top_p),
+            np.asarray(top_rows),
+            np.asarray(top_valid),
+            np.asarray(n_cand),
+        )
+
+    def query(self, df):
+        """Score a query DataFrame; returns a tidy DataFrame with one row
+        per (query, match): query id, matched reference id, rank, match
+        probability and the query's candidate count."""
+        import pandas as pd
+
+        top_p, top_rows, top_valid, n_cand = self.query_arrays(df)
+        ref_uid = self.index.unique_id
+        q_idx, rank = np.nonzero(top_valid)
+        uid_col = self.index.settings["unique_id_column_name"]
+        query_uid = self._query_uids(df)
+        return pd.DataFrame(
+            {
+                f"{uid_col}_q": query_uid[q_idx],
+                f"{uid_col}_m": ref_uid[top_rows[q_idx, rank]],
+                "rank": rank.astype(np.int64),
+                "match_probability": top_p[q_idx, rank],
+                "n_candidates": n_cand[q_idx],
+            }
+        )
+
+    def _query_uids(self, df) -> np.ndarray:
+        uid_col = self.index.settings["unique_id_column_name"]
+        if uid_col in df.columns:
+            return df[uid_col].to_numpy()
+        return np.arange(len(df))
+
+    # -- warmup / compile accounting ------------------------------------
+
+    def warmup(self) -> dict:
+        """Compile every (query-bucket, candidate-bucket) combination with
+        dummy batches so steady-state serving never compiles. Returns
+        ``{"combinations": N, "compiles": measured backend compiles}`` —
+        the compile count is the jax.monitoring-measured proof that one
+        combination costs exactly one compile (and, after this, zero)."""
+        from ..obs.metrics import compile_totals, install_compile_monitor
+
+        install_compile_monitor()
+        c0, _ = compile_totals()
+        combos = self.policy.warmup_combinations()
+        for q_pad, capacity in combos:
+            self._warm_one(q_pad, capacity)
+        c1, _ = compile_totals()
+        if self._obs is not None:
+            self._obs.count("serve_warmup_compiles", c1 - c0)
+        return {"combinations": len(combos), "compiles": c1 - c0}
+
+    def _warm_one(self, q_pad: int, capacity: int) -> None:
+        import jax.numpy as jnp
+
+        index = self.index
+        dev = index.device_state()
+        kernel = self._fused_kernel()
+        packed = np.zeros((q_pad, index.n_lanes), np.uint32)
+        qb = np.full((len(index.rules), q_pad), -1, np.int32)
+        out = kernel(
+            capacity,
+            jnp.asarray(packed),
+            jnp.asarray(qb),
+            np.int32(0),
+            dev["starts"],
+            dev["sizes"],
+            dev["rows"],
+            dev["row_bucket"],
+            dev["packed"],
+            dev["params"],
+        )
+        np.asarray(out[0])  # execute fully
+        self._warmed.add((q_pad, capacity))
+
+    @property
+    def warmed_shapes(self) -> set:
+        """The (query_bucket, candidate_bucket) combinations compiled so
+        far."""
+        return set(self._warmed)

@@ -1,0 +1,832 @@
+"""LinkageIndex: the frozen, versioned serving artifact.
+
+Everything built so far is batch/offline — train a model, score every pair,
+exit. This module is the bridge to ONLINE linkage: a trained ``Splink``
+linker freezes into a :class:`LinkageIndex`, a self-contained artifact that
+a query service loads once and serves from for its whole lifetime. It holds
+
+  * the encoded reference table as the packed uint32 row matrix the gamma
+    kernels gather from (``gammas.pack_table`` layout — resident on device
+    for the life of the engine, so a query batch costs exactly two row
+    gathers like the offline path),
+  * a per-blocking-rule hash-bucket index over the same packed key codes
+    blocking.py joins on (``_key_codes``): rows grouped by combined key
+    code in CSR form (``rows_sorted``/``starts``/``sizes``) plus a
+    per-row bucket id for device-side sequential-rule dedup, plus the
+    host-side key -> bucket dictionary a query record resolves through,
+  * the trained Fellegi-Sunter parameters,
+  * the term-frequency tables (per-token counts) of every TF-flagged
+    column, and the per-column vocabularies that bind query-side encoding
+    to the reference factorisation.
+
+Durability mirrors the EM checkpoints (resilience/checkpoint.py, whose
+atomic-write machinery this reuses): the artifact is versioned, the meta
+JSON is the atomic commit point, the settings are hash-bound (an index
+built for different settings or a different reference extract is rejected,
+never silently served), and the array payload carries a content fingerprint
+verified at load.
+
+Serving restriction: blocking rules must be pure equality conjunctions
+(``l.a = r.a AND substr(l.b,1,3) = substr(r.b,1,3)`` — symmetric keys,
+derived-key expressions included). Residual predicates and cross-column
+equalities have no bucket structure to index; :func:`build_index` rejects
+them with a clear error rather than serving wrong candidates.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..blocking import _key_codes, _sort_groups, clear_key_code_cache
+from ..compat_sql import parse_blocking_rule
+from ..data import (
+    EncodedStringColumn,
+    EncodedTable,
+    encode_table,
+)
+from ..gammas import (
+    charset_specs_for,
+    comparison_columns_used,
+    pack_table,
+    qgram_specs_for,
+)
+from ..resilience.checkpoint import (
+    atomic_write_bytes,
+    atomic_write_json,
+    settings_state_hash,
+)
+
+logger = logging.getLogger("splink_tpu")
+
+INDEX_VERSION = 1
+META_NAME = "linkage_index.json"
+ARRAYS_STEM = "linkage_index"  # arrays live at <stem>-<sha16>.npz
+
+# canonical-key-token type tags (see _canon_token)
+_KEY_SEP = "\x1f"
+
+
+class ServeIndexError(RuntimeError):
+    """Unreadable / corrupt / mismatched serving index."""
+
+
+class IndexMismatchError(ServeIndexError):
+    """Index belongs to a different job (settings hash, format version or
+    array fingerprint disagree) — refusing to serve from it."""
+
+
+def _canon_token(v) -> str | None:
+    """Canonical string token for one blocking-key value, equality-isomorphic
+    to the factorisation blocking.py keys on: strings compare by their
+    ``str()`` form (token-id semantics), numbers by exact float value.
+    None means null — a null key never joins (SQL equality)."""
+    if v is None:
+        return None
+    if isinstance(v, (bool, np.bool_)):
+        return f"b:{bool(v)}"
+    if isinstance(v, (int, np.integer)):
+        f = float(v)
+        return f"n:{f!r}" if int(f) == int(v) else f"i:{int(v)}"
+    if isinstance(v, (float, np.floating)):
+        return f"n:{float(v)!r}"
+    return f"s:{v}"
+
+
+def _canonical_key_values(table: EncodedTable, col: str) -> np.ndarray:
+    """(n_rows,) object array of canonical key values for one blocking-key
+    column/expression; None where null. The single definition used at index
+    build (reference side) and at query encode (query side), so the two
+    sides cannot drift. Tokens materialise only for NON-null rows and the
+    common families skip the _canon_token dispatch per value (a build over
+    the full reference walks this once per rule key column)."""
+    import pandas as pd
+
+    n = table.n_rows
+    out = np.empty(n, dtype=object)
+    out[:] = None
+    if col in table.strings:
+        sc = table.strings[col]
+        nz = np.flatnonzero(~sc.null_mask)
+        out[nz] = [f"s:{sc.values[i]}" for i in nz]
+        return out
+    if col in table.numerics:
+        nc = table.numerics[col]
+        nz = np.flatnonzero(~nc.null_mask)
+        # .tolist() yields PYTHON floats: numpy 2 reprs scalars as
+        # "np.float64(x)", which would silently split every bucket key
+        vals = nc.values_f64.tolist()
+        out[nz] = [f"n:{vals[i]!r}" for i in nz]
+        return out
+    if col in table.raw:
+        vals = table.raw[col]
+        null = pd.isna(pd.Series(vals)).to_numpy()
+        nz = np.flatnonzero(~null)
+        out[nz] = [_canon_token(vals[i]) for i in nz]
+        return out
+    from ..derived_keys import is_plain_column, key_values_object
+
+    if is_plain_column(col):
+        raise KeyError(f"blocking key column {col!r} is not in the table")
+    vals, null = key_values_object(table, col)
+    nz = np.flatnonzero(~np.asarray(null))
+    out[nz] = [_canon_token(vals[i]) for i in nz]
+    return out
+
+
+def _rule_key_cols(rule: str) -> list[str]:
+    """The symmetric equality key columns of one blocking rule, or raise
+    for shapes serving cannot index (residuals, cross-column keys, keyless
+    rules)."""
+    from ..blocking import _split_join_keys
+
+    eq_pairs, residual = parse_blocking_rule(rule)
+    sym, asym, residual = _split_join_keys(eq_pairs, residual)
+    if residual is not None:
+        raise ValueError(
+            f"blocking rule {rule!r} has a non-equality residual predicate; "
+            "online serving indexes pure equality conjunctions only — move "
+            "the filter into the comparison columns or drop it for serving"
+        )
+    if asym:
+        raise ValueError(
+            f"blocking rule {rule!r} joins across different columns/"
+            "expressions (l.a = r.b); online serving indexes symmetric "
+            "keys only"
+        )
+    if not sym:
+        raise ValueError(
+            f"blocking rule {rule!r} has no equality condition (cartesian); "
+            "online serving requires at least one equality key"
+        )
+    return sym
+
+
+@dataclass
+class ServeRule:
+    """One blocking rule's frozen hash-bucket index."""
+
+    rule: str
+    key_cols: list[str]
+    rows_sorted: np.ndarray  # (n_valid,) int32: rows grouped by bucket
+    starts: np.ndarray  # (n_buckets,) int32 CSR starts into rows_sorted
+    sizes: np.ndarray  # (n_buckets,) int32 bucket sizes
+    row_bucket: np.ndarray  # (n_rows,) int32 bucket of each row; -1 null key
+    bucket_of: dict = field(default_factory=dict)  # canonical key -> bucket
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.starts)
+
+    def query_bucket(self, key_tokens: list) -> int:
+        """Bucket index for one query's canonical key tokens; -1 when any
+        key is null or the combination is absent from the reference."""
+        if any(t is None for t in key_tokens):
+            return -1
+        return self.bucket_of.get(_KEY_SEP.join(key_tokens), -1)
+
+
+@dataclass
+class QueryBatch:
+    """Host-side encoded query batch, ready for the engine."""
+
+    packed: np.ndarray  # (n, n_lanes) uint32, same layout as the index
+    qbuckets: np.ndarray  # (n_rules, n) int32; -1 = no candidates
+    n: int
+    unique_id: np.ndarray  # (n,) query ids (positional when absent)
+
+
+class LinkageIndex:
+    """Frozen serving artifact for one trained linker (module docstring)."""
+
+    def __init__(
+        self,
+        *,
+        settings: dict,
+        dtype: str,
+        lam: float,
+        m: np.ndarray,
+        u: np.ndarray,
+        packed: np.ndarray,
+        layout: dict,
+        string_cols: list[str],
+        numeric_cols: list[str],
+        string_meta: dict,
+        rules: list[ServeRule],
+        unique_id: np.ndarray,
+        tf_tables: dict,
+        state_hash: str,
+    ):
+        self.settings = settings
+        self.dtype = dtype  # "float32" | "float64"
+        self.lam = float(lam)
+        self.m = np.asarray(m)
+        self.u = np.asarray(u)
+        self.packed = packed
+        self.layout = layout
+        self.string_cols = string_cols
+        self.numeric_cols = numeric_cols
+        self.string_meta = string_meta  # name -> {width, kind, vocab}
+        self.rules = rules
+        self.unique_id = unique_id
+        self.tf_tables = tf_tables  # name -> (n_tokens,) int64 counts
+        self.state_hash = state_hash
+        self._device = None  # memoised device-resident arrays
+        self._vocab_maps: dict | None = None
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.unique_id)
+
+    @property
+    def n_lanes(self) -> int:
+        return self.packed.shape[1]
+
+    @property
+    def float_dtype(self):
+        return np.float64 if self.dtype == "float64" else np.float32
+
+    def candidate_counts(self, qbuckets: np.ndarray) -> np.ndarray:
+        """(n,) int64 upper-bound candidate count per query (duplicates
+        across rules included — the capacity the engine pads to)."""
+        total = np.zeros(qbuckets.shape[1], np.int64)
+        for r, rule in enumerate(self.rules):
+            qb = qbuckets[r]
+            has = qb >= 0
+            total[has] += rule.sizes[qb[has]]
+        return total
+
+    # ------------------------------------------------------------------
+    # Device residency
+    # ------------------------------------------------------------------
+
+    def device_state(self):
+        """Memoised device-resident arrays: the packed reference matrix,
+        the per-rule bucket CSR arrays and the trained FSParams — uploaded
+        once, shared by every query batch for the index's lifetime."""
+        if self._device is None:
+            import jax.numpy as jnp
+
+            from ..models.fellegi_sunter import FSParams
+
+            dt = self.float_dtype
+            self._device = {
+                "packed": jnp.asarray(self.packed),
+                "starts": tuple(jnp.asarray(r.starts) for r in self.rules),
+                "sizes": tuple(jnp.asarray(r.sizes) for r in self.rules),
+                "rows": tuple(jnp.asarray(r.rows_sorted) for r in self.rules),
+                "row_bucket": tuple(
+                    jnp.asarray(r.row_bucket) for r in self.rules
+                ),
+                "params": FSParams(
+                    lam=jnp.asarray(np.asarray(self.lam, dt)),
+                    m=jnp.asarray(self.m.astype(dt)),
+                    u=jnp.asarray(self.u.astype(dt)),
+                ),
+            }
+        return self._device
+
+    # ------------------------------------------------------------------
+    # Query-side encoding
+    # ------------------------------------------------------------------
+
+    def encode_queries(self, df) -> QueryBatch:
+        """Encode a query DataFrame into the index's packed layout.
+
+        Query records encode against the REFERENCE vocabulary: a query
+        string seen in the reference takes its reference token id (so exact
+        and token-equality comparisons behave identically to the offline
+        pipeline); unseen values take fresh ids past the reference
+        vocabulary. Char/length/numeric encoding is pinned to the reference
+        layout (width, ascii/wide kind, f32/f64 lanes), so the packed query
+        matrix is gather-compatible with the resident reference matrix and
+        gammas are bit-identical to the offline program on shared records.
+        """
+        import pandas as pd
+
+        settings = self.settings
+        uid_col = settings["unique_id_column_name"]
+        if uid_col not in df.columns:
+            df = df.copy()
+            df[uid_col] = np.arange(len(df))
+        qtable = encode_table(df, settings)
+        # pin every packed string column to the reference encoding
+        for name in self.string_cols:
+            if name not in qtable.strings:
+                raise ValueError(
+                    f"query data is missing encoded column {name!r}"
+                )
+            qtable.strings[name] = self._pin_string_column(
+                qtable.strings[name], self.string_meta[name]
+            )
+        # pack_table iterates insertion order; rebuild the dicts in the
+        # exact order recorded at build so lanes line up byte for byte
+        qtable.strings = {
+            **{n: qtable.strings[n] for n in self.string_cols},
+            **{
+                n: c
+                for n, c in qtable.strings.items()
+                if n not in self.string_cols
+            },
+        }
+        for name in self.numeric_cols:
+            if name not in qtable.numerics:
+                raise ValueError(
+                    f"query data is missing numeric column {name!r}"
+                )
+        qtable.numerics = {
+            **{n: qtable.numerics[n] for n in self.numeric_cols},
+            **{
+                n: c
+                for n, c in qtable.numerics.items()
+                if n not in self.numeric_cols
+            },
+        }
+        import jax.numpy as jnp
+
+        float_dtype = (
+            jnp.float64 if self.dtype == "float64" else jnp.float32
+        )
+        packed_q, _ = pack_table(
+            qtable,
+            float_dtype,
+            include=comparison_columns_used(settings),
+            qgram_specs=qgram_specs_for(settings),
+            charset_specs=charset_specs_for(settings),
+            jw_specs=(),
+        )
+        if packed_q.shape[1] != self.n_lanes:
+            raise ServeIndexError(
+                f"query packing produced {packed_q.shape[1]} lanes but the "
+                f"index holds {self.n_lanes} — the settings or encoding "
+                "drifted from the artifact"
+            )
+        qbuckets = np.full((len(self.rules), len(df)), -1, np.int32)
+        for r, rule in enumerate(self.rules):
+            tokens = [
+                _canonical_key_values(qtable, col) for col in rule.key_cols
+            ]
+            for q in range(len(df)):
+                qbuckets[r, q] = rule.query_bucket(
+                    [t[q] for t in tokens]
+                )
+        return QueryBatch(
+            packed=packed_q,
+            qbuckets=qbuckets,
+            n=len(df),
+            unique_id=np.asarray(pd.Series(df[uid_col]).to_numpy()),
+        )
+
+    def _pin_string_column(
+        self, sc: EncodedStringColumn, meta: dict
+    ) -> EncodedStringColumn:
+        """Re-encode a query string column against the reference layout:
+        reference width, reference ascii/wide kind, reference vocabulary
+        token ids (unseen values get fresh ids past the vocabulary)."""
+        width = int(meta["width"])
+        kind = meta["kind"]
+        vocab = self._vocab_map_for(meta)
+        n = len(sc.token_ids)
+        n_ref = len(meta["vocab"])
+        token_ids = np.full(n, -1, np.int32)
+        fresh: dict[str, int] = {}
+        if kind == "ascii":
+            bytes_ = np.zeros((n, width), np.uint8)
+        else:
+            bytes_ = np.zeros((n, width), np.uint32)
+        lengths = np.zeros(n, np.int32)
+        for i in range(n):
+            if sc.null_mask[i]:
+                continue
+            v = str(sc.values[i])
+            tid = vocab.get(v)
+            if tid is None:
+                tid = fresh.get(v)
+                if tid is None:
+                    tid = fresh[v] = n_ref + len(fresh)
+            token_ids[i] = tid
+            chars = v[:width]
+            lengths[i] = len(chars)
+            for j, ch in enumerate(chars):
+                cp = ord(ch)
+                if kind == "ascii":
+                    # a non-ASCII query char in an ASCII-only reference
+                    # column definitionally matches no reference char;
+                    # 0xFF never appears in ASCII reference bytes
+                    bytes_[i, j] = cp if cp < 128 else 0xFF
+                else:
+                    bytes_[i, j] = cp
+        return EncodedStringColumn(
+            bytes_=bytes_,
+            lengths=lengths,
+            token_ids=token_ids,
+            null_mask=sc.null_mask,
+            values=sc.values,
+            width=width,
+        )
+
+    def _vocab_map_for(self, meta: dict) -> dict:
+        key = id(meta)
+        if self._vocab_maps is None:
+            self._vocab_maps = {}
+        vm = self._vocab_maps.get(key)
+        if vm is None:
+            vm = self._vocab_maps[key] = {
+                v: i for i, v in enumerate(meta["vocab"])
+            }
+        return vm
+
+    # ------------------------------------------------------------------
+    # Persistence
+    # ------------------------------------------------------------------
+
+    def save(self, directory: str | os.PathLike) -> str:
+        """Persist the artifact: arrays first (under a fingerprint-derived
+        file name), then the meta JSON as the atomic commit point. Saving
+        OVER an existing artifact is crash-safe: the new arrays land in a
+        fresh file, so a crash before the meta commit leaves the previous
+        meta still pointing at the previous (intact) arrays; superseded
+        arrays files are swept only after the commit. Returns the meta
+        path."""
+        directory = os.fspath(directory)
+        os.makedirs(directory, exist_ok=True)
+        buf = io.BytesIO()
+        arrays = {"packed": self.packed}
+        for r, rule in enumerate(self.rules):
+            arrays[f"rule{r}_rows"] = rule.rows_sorted
+            arrays[f"rule{r}_starts"] = rule.starts
+            arrays[f"rule{r}_sizes"] = rule.sizes
+            arrays[f"rule{r}_row_bucket"] = rule.row_bucket
+        for name, counts in self.tf_tables.items():
+            arrays[f"tf_{name}"] = counts
+        if self.unique_id.dtype != object:
+            arrays["unique_id"] = self.unique_id
+        np.savez_compressed(buf, **arrays)
+        payload = buf.getvalue()
+        fingerprint = hashlib.sha256(payload).hexdigest()
+        arrays_file = f"{ARRAYS_STEM}-{fingerprint[:16]}.npz"
+        atomic_write_bytes(os.path.join(directory, arrays_file), payload)
+        from ..params import _jsonable_settings
+
+        meta = {
+            "version": INDEX_VERSION,
+            "state_hash": self.state_hash,
+            "arrays_file": arrays_file,
+            "arrays_sha256": fingerprint,
+            "dtype": self.dtype,
+            "settings": _jsonable_settings(self.settings),
+            "lam": self.lam,
+            "m": self.m.tolist(),
+            "u": self.u.tolist(),
+            "string_cols": self.string_cols,
+            "numeric_cols": self.numeric_cols,
+            "string_meta": self.string_meta,
+            "rules": [
+                {
+                    "rule": r.rule,
+                    "key_cols": r.key_cols,
+                    "bucket_of": r.bucket_of,
+                }
+                for r in self.rules
+            ],
+            "tf_columns": sorted(self.tf_tables),
+            "n_rows": self.n_rows,
+            "unique_id_json": (
+                self.unique_id.tolist()
+                if self.unique_id.dtype == object
+                else None
+            ),
+        }
+        path = atomic_write_json(os.path.join(directory, META_NAME), meta)
+        # post-commit sweep of superseded arrays files (best-effort: a
+        # leftover costs disk, never correctness — meta names its file)
+        try:
+            for name in os.listdir(directory):
+                if (
+                    name.startswith(ARRAYS_STEM)
+                    and name.endswith(".npz")
+                    and name != arrays_file
+                ):
+                    os.unlink(os.path.join(directory, name))
+        except OSError:  # pragma: no cover - sweep is best-effort
+            pass
+        logger.info(
+            "linkage index saved: %s (%d rows, %d rules, %d lanes)",
+            directory, self.n_rows, len(self.rules), self.n_lanes,
+        )
+        return path
+
+
+def load_index(directory: str | os.PathLike) -> LinkageIndex:
+    """Load a saved index, verifying format version, settings-hash binding
+    and the array-payload fingerprint (a torn or tampered artifact is
+    rejected, never served)."""
+    directory = os.fspath(directory)
+    meta_path = os.path.join(directory, META_NAME)
+    try:
+        with open(meta_path, encoding="utf-8") as fh:
+            meta = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ServeIndexError(f"unreadable index meta at {meta_path}: {e}") from e
+    if meta.get("version") != INDEX_VERSION:
+        raise IndexMismatchError(
+            f"index at {directory} has format version "
+            f"{meta.get('version')!r}; this build reads {INDEX_VERSION}. "
+            "Rebuild the index with build_index()."
+        )
+    arrays_name = meta.get("arrays_file")
+    if not arrays_name or os.path.sep in arrays_name:
+        raise ServeIndexError(
+            f"index meta at {meta_path} names no valid arrays file"
+        )
+    arrays_path = os.path.join(directory, arrays_name)
+    try:
+        with open(arrays_path, "rb") as fh:
+            payload = fh.read()
+    except OSError as e:
+        raise ServeIndexError(f"unreadable index arrays at {arrays_path}: {e}") from e
+    fingerprint = hashlib.sha256(payload).hexdigest()
+    if fingerprint != meta.get("arrays_sha256"):
+        raise IndexMismatchError(
+            f"index arrays at {arrays_path} do not match the meta "
+            "fingerprint (torn write or tampering); rebuild the index"
+        )
+    settings = meta["settings"]
+    expect = settings_state_hash(
+        settings, extra={"artifact": "linkage_index", "n_rows": meta["n_rows"]}
+    )
+    if expect != meta.get("state_hash"):
+        raise IndexMismatchError(
+            f"index at {directory} was written for a different job "
+            f"(settings hash {meta.get('state_hash')!r}, recomputed "
+            f"{expect!r}); rebuild the index"
+        )
+    npz = np.load(io.BytesIO(payload), allow_pickle=False)
+    rules = []
+    for r, rm in enumerate(meta["rules"]):
+        rules.append(
+            ServeRule(
+                rule=rm["rule"],
+                key_cols=list(rm["key_cols"]),
+                rows_sorted=npz[f"rule{r}_rows"],
+                starts=npz[f"rule{r}_starts"],
+                sizes=npz[f"rule{r}_sizes"],
+                row_bucket=npz[f"rule{r}_row_bucket"],
+                bucket_of=dict(rm["bucket_of"]),
+            )
+        )
+    if meta.get("unique_id_json") is not None:
+        unique_id = np.asarray(meta["unique_id_json"], dtype=object)
+    else:
+        unique_id = npz["unique_id"]
+    tf_tables = {name: npz[f"tf_{name}"] for name in meta.get("tf_columns", [])}
+    return LinkageIndex(
+        settings=settings,
+        dtype=meta["dtype"],
+        lam=meta["lam"],
+        m=np.asarray(meta["m"]),
+        u=np.asarray(meta["u"]),
+        packed=npz["packed"],
+        layout=None,  # rebuilt below
+        string_cols=list(meta["string_cols"]),
+        numeric_cols=list(meta["numeric_cols"]),
+        string_meta=meta["string_meta"],
+        rules=rules,
+        unique_id=unique_id,
+        tf_tables=tf_tables,
+        state_hash=meta["state_hash"],
+    )._rebuild_layout()
+
+
+def _string_vocab(sc: EncodedStringColumn) -> list[str]:
+    """token id -> stringified value, the factorisation the reference
+    encoding committed to (token ids factorise the str() forms)."""
+    tids = sc.token_ids
+    n_tokens = sc.n_tokens
+    vocab: list[str | None] = [None] * n_tokens
+    uniq, first = np.unique(tids, return_index=True)
+    for tid, idx in zip(uniq, first):
+        if tid >= 0:
+            vocab[int(tid)] = str(sc.values[int(idx)])
+    return [v if v is not None else "" for v in vocab]
+
+
+def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
+    """Freeze a trained linker into a :class:`LinkageIndex`.
+
+    Uses the linker's current parameters (post ``estimate_parameters`` /
+    loaded model) and its encoded input table as the reference corpus.
+    ``clear_caches`` releases the per-table blocking key-code caches on
+    completion: the bucket build runs through the same ``_key_codes`` cache
+    blocking uses, and an index build holds its encoded table long-lived —
+    without the release every cached key tuple (8 bytes/row each) would
+    pin host RAM for the artifact's lifetime.
+    """
+    import jax.numpy as jnp
+
+    settings = linker.settings
+    table = linker._ensure_encoded()
+    if table.n_rows == 0:
+        raise ValueError("cannot build a serving index over an empty table")
+    rules_text = settings.get("blocking_rules") or []
+    if not rules_text:
+        raise ValueError(
+            "online serving requires at least one blocking rule (a keyless "
+            "cartesian scan per query does not serve at low latency)"
+        )
+    try:
+        dtype_np = linker._float_dtype
+        float_dtype = jnp.float64 if dtype_np == np.float64 else jnp.float32
+        lam, m, u, _ = linker.params.to_arrays(dtype=dtype_np)
+
+        packed, layout = pack_table(
+            table,
+            float_dtype,
+            include=comparison_columns_used(settings),
+            qgram_specs=qgram_specs_for(settings),
+            charset_specs=charset_specs_for(settings),
+            jw_specs=(),
+        )
+        include = comparison_columns_used(settings)
+        string_cols = [
+            n for n in table.strings if include is None or n in include
+        ]
+        numeric_cols = [
+            n for n in table.numerics if include is None or n in include
+        ]
+        string_meta = {}
+        for name in string_cols:
+            sc = table.strings[name]
+            string_meta[name] = {
+                "width": int(sc.width),
+                "kind": "ascii" if sc.bytes_.dtype == np.uint8 else "wide",
+                "vocab": _string_vocab(sc),
+            }
+
+        rules = [
+            _build_serve_rule(table, rule) for rule in rules_text
+        ]
+
+        from ..term_frequencies import term_frequency_columns
+
+        tf_tables = {}
+        for name in term_frequency_columns(settings):
+            sc = table.strings.get(name)
+            if sc is not None and sc.n_tokens:
+                tids = sc.token_ids
+                tf_tables[name] = np.bincount(
+                    tids[tids >= 0], minlength=sc.n_tokens
+                ).astype(np.int64)
+        if tf_tables:
+            import warnings
+
+            warnings.warn(
+                "settings flag term_frequency_adjustments on "
+                f"{sorted(tf_tables)} but online serving returns "
+                "UNADJUSTED match probabilities (the Fellegi-Sunter score "
+                "only); the per-token count tables ride in the artifact "
+                "(index.tf_tables) for downstream re-ranking."
+            )
+
+        state_hash = settings_state_hash(
+            settings,
+            extra={"artifact": "linkage_index", "n_rows": int(table.n_rows)},
+        )
+        return LinkageIndex(
+            settings=settings,
+            dtype=np.dtype(dtype_np).name,
+            lam=float(lam),
+            m=np.asarray(m, np.float64),
+            u=np.asarray(u, np.float64),
+            packed=packed,
+            layout=layout,
+            string_cols=string_cols,
+            numeric_cols=numeric_cols,
+            string_meta=string_meta,
+            rules=rules,
+            unique_id=np.asarray(table.unique_id),
+            tf_tables=tf_tables,
+            state_hash=state_hash,
+        )
+    finally:
+        if clear_caches:
+            # the bucket build warmed the per-table key-code caches (one
+            # int64 array per key tuple); the index keeps its own compact
+            # CSR copies, so the caches must not outlive the build
+            clear_key_code_cache(table)
+
+
+def _build_serve_rule(table: EncodedTable, rule: str) -> ServeRule:
+    """One rule's frozen bucket index from the same key codes blocking
+    joins on."""
+    key_cols = _rule_key_cols(rule)
+    codes = _key_codes(table, key_cols)
+    n = table.n_rows
+    rows = np.flatnonzero(codes >= 0).astype(np.int32)
+    rows_sorted, uniq_codes, starts, sizes = _sort_groups(codes, rows)
+    n_buckets = len(uniq_codes)
+    if n_buckets == 0:
+        # every key null: empty dict, 1-element dummy CSR so device
+        # gathers stay in bounds (qbucket is always -1)
+        return ServeRule(
+            rule=rule,
+            key_cols=key_cols,
+            rows_sorted=np.zeros(1, np.int32),
+            starts=np.zeros(1, np.int32),
+            sizes=np.zeros(1, np.int32),
+            row_bucket=np.full(n, -1, np.int32),
+        )
+    row_bucket = np.full(n, -1, np.int32)
+    row_bucket[rows_sorted] = np.repeat(
+        np.arange(n_buckets, dtype=np.int32), sizes
+    )
+    # host-side key -> bucket dictionary from one representative row per
+    # bucket, via the same canonicalisation queries resolve through
+    reps = rows_sorted[starts]
+    col_tokens = [_canonical_key_values(table, c) for c in key_cols]
+    bucket_of: dict[str, int] = {}
+    for b, rep in enumerate(reps):
+        tokens = [t[rep] for t in col_tokens]
+        if any(tok is None for tok in tokens):  # pragma: no cover - codes>=0
+            continue
+        key = _KEY_SEP.join(tokens)
+        if key in bucket_of:
+            raise ValueError(
+                f"blocking rule {rule!r}: two key groups canonicalise to "
+                f"the same serving key {key!r}; this key type cannot be "
+                "indexed for online serving"
+            )
+        bucket_of[key] = b
+    return ServeRule(
+        rule=rule,
+        key_cols=key_cols,
+        rows_sorted=rows_sorted.astype(np.int32),
+        starts=starts.astype(np.int32),
+        sizes=sizes.astype(np.int32),
+        row_bucket=row_bucket,
+        bucket_of=bucket_of,
+    )
+
+
+def _layout_rebuild_table(index: LinkageIndex) -> EncodedTable:
+    """A zero-row EncodedTable with the index's column structure, enough
+    for pack_table to reproduce the lane layout deterministically."""
+    table = EncodedTable(n_rows=0, unique_id=np.zeros(0, np.int64))
+    for name in index.string_cols:
+        meta = index.string_meta[name]
+        w = int(meta["width"])
+        dt = np.uint8 if meta["kind"] == "ascii" else np.uint32
+        table.strings[name] = EncodedStringColumn(
+            bytes_=np.zeros((0, w), dt),
+            lengths=np.zeros(0, np.int32),
+            token_ids=np.zeros(0, np.int32),
+            null_mask=np.zeros(0, bool),
+            values=np.zeros(0, object),
+            width=w,
+        )
+    from ..data import EncodedNumericColumn
+
+    for name in index.numeric_cols:
+        table.numerics[name] = EncodedNumericColumn(
+            values_f64=np.zeros(0, np.float64),
+            null_mask=np.zeros(0, bool),
+            values=np.zeros(0, object),
+        )
+    return table
+
+
+def _attach_rebuilt_layout(index: LinkageIndex) -> LinkageIndex:
+    import jax.numpy as jnp
+
+    settings = index.settings
+    float_dtype = jnp.float64 if index.dtype == "float64" else jnp.float32
+    probe, layout = pack_table(
+        _layout_rebuild_table(index),
+        float_dtype,
+        include=comparison_columns_used(settings),
+        qgram_specs=qgram_specs_for(settings),
+        charset_specs=charset_specs_for(settings),
+        jw_specs=(),
+    )
+    if probe.shape[1] != index.n_lanes:
+        raise IndexMismatchError(
+            f"rebuilt layout has {probe.shape[1]} lanes but the stored "
+            f"packed matrix has {index.n_lanes}; the artifact does not "
+            "match this build's packing"
+        )
+    index.layout = layout
+    return index
+
+
+# bound as a method so load_index can chain it
+LinkageIndex._rebuild_layout = _attach_rebuilt_layout

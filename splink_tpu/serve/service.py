@@ -1,0 +1,260 @@
+"""Micro-batching front-end: single queries in, coalesced device batches out.
+
+Accelerators amortise dispatch over batches; online traffic arrives one
+record at a time. :class:`LinkageService` bridges the two with the classic
+micro-batching loop: ``submit`` enqueues a record and returns a future, a
+worker thread coalesces everything queued within ``deadline_ms`` of the
+FIRST waiting record (or until a full largest query bucket accumulates,
+whichever comes first) into one engine dispatch, and each future resolves
+with its record's matches.
+
+Admission control is a bounded queue that SHEDS instead of OOMing: when
+``queue_depth`` records are already waiting, ``submit`` resolves the future
+immediately with ``shed=True`` and emits the structured degradation record
+(``logging_utils.warn_degraded`` — the same channel the offline degradation
+ladder uses), so overload is a measured, observable state rather than a
+crash. Nothing raises on the submit path.
+
+Per-request latency (enqueue -> result set) feeds a bounded reservoir;
+:meth:`latency_summary` reports p50/p95/p99 and throughput, and with a
+telemetry ``RunContext`` the summary lands in the run record (``python -m
+splink_tpu.obs summarize`` renders it) alongside per-batch ``serve_batch``
+spans.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..utils.logging_utils import warn_degraded
+
+logger = logging.getLogger("splink_tpu")
+
+_LATENCY_RESERVOIR = 65536  # newest-N latency samples kept for percentiles
+
+
+@dataclass
+class QueryResult:
+    """One query's outcome."""
+
+    matches: list = field(default_factory=list)  # [(ref_uid, probability)]
+    n_candidates: int = 0
+    shed: bool = False
+    latency_ms: float | None = None
+
+
+class LinkageService:
+    """Micro-batching query front-end over a :class:`~.engine.QueryEngine`
+    (module docstring)."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        queue_depth: int | None = None,
+        deadline_ms: float | None = None,
+        autostart: bool = True,
+        telemetry=None,
+    ):
+        settings = engine.index.settings
+        self.engine = engine
+        self.queue_depth = int(
+            queue_depth
+            if queue_depth is not None
+            else settings.get("serve_queue_depth", 1024) or 1024
+        )
+        self.deadline_ms = float(
+            deadline_ms
+            if deadline_ms is not None
+            else settings.get("serve_deadline_ms", 5.0)
+        )
+        self._obs = telemetry
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._queue: deque = deque()  # (record, future, t_enqueue)
+        self._latencies: deque = deque(maxlen=_LATENCY_RESERVOIR)
+        self._shed_count = 0
+        self._served = 0
+        self._batches = 0
+        self._t_start = time.monotonic()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        if autostart:
+            self.start()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "LinkageService":
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._worker, name="splink-serve", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker. With ``drain`` (default) queued requests are
+        served first; otherwise they resolve shed."""
+        with self._nonempty:
+            self._stop = True
+            if not drain:
+                while self._queue:
+                    _, fut, _ = self._queue.popleft()
+                    self._shed_count += 1
+                    fut.set_result(QueryResult(shed=True))
+            self._nonempty.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        # a submit racing the shutdown can enqueue after the worker's last
+        # batch; resolve any stragglers shed so no future hangs forever
+        with self._nonempty:
+            while self._queue:
+                _, fut, _ = self._queue.popleft()
+                self._shed_count += 1
+                if not fut.done():
+                    fut.set_result(QueryResult(shed=True))
+        if self._obs is not None:
+            self._obs.record("serve_latency", self.latency_summary())
+
+    def __enter__(self) -> "LinkageService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, record: dict) -> Future:
+        """Enqueue one query record; never raises. Over ``queue_depth``
+        waiting records — or after :meth:`close` (no worker will ever
+        drain the queue again) — the request is shed: the future resolves
+        immediately with ``shed=True`` and a degradation event is
+        emitted."""
+        fut: Future = Future()
+        with self._nonempty:
+            closed = self._stop and self._thread is None
+            if closed or len(self._queue) >= self.queue_depth:
+                self._shed_count += 1
+                shed_total = self._shed_count
+                fut.set_result(QueryResult(shed=True))
+                reason = (
+                    "service is closed; submissions resolve shed"
+                    if closed
+                    else f"bounded queue full ({self.queue_depth} waiting); "
+                    "shedding instead of growing without bound"
+                )
+            else:
+                self._queue.append((record, fut, time.monotonic()))
+                self._nonempty.notify()
+                return fut
+        # outside the lock: warn_degraded publishes + warns, both of which
+        # may run user hooks
+        warn_degraded("serve_queue", "shed", reason, shed_total=shed_total)
+        return fut
+
+    def query(self, record: dict, timeout: float | None = None) -> QueryResult:
+        """Submit one record and wait for its result."""
+        return self.submit(record).result(timeout=timeout)
+
+    # -- worker ---------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self._serve_batch(batch)
+
+    def _take_batch(self):
+        """Block until work exists, then coalesce until the deadline (from
+        the FIRST waiting record) or a full largest bucket."""
+        max_batch = self.engine.policy.max_batch
+        with self._nonempty:
+            while not self._queue:
+                if self._stop:
+                    return None
+                self._nonempty.wait(timeout=0.1)
+            deadline = self._queue[0][2] + self.deadline_ms / 1000.0
+            while len(self._queue) < max_batch and not self._stop:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._nonempty.wait(timeout=remaining)
+            take = min(len(self._queue), max_batch)
+            return [self._queue.popleft() for _ in range(take)]
+
+    def _serve_batch(self, batch) -> None:
+        import pandas as pd
+
+        records = [b[0] for b in batch]
+        futures = [b[1] for b in batch]
+        t_enq = [b[2] for b in batch]
+        try:
+            df = pd.DataFrame.from_records(records)
+            if self._obs is not None:
+                with self._obs.span("serve_batch", batch=len(batch)):
+                    results = self._score(df)
+            else:
+                results = self._score(df)
+        except Exception as e:  # noqa: BLE001 - one bad batch must not kill the loop
+            logger.exception("serve batch failed")
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        now = time.monotonic()
+        self._batches += 1
+        for i, fut in enumerate(futures):
+            res = results[i]
+            res.latency_ms = (now - t_enq[i]) * 1000.0
+            self._latencies.append(res.latency_ms)
+            self._served += 1
+            if self._obs is not None:
+                self._obs.observe("serve_latency_ms", res.latency_ms)
+            if not fut.done():
+                fut.set_result(res)
+
+    def _score(self, df) -> list[QueryResult]:
+        top_p, top_rows, top_valid, n_cand = self.engine.query_arrays(df)
+        uids = self.engine.index.unique_id
+        out = []
+        for i in range(len(df)):
+            matches = [
+                (uids[top_rows[i, r]], float(top_p[i, r]))
+                for r in range(top_p.shape[1])
+                if top_valid[i, r]
+            ]
+            out.append(
+                QueryResult(matches=matches, n_candidates=int(n_cand[i]))
+            )
+        return out
+
+    # -- reporting ------------------------------------------------------
+
+    def latency_summary(self) -> dict:
+        """p50/p95/p99 request latency (ms), counts and throughput over the
+        service's lifetime."""
+        lats = np.asarray(self._latencies, np.float64)
+        elapsed = max(time.monotonic() - self._t_start, 1e-9)
+        out = {
+            "served": self._served,
+            "shed": self._shed_count,
+            "batches": self._batches,
+            "queries_per_sec": self._served / elapsed,
+        }
+        if len(lats):
+            p50, p95, p99 = np.percentile(lats, [50, 95, 99])
+            out.update(
+                p50_ms=float(p50), p95_ms=float(p95), p99_ms=float(p99),
+                mean_ms=float(lats.mean()),
+            )
+        return out

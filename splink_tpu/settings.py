@@ -68,6 +68,11 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "checkpoint_dir",
     "checkpoint_interval",
     "fault_plan",
+    "serve_query_buckets",
+    "serve_candidate_buckets",
+    "serve_queue_depth",
+    "serve_deadline_ms",
+    "serve_top_k",
 ]
 
 

@@ -1,0 +1,377 @@
+"""Online serving (splink_tpu/serve/): serve<->offline score parity,
+bucketed compile-cache behaviour, micro-batching admission control, artifact
+durability, and the key-code cache-release regression.
+
+The parity contract is BIT-identity: for every (query record, reference
+record) pair the engine returns, the match probability must equal
+``get_scored_comparisons`` on the same pair exactly — the serving path
+re-encodes the query side against the reference vocabulary and runs the
+same comparison kernels, so any drift is a bug, not tolerance noise.
+"""
+
+import warnings
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu import Splink
+from splink_tpu.serve import (
+    BucketPolicy,
+    IndexMismatchError,
+    LinkageService,
+    QueryEngine,
+    build_index,
+    load_index,
+)
+from splink_tpu.utils.logging_utils import DegradationWarning
+
+
+def people_df(n=120, seed=11):
+    rng = np.random.default_rng(seed)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    return pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+
+
+def serve_settings(**over):
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.surname = r.surname"],
+        "max_iterations": 6,
+    }
+    s.update(over)
+    return s
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """(df, linker, df_e, index): one trained linker + frozen index shared
+    across the module (training dominates the suite's cost)."""
+    df = people_df()
+    linker = Splink(serve_settings(), df=df)
+    df_e = linker.get_scored_comparisons()
+    index = linker.export_index()
+    return df, linker, df_e, index
+
+
+@pytest.fixture(scope="module")
+def engine(trained):
+    _, _, _, index = trained
+    eng = QueryEngine(
+        index, top_k=64, policy=BucketPolicy((16, 128), (64, 256))
+    )
+    eng.warmup()
+    return eng
+
+
+def _offline_scores(df_e):
+    return {
+        (r["unique_id_l"], r["unique_id_r"]): r["match_probability"]
+        for _, r in df_e.iterrows()
+    }
+
+
+def test_serve_offline_parity_bit_identical(trained, engine):
+    """Every served (query, match) score equals the offline score for the
+    same pair bitwise, and the served candidate sets cover EVERY offline
+    pair (top_k exceeds the largest block, so nothing is cut off)."""
+    df, _, df_e, index = trained
+    offline = _offline_scores(df_e)
+    top_p, top_rows, top_valid, n_cand = engine.query_arrays(df)
+    assert top_p.dtype == np.float32
+    served = set()
+    checked = 0
+    for q in range(len(df)):
+        for r in range(top_p.shape[1]):
+            if not top_valid[q, r]:
+                continue
+            m = int(index.unique_id[top_rows[q, r]])
+            if m == q:
+                continue  # self-match: not an offline pair (uid ordering)
+            key = (min(q, m), max(q, m))
+            assert key in offline, f"served pair {key} missing offline"
+            assert np.float32(offline[key]) == top_p[q, r], key
+            served.add(key)
+            checked += 1
+    assert checked > 100
+    assert served == set(offline), "serve must cover every offline pair"
+
+
+def test_serve_parity_float64_tier():
+    """The float64 tier holds the same bit-identity (the engine runs the
+    index's recorded dtype end to end)."""
+    df = people_df(60, seed=3)
+    linker = Splink(serve_settings(float64=True, max_iterations=3), df=df)
+    df_e = linker.get_scored_comparisons()
+    index = linker.export_index()
+    assert index.dtype == "float64"
+    eng = QueryEngine(index, top_k=64, policy=BucketPolicy((64,), (128,)))
+    offline = _offline_scores(df_e)
+    top_p, top_rows, top_valid, _ = eng.query_arrays(df)
+    assert top_p.dtype == np.float64
+    checked = 0
+    for q in range(len(df)):
+        for r in range(top_p.shape[1]):
+            if not top_valid[q, r]:
+                continue
+            m = int(index.unique_id[top_rows[q, r]])
+            if m == q:
+                continue
+            checked += 1
+            assert offline[(min(q, m), max(q, m))] == top_p[q, r]
+    assert checked > 50
+
+
+def test_self_match_scores_highest(trained, engine):
+    """A query identical to a reference record must retrieve that record
+    at (joint-)top rank — the entity-lookup sanity check."""
+    df, _, _, index = trained
+    top_p, top_rows, top_valid, _ = engine.query_arrays(df.head(20))
+    for q in range(20):
+        ranks = [
+            r
+            for r in range(top_p.shape[1])
+            if top_valid[q, r] and int(index.unique_id[top_rows[q, r]]) == q
+        ]
+        assert ranks, f"query {q} did not retrieve itself"
+        assert top_p[q, ranks[0]] == top_p[q, 0]  # ties share the top score
+
+
+def test_warmup_compiles_once_per_bucket_combo(trained):
+    """Compile count == number of distinct (query, candidate) bucket
+    combinations after warmup, and steady-state serving (any bucketed
+    batch size) performs ZERO recompiles — measured by the jax.monitoring
+    compile counter."""
+    from splink_tpu.obs.metrics import compile_totals
+
+    df, _, _, index = trained
+    policy = BucketPolicy((8, 32), (64, 128))
+    eng = QueryEngine(index, top_k=8, policy=policy)
+    stats = eng.warmup()
+    assert stats["combinations"] == 4
+    assert stats["compiles"] == 4
+    c0, _ = compile_totals()
+    eng.query_arrays(df.head(3))
+    eng.query_arrays(df.head(30))
+    eng.query_arrays(df.head(70))  # > largest bucket: splits into chunks
+    c1, _ = compile_totals()
+    assert c1 - c0 == 0, "steady-state serving must not recompile"
+    assert eng.warmed_shapes == {(8, 64), (8, 128), (32, 64), (32, 128)}
+
+
+def test_large_batch_splits_into_bucket_chunks(trained, engine):
+    """A batch beyond the largest query bucket chunks internally and the
+    results equal the per-chunk results row for row."""
+    df, _, _, _ = trained
+    whole = engine.query_arrays(df)
+    head = engine.query_arrays(df.head(50))
+    for a, b in zip(whole, head):
+        assert np.array_equal(a[:50], b)
+
+
+def test_unseen_and_null_query_values(trained, engine):
+    """Unseen names score through the kernels (fresh token ids); a null
+    blocking key yields no candidates rather than an error."""
+    df, _, _, _ = trained
+    q = pd.DataFrame(
+        {
+            "unique_id": [0, 1],
+            "first_name": ["zzyzx", None],
+            "surname": [df["surname"][0], None],
+            "dob": [df["dob"][0], None],
+        }
+    )
+    top_p, top_rows, top_valid, n_cand = engine.query_arrays(q)
+    assert n_cand[0] > 0  # dob+surname blocks still resolve
+    assert n_cand[1] == 0 and not top_valid[1].any()
+
+
+def test_key_code_cache_released_after_build(trained):
+    """build_index runs through blocking's per-table key-code cache but
+    must release it on completion: an index build holds the encoded table
+    long-lived, and each cached key tuple is 8 bytes/row of host RAM.
+    Building twice must not grow the cache either."""
+    df, linker, _, _ = trained
+    table = linker._ensure_encoded()
+    for _ in range(2):
+        build_index(linker)
+        assert not getattr(table, "_key_code_cache", None)
+        assert not getattr(table, "_asym_code_cache", None)
+
+
+def test_index_save_load_roundtrip(tmp_path, trained, engine):
+    """Scores from a loaded artifact are identical to the in-memory index;
+    a tampered artifact is rejected, never served."""
+    df, linker, _, _ = trained
+    path = tmp_path / "idx"
+    linker.export_index(path)
+    index2 = load_index(path)
+    eng2 = QueryEngine(
+        index2, top_k=64, policy=BucketPolicy((16, 128), (64, 256))
+    )
+    a = engine.query_arrays(df.head(40))
+    b = eng2.query_arrays(df.head(40))
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+    # tamper with the committed meta -> hash binding rejects it
+    import json
+
+    meta_path = path / "linkage_index.json"
+    meta = json.loads(meta_path.read_text())
+    meta["n_rows"] = meta["n_rows"] + 1
+    meta_path.write_text(json.dumps(meta))
+    with pytest.raises(IndexMismatchError):
+        load_index(path)
+
+
+def test_short_candidate_rows_never_emit_sentinel_matches(trained):
+    """A query with fewer valid candidates than top_k must report ONLY its
+    real candidates: the re-picked mask-sentinel slots are flagged invalid
+    (they previously leaked as duplicate matches scored -2.0)."""
+    df, _, _, index = trained
+    eng = QueryEngine(index, top_k=64, policy=BucketPolicy((16,), (64,)))
+    top_p, top_rows, top_valid, n_cand = eng.query_arrays(df.head(16))
+    for q in range(16):
+        assert int(top_valid[q].sum()) == min(int(n_cand[q]), 64)
+        assert (top_p[q][top_valid[q]] >= 0).all()
+        rows = top_rows[q][top_valid[q]]
+        assert len(np.unique(rows)) == len(rows), "duplicate match rows"
+
+
+def test_top_k_capacity_validated(trained):
+    """top_k beyond the largest candidate bucket cannot produce truncated
+    nonsense — the engine rejects the configuration up front."""
+    _, _, _, index = trained
+    with pytest.raises(ValueError, match="serve_top_k"):
+        QueryEngine(index, top_k=128, policy=BucketPolicy((16,), (64,)))
+
+
+def test_submit_after_close_sheds_not_hangs(trained, engine):
+    """A closed service must never hand out a future nobody will resolve:
+    post-close submissions resolve immediately as shed, with the
+    degradation event."""
+    svc = LinkageService(engine, deadline_ms=1.0)
+    svc.close()
+    with pytest.warns(DegradationWarning, match="closed"):
+        fut = svc.submit({"unique_id": 0, "first_name": "ava",
+                          "surname": "smith", "dob": "1950"})
+    assert fut.result(timeout=5).shed
+
+
+def test_save_over_existing_index_is_crash_safe(tmp_path, trained):
+    """Re-saving over a live artifact must leave the OLD artifact loadable
+    at every intermediate point: the new arrays land in a fresh
+    fingerprint-named file and the meta commit flips atomically."""
+    import json
+
+    df, linker, _, _ = trained
+    path = tmp_path / "idx"
+    linker.export_index(path)
+    meta1 = json.loads((path / "linkage_index.json").read_text())
+    # simulate the crash window: new arrays written, meta NOT yet
+    # committed — the old meta must still load against the old arrays
+    (path / "linkage_index-deadbeefdeadbeef.npz").write_bytes(b"garbage")
+    index = load_index(path)
+    assert index.n_rows == meta1["n_rows"]
+    # a full re-save commits and sweeps the stray arrays file
+    linker.export_index(path)
+    leftovers = [p.name for p in path.iterdir() if p.suffix == ".npz"]
+    meta2 = json.loads((path / "linkage_index.json").read_text())
+    assert leftovers == [meta2["arrays_file"]]
+    load_index(path)
+
+
+def test_unsupported_blocking_rules_rejected():
+    """Residual predicates and cartesian rules cannot be served; the build
+    fails loudly instead of serving wrong candidates."""
+    df = people_df(20)
+    linker = Splink(
+        serve_settings(
+            blocking_rules=["l.dob = r.dob and l.unique_id + 1 < r.unique_id"]
+        ),
+        df=df,
+    )
+    with pytest.raises(ValueError, match="residual"):
+        build_index(linker)
+    with pytest.warns(UserWarning, match="blocking"):
+        linker2 = Splink(serve_settings(blocking_rules=[]), df=df)
+    with pytest.raises(ValueError, match="blocking rule"):
+        build_index(linker2)
+
+
+def test_service_micro_batching_end_to_end(trained, engine):
+    """Submitted records coalesce into batches, every future resolves with
+    its matches, and the latency summary reports percentiles."""
+    df, _, df_e, _ = trained
+    offline = _offline_scores(df_e)
+    records = df.head(30).to_dict(orient="records")
+    with LinkageService(engine, deadline_ms=20.0, queue_depth=64) as svc:
+        futures = [svc.submit(r) for r in records]
+        results = [f.result(timeout=30) for f in futures]
+        summary = svc.latency_summary()
+    assert all(not r.shed for r in results)
+    assert summary["served"] == 30 and summary["shed"] == 0
+    assert summary["p50_ms"] > 0 and summary["p99_ms"] >= summary["p50_ms"]
+    # spot-check one served score against the offline frame, bit-identical
+    for rec, res in zip(records, results):
+        for uid, p in res.matches:
+            if uid == rec["unique_id"]:
+                continue
+            key = (min(rec["unique_id"], uid), max(rec["unique_id"], uid))
+            assert np.float32(offline[key]) == np.float32(p)
+
+
+def test_overload_sheds_with_degradation_event(trained, engine):
+    """Admission control: a full bounded queue sheds load through the
+    structured degradation channel — submit never raises, the shed future
+    resolves immediately with shed=True, and both the DegradationWarning
+    and the telemetry event fire."""
+    from splink_tpu.obs import events
+
+    captured = []
+
+    class _Sink:
+        def emit(self, kind, **fields):
+            captured.append((kind, fields))
+
+    sink = _Sink()
+    events.register_ambient(sink)
+    try:
+        svc = LinkageService(
+            engine, queue_depth=2, deadline_ms=50.0, autostart=False
+        )
+        record = {"unique_id": 0, "first_name": "ava", "surname": "smith",
+                  "dob": "1950"}
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            futures = [svc.submit(dict(record)) for _ in range(5)]
+        shed = [f for f in futures if f.done() and f.result().shed]
+        assert len(shed) == 3  # queue_depth=2 admitted two
+        degr = [w for w in caught if issubclass(w.category, DegradationWarning)]
+        assert len(degr) == 3
+        assert any(k == "degradation" for k, _ in captured)
+        # the two admitted requests still serve once the worker starts
+        svc.start()
+        pending = [f for f in futures if f not in shed]
+        for f in pending:
+            res = f.result(timeout=30)
+            assert not res.shed and res.n_candidates >= 1
+        svc.close()
+    finally:
+        events.unregister_ambient(sink)

@@ -190,6 +190,58 @@ def test_observability_keys_validate_types():
     )
 
 
+def test_serve_defaults_filled():
+    """The online-serving keys complete from the schema (the schema is the
+    single source of truth for their defaults)."""
+    s = complete_settings_dict(_minimal())
+    assert s["serve_query_buckets"] == [16, 128, 1024]
+    assert s["serve_candidate_buckets"] == [32, 256, 2048]
+    assert s["serve_queue_depth"] == 1024
+    assert s["serve_deadline_ms"] == 5
+    assert s["serve_top_k"] == 5
+
+
+def test_serve_keys_validate_types():
+    """Schema validation rejects wrongly-typed serve keys and accepts
+    correctly-typed ones."""
+    for bad in (
+        {"serve_query_buckets": 16},
+        {"serve_query_buckets": ["x"]},
+        {"serve_candidate_buckets": "big"},
+        {"serve_queue_depth": "deep"},
+        {"serve_queue_depth": 0},
+        {"serve_deadline_ms": "soon"},
+        {"serve_top_k": 0},
+        {"serve_top_k": [5]},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    validate_settings(
+        _minimal(
+            serve_query_buckets=[8, 64],
+            serve_candidate_buckets=[16, 512],
+            serve_queue_depth=64,
+            serve_deadline_ms=1.5,
+            serve_top_k=3,
+        )
+    )
+
+
+def test_serve_bucket_policy_reads_settings():
+    """BucketPolicy.from_settings consumes the completed keys and rejects
+    non-power-of-two or unsorted bucket lists."""
+    from splink_tpu.serve.bucketing import BucketPolicy
+
+    s = complete_settings_dict(_minimal())
+    policy = BucketPolicy.from_settings(s)
+    assert policy.query_buckets == (16, 128, 1024)
+    assert policy.candidate_buckets == (32, 256, 2048)
+    with pytest.raises(ValueError, match="powers of two"):
+        BucketPolicy.from_settings({**s, "serve_query_buckets": [12]})
+    with pytest.raises(ValueError, match="ascending"):
+        BucketPolicy.from_settings({**s, "serve_candidate_buckets": [64, 32]})
+
+
 def test_telemetry_settings_flow_into_run_context(tmp_path):
     """telemetry_dir turns the linker's RunContext on; telemetry_memory
     flows through; no telemetry_dir -> disabled context."""
