@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -41,6 +41,16 @@ obs-smoke:
 serve-smoke:
 	python scripts/serve_smoke.py
 
+# Chaos smoke: run the service under EVERY registered serve fault site
+# (worker death, batch exception, slow batch, breaker storm, index
+# corruption, swap-validation failure — resilience/faults.py SERVE_SITES)
+# and assert the resilience contract: no future hangs past its timeout, no
+# exception escapes to a caller, fault/degradation events land in the
+# JSONL sink, throughput recovers after each fault, and a hot-swap +
+# brown-out episode stay recompile-free (docs/serving.md#resilience).
+chaos-smoke:
+	python scripts/chaos_smoke.py
+
 bench:
 	python bench.py
 
@@ -48,4 +58,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke serve-smoke bench
+all: lint test tpu-smoke serve-smoke chaos-smoke bench

@@ -1,0 +1,359 @@
+"""Chaos smoke (`make chaos-smoke`): the serving tier under injected faults.
+
+Drives a live LinkageService through EVERY registered serve fault site
+(resilience/faults.py SERVE_SITES) plus the hot-swap failure modes, and
+asserts the resilience contract end to end on every scenario:
+
+  1. no future ever hangs past its timeout (every submit resolves);
+  2. no exception escapes to a caller through a future;
+  3. the structured fault/degradation events land in the JSONL sink;
+  4. post-fault throughput recovers (a follow-up wave serves non-shed).
+
+Scenarios:
+
+  A  worker-thread death      -> watchdog sheds orphans, restarts, recovers
+  B  batch-scoring exception  -> batch sheds (reason batch_error), recovers
+  C  slow batch               -> query(timeout=) cancels + sheds, recovers
+  D  breaker storm            -> opens after N failures, fails fast, the
+                                 watchdog probe closes it, recovers
+  E  brown-out episode        -> pressure serves budgeted degraded answers,
+                                 ZERO recompiles (shapes pre-warmed)
+  F  index hot-swap (valid)   -> parity probes pass, in-flight requests
+                                 drain on the old index, post-swap scores
+                                 bit-identical to offline on the new index,
+                                 ZERO steady-state recompiles after the swap
+  G  corrupted candidate      -> load rejects, swap rolls back, old index
+                                 still serving
+  H  swap-validation fault    -> injected validation failure rolls back
+  I  parity-failing candidate -> different reference content fails the
+                                 probe replay, rolls back; refresh_probes
+                                 commits the intentional change
+
+Exits nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WAVE_TIMEOUT_S = 60  # generous: the contract is "never hangs", not "fast"
+
+
+def _settings():
+    return {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.surname = r.surname"],
+        "max_iterations": 4,
+        "serve_top_k": 64,
+        "serve_query_buckets": [16, 128],
+        "serve_candidate_buckets": [64, 256],
+        "serve_deadline_ms": 2,
+        "serve_brownout_top_k": 2,
+        "serve_breaker_threshold": 2,
+        "serve_probe_queries": 8,
+        "serve_queue_depth": 256,
+    }
+
+
+def _corpus(n=200, seed=7):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    return pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+
+
+def _drive(svc, records, timeout=WAVE_TIMEOUT_S):
+    """Submit a wave and wait for EVERY future: a hang or an escaping
+    exception here is a contract violation (the assertions this whole
+    script exists for)."""
+    futures = [svc.submit(dict(r)) for r in records]
+    results = []
+    for f in futures:
+        results.append(f.result(timeout=timeout))  # raises on hang; must not
+    return results
+
+
+def _assert_serves(svc, records, what):
+    results = _drive(svc, records)
+    shed = [r for r in results if r.shed]
+    assert not shed, f"{what}: {len(shed)}/{len(results)} shed ({shed[0].reason})"
+    return results
+
+
+def _fresh_service(engine, **over):
+    from splink_tpu.serve import LinkageService
+
+    kw = dict(deadline_ms=2.0, watchdog_interval_s=0.05,
+              breaker_cooldown_s=0.3)
+    kw.update(over)
+    return LinkageService(engine, **kw)
+
+
+def _set_plan(spec):
+    from splink_tpu.resilience import faults
+
+    faults.reset_plans()
+    if spec:
+        os.environ[faults.ENV_VAR] = spec
+    else:
+        os.environ.pop(faults.ENV_VAR, None)
+
+
+def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
+    import warnings
+
+    import numpy as np
+
+    from splink_tpu import Splink
+    from splink_tpu.obs.events import EventSink, read_events, register_ambient
+    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.serve import (
+        IndexSwapError,
+        QueryEngine,
+        build_index,
+        load_index,
+    )
+
+    install_compile_monitor()
+    warnings.simplefilter("ignore")  # degradations are asserted via events
+    tmp = tempfile.mkdtemp(prefix="splink_chaos_")
+    events_path = os.path.join(tmp, "chaos_events.jsonl")
+    sink = EventSink(events_path, run_id="chaos-smoke")
+    register_ambient(sink)
+
+    df = _corpus()
+    linker = Splink(_settings(), df=df)
+    df_e = linker.get_scored_comparisons()
+    offline = {
+        (r["unique_id_l"], r["unique_id_r"]): np.float32(r["match_probability"])
+        for _, r in df_e.iterrows()
+    }
+    idx_v1 = os.path.join(tmp, "idx_v1")
+    idx_v2 = os.path.join(tmp, "idx_v2")
+    linker.export_index(idx_v1)
+    linker.export_index(idx_v2)  # same content: the valid-swap candidate
+
+    engine = QueryEngine(load_index(idx_v1))
+    warm = engine.warmup()
+    records = df.head(100).to_dict(orient="records")
+    wave = records[:20]
+
+    # ---- A: worker-thread death -> watchdog recovery --------------------
+    _set_plan("serve_worker@batch=1")
+    svc = _fresh_service(engine)
+    _assert_serves(svc, wave, "A pre-fault")
+    t0 = time.monotonic()
+    results = _drive(svc, records)  # worker dies around this wave
+    assert time.monotonic() - t0 < WAVE_TIMEOUT_S
+    _assert_serves(svc, wave, "A recovery")
+    assert svc.latency_summary()["worker_crashes"] >= 1, (
+        "watchdog did not register the worker death"
+    )
+    svc.close()
+    print(f"chaos A ok: worker death -> {len(results)} futures resolved, "
+          f"{svc.latency_summary()['worker_crashes']} restart(s)")
+
+    # ---- B: batch-scoring exception -> shed, no escape ------------------
+    # autostart=False + pre-queued wave guarantees ONE deterministic batch
+    _set_plan("serve_batch@times=1")
+    svc = _fresh_service(engine, autostart=False)
+    futures = [svc.submit(dict(r)) for r in wave]
+    svc.start()
+    results = [f.result(timeout=WAVE_TIMEOUT_S) for f in futures]
+    assert all(r.shed and r.reason == "batch_error" for r in results), (
+        "B: faulted batch must shed with reason batch_error"
+    )
+    _assert_serves(svc, wave, "B recovery")
+    svc.close()
+    print("chaos B ok: batch exception shed cleanly, recovered")
+
+    # ---- C: slow batch -> query(timeout=) cancels + sheds ---------------
+    _set_plan("serve_batch@times=1:kind=slow:delay_ms=600")
+    svc = _fresh_service(engine, autostart=False)
+    futures = [svc.submit(dict(r)) for r in wave]  # the stalled batch
+    svc.start()
+    res = svc.query(dict(wave[0]), timeout=0.15)  # queued behind the stall
+    assert res.shed and res.reason == "timeout", (
+        f"C: expected timeout shed, got {res}"
+    )
+    stalled = [f.result(timeout=WAVE_TIMEOUT_S) for f in futures]
+    assert not any(r.shed for r in stalled), "C: the slow batch still serves"
+    _assert_serves(svc, wave, "C recovery")
+    assert svc.latency_summary()["timeouts"] == 1
+    svc.close()
+    print("chaos C ok: slow batch timed out, cancelled, recovered")
+
+    # ---- D: breaker storm -> open, fail fast, probe recovery ------------
+    _set_plan("serve_batch@times=2")  # threshold is 2 -> opens
+    svc = _fresh_service(engine, autostart=False)
+    futures = [svc.submit(dict(r)) for r in wave]
+    svc.start()
+    storm1 = [f.result(timeout=WAVE_TIMEOUT_S) for f in futures]
+    storm2 = _drive(svc, wave)
+    assert all(
+        r.shed and r.reason in ("batch_error", "breaker_open")
+        for r in storm1 + storm2
+    ), "D: storm batches must shed"
+    assert svc.breaker.state == "open", "D: breaker must open"
+    results = _drive(svc, wave)
+    assert all(r.shed and r.reason == "breaker_open" for r in results), (
+        "D: open breaker must fail fast with reason breaker_open"
+    )
+    deadline = time.monotonic() + 10
+    while svc.breaker.state != "closed" and time.monotonic() < deadline:
+        time.sleep(0.05)  # the watchdog probe closes it after the cooldown
+    assert svc.breaker.state == "closed", "D: watchdog probe never recovered"
+    _assert_serves(svc, wave, "D recovery")
+    svc.close()
+    print("chaos D ok: breaker opened, failed fast, probe recovered")
+
+    # ---- E: brown-out episode, zero recompiles --------------------------
+    _set_plan("")
+    svc = _fresh_service(engine, autostart=False, queue_depth=64)
+    futures = [svc.submit(dict(r)) for r in records[:60]]  # 94% full
+    c0, _ = compile_totals()
+    svc.start()
+    results = [f.result(timeout=WAVE_TIMEOUT_S) for f in futures]
+    c1, _ = compile_totals()
+    degraded = [r for r in results if r.degraded]
+    assert degraded, "E: pressure must engage the brown-out tier"
+    assert all(
+        len(r.matches) <= engine.brownout_top_k for r in degraded
+    ), "E: brown-out answers must honour the reduced top-k budget"
+    assert not any(r.shed for r in results), "E: brown-out must not shed"
+    assert c1 - c0 == 0, (
+        f"E: brown-out episode performed {c1 - c0} recompiles"
+    )
+    assert svc.latency_summary()["brownout_episodes"] >= 1
+    svc.close()
+    print(f"chaos E ok: {len(degraded)} degraded answers, 0 recompiles")
+
+    # ---- F: valid hot-swap under traffic --------------------------------
+    _set_plan("")
+    svc = _fresh_service(engine, probe_queries=8)
+    _assert_serves(svc, wave, "F probe capture")  # seeds the probe set
+    assert engine.probe_count == 8
+    futures = [svc.submit(dict(r)) for r in records]  # in-flight across swap
+    stats = svc.swap_index(idx_v2)
+    inflight = [f.result(timeout=WAVE_TIMEOUT_S) for f in futures]
+    assert not any(r.shed for r in inflight), (
+        "F: zero dropped in-flight requests across the swap"
+    )
+    assert stats["generation"] == 1 and stats["probes_checked"] == 8, stats
+    c0, _ = compile_totals()
+    post = _assert_serves(svc, records[:40], "F post-swap")
+    c1, _ = compile_totals()
+    assert c1 - c0 == 0, f"F: {c1 - c0} recompiles after the hot-swap"
+    checked = 0
+    for rec, r in zip(records[:40], post):
+        for uid, p in r.matches:
+            if uid == rec["unique_id"]:
+                continue
+            key = (min(rec["unique_id"], uid), max(rec["unique_id"], uid))
+            assert offline[key] == np.float32(p), (
+                f"F: post-swap parity violation on {key}"
+            )
+            checked += 1
+    assert checked > 50
+    print(f"chaos F ok: hot-swap committed, {checked} post-swap scores "
+          "bit-identical to offline, 0 steady-state recompiles")
+
+    # ---- G: corrupted candidate -> rollback -----------------------------
+    idx_bad = os.path.join(tmp, "idx_bad")
+    shutil.copytree(idx_v1, idx_bad)
+    for name in os.listdir(idx_bad):
+        if name.endswith(".npz"):
+            path = os.path.join(idx_bad, name)
+            payload = bytearray(open(path, "rb").read())
+            payload[len(payload) // 2] ^= 0xFF
+            open(path, "wb").write(bytes(payload))
+    gen = engine.generation
+    try:
+        svc.swap_index(idx_bad)
+        raise AssertionError("G: corrupted index must fail the swap")
+    except IndexSwapError:
+        pass
+    assert engine.generation == gen, "G: rollback must not bump generation"
+    _assert_serves(svc, wave, "G old index still serving")
+    print("chaos G ok: corrupted candidate rejected, old index serving")
+
+    # ---- H: injected swap-validation failure -> rollback ----------------
+    _set_plan("swap_validate@")
+    try:
+        svc.swap_index(idx_v2)
+        raise AssertionError("H: injected validation fault must roll back")
+    except IndexSwapError:
+        pass
+    _assert_serves(svc, wave, "H old index still serving")
+    print("chaos H ok: injected validation failure rolled back")
+
+    # ---- I: parity-failing candidate -> rollback, refresh commits -------
+    _set_plan("")
+    other = Splink(_settings(), df=df.head(150))  # different reference content
+    index_other = build_index(other)
+    try:
+        svc.swap_index(index_other)
+        raise AssertionError("I: parity-failing candidate must roll back")
+    except IndexSwapError as e:
+        assert "parity" in str(e), e
+    _assert_serves(svc, wave, "I old index still serving")
+    stats = svc.swap_index(index_other, refresh_probes=True)
+    assert stats["generation"] == gen + 1
+    results = _drive(svc, wave)
+    assert not any(r.shed for r in results), "I: post-refresh swap must serve"
+    svc.close()
+    print("chaos I ok: parity drift rolled back; refresh_probes committed")
+
+    # ---- the JSONL record must tell the whole story ---------------------
+    sink.close()
+    events = read_events(events_path)
+    fault_sites = {e.get("site") for e in events if e.get("type") == "fault"}
+    assert {"serve_worker", "serve_batch", "swap_validate"} <= fault_sites, (
+        f"missing fault events: {fault_sites}"
+    )
+    degr = [e for e in events if e.get("type") == "degradation"]
+    degr_from = {e.get("from") for e in degr}
+    for expected in ("serve_batch", "serve_timeout", "serve_breaker",
+                     "serve_brownout", "serve_index_swap", "serve_worker"):
+        assert expected in degr_from, (
+            f"missing degradation events from {expected}: {sorted(degr_from)}"
+        )
+    swaps = [e for e in events if e.get("type") == "index_swap"]
+    assert len(swaps) == 2, f"expected 2 committed swaps, saw {len(swaps)}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(
+        "chaos-smoke OK: 9 scenarios, every future resolved, no exception "
+        f"escaped, {len([e for e in events if e.get('type') == 'fault'])} "
+        f"fault + {len(degr)} degradation events recorded, "
+        f"warmup={warm['combinations']} combos"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

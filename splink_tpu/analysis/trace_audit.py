@@ -644,3 +644,27 @@ def _ensure_default_registry() -> None:
         # the packed reference table as an explicit argument — the same
         # no-embedded-constant design TA-CONST pins for gamma_batch
         return fn, (packed_q, program._packed, cand, valid, params), {}
+
+    # the brown-out tier's budgeted twin (engine._brownout_kernel): same
+    # factory, reduced top-k over a small candidate capacity — the shape
+    # the service dispatches under pressure, so it is gated like the
+    # full-service program (it runs per degraded request). Not registered
+    # in the shard tier: brown-out batches are single-device by design
+    # (the cheapest shape combination, not a sharded one).
+    @register_kernel("serve_score_topk_brownout")
+    def _build_serve_score_brownout():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..serve.engine import make_score_topk_fn
+
+        program = _gamma_program()
+        _, params = _fs_inputs()
+        fn = make_score_topk_fn(
+            program._layout, program.settings["comparison_columns"], k=1
+        )
+        packed_q = jnp.asarray(np.zeros((16, program._packed.shape[1]),
+                                        np.uint32))
+        cand = jnp.asarray(np.zeros((16, 4), np.int32))
+        valid = jnp.asarray(np.zeros((16, 4), bool))
+        return fn, (packed_q, program._packed, cand, valid, params), {}

@@ -90,8 +90,13 @@ def summarize_events(events: list[dict]) -> str:
             )
 
     # ---- resilience events ----------------------------------------------
+    # serve-tier events (health transitions, breaker state changes, index
+    # hot-swaps, worker restarts, brown-out boundaries) belong in the same
+    # chronological incident timeline as the training-side ones
     res = [e for e in events
-           if e.get("type") in ("retry", "fault", "checkpoint", "degradation")]
+           if e.get("type") in ("retry", "fault", "checkpoint", "degradation",
+                                "health", "breaker", "index_swap",
+                                "serve_worker_restart", "brownout_end")]
     if res:
         lines.append("")
         lines.append(f"resilience events: {len(res)}")

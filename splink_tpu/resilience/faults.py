@@ -17,6 +17,7 @@ Plan grammar (comma-separated events)::
     em_iteration@iter=4:kind=kill         SIGKILL own process at iteration 4
     resident_em@kind=oom                  device OOM entering the resident path
     segment@iter=10:kind=transient        error at a segmented-EM boundary
+    serve_batch@batch=1:kind=slow:delay_ms=400   stall one serve batch 400ms
 
 Sites are the hook names the execution stack calls (`fire`); ``iter`` /
 ``batch`` constrain when the event matches (omitted = any). ``times``
@@ -27,6 +28,26 @@ recovery assertions possible.
 The kill kind uses SIGKILL (no atexit, no finally blocks), faithfully
 modelling host death for the checkpoint/resume tests; the relaunching
 parent controls the environment, so a resumed process does not re-fire.
+The slow kind SLEEPS ``delay_ms`` (default 250) and returns — it models a
+stalled device dispatch rather than a failed one, for deadline/timeout
+paths that only misbehave when work is late, not absent.
+
+Serve-path fault sites (SERVE_SITES; exercised end to end by
+``scripts/chaos_smoke.py`` / ``make chaos-smoke``):
+
+    serve_worker    top of the micro-batch worker loop, OUTSIDE the batch
+                    try block — a raise here kills the worker thread
+                    (coords: batch=completed batch count), the failure the
+                    service watchdog exists to recover from
+    serve_batch     inside the per-batch scoring try block (coords:
+                    batch=batch ordinal) — an exception here must shed
+                    the batch, never escape to callers, and feeds the
+                    circuit breaker; kind=slow stalls the batch instead
+    swap_load       QueryEngine.swap_index, before loading the candidate
+                    index (models unreadable/corrupt artifact files)
+    swap_validate   QueryEngine.swap_index, before the parity-probe
+                    replay commits — a raise rolls the swap back with the
+                    old index still serving
 """
 
 from __future__ import annotations
@@ -34,12 +55,19 @@ from __future__ import annotations
 import logging
 import os
 import signal
+import time
 
 logger = logging.getLogger("splink_tpu")
 
 ENV_VAR = "SPLINK_TPU_FAULTS"
 
-_KINDS = ("transient", "oom", "kill")
+_KINDS = ("transient", "oom", "kill", "slow")
+
+DEFAULT_SLOW_DELAY_MS = 250
+
+# The serve-path injection points (documented above); chaos_smoke drives
+# every one of them and asserts the service-level recovery contract.
+SERVE_SITES = ("serve_worker", "serve_batch", "swap_load", "swap_validate")
 
 
 class InjectedFault(RuntimeError):
@@ -64,13 +92,21 @@ class InjectedFault(RuntimeError):
 
 
 class _Event:
-    __slots__ = ("site", "kind", "match", "times")
+    __slots__ = ("site", "kind", "match", "times", "delay_ms")
 
-    def __init__(self, site: str, kind: str, match: dict, times: int):
+    def __init__(
+        self,
+        site: str,
+        kind: str,
+        match: dict,
+        times: int,
+        delay_ms: int = DEFAULT_SLOW_DELAY_MS,
+    ):
         self.site = site
         self.kind = kind
         self.match = match  # {"iter": int, "batch": int, ...}
         self.times = times
+        self.delay_ms = delay_ms
 
     def matches(self, site: str, coords: dict) -> bool:
         if self.times <= 0 or site != self.site:
@@ -103,6 +139,7 @@ class FaultPlan:
                 continue
             site, _, argstr = part.partition("@")
             kind, times, match = "transient", 1, {}
+            delay_ms = DEFAULT_SLOW_DELAY_MS
             for kv in filter(None, argstr.split(":")):
                 key, _, value = kv.partition("=")
                 key = key.strip()
@@ -114,13 +151,16 @@ class FaultPlan:
                     kind = value
                 elif key == "times":
                     times = int(value)
+                elif key == "delay_ms":
+                    delay_ms = int(value)
                 else:
                     match[key] = int(value)
-            events.append(_Event(site.strip(), kind, match, times))
+            events.append(_Event(site.strip(), kind, match, times, delay_ms))
         return cls(events, spec)
 
     def fire(self, site: str, **coords) -> None:
-        """Raise/kill if an event matches this (site, coords); else no-op."""
+        """Raise/kill/stall if an event matches this (site, coords); else
+        no-op."""
         if not self.events:
             return
         for ev in self.events:
@@ -132,6 +172,13 @@ class FaultPlan:
                 from ..obs.events import publish
 
                 publish("fault", site=site, kind=ev.kind, coords=dict(coords))
+                if ev.kind == "slow":
+                    logger.warning(
+                        "fault injection: stalling %s %s for %dms",
+                        site, coords, ev.delay_ms,
+                    )
+                    time.sleep(ev.delay_ms / 1000.0)
+                    continue  # a stall completes; later events may still fire
                 if ev.kind == "kill":
                     logger.warning(
                         "fault injection: SIGKILL self at %s %s", site, coords

@@ -16,12 +16,23 @@ this package answers "which reference records match THIS record, now":
         result = svc.query({"first_name": "amelia", "surname": "smith",
                             "dob": "1987"})
 
+Resilience (docs/serving.md#resilience): per-replica health states with
+hysteresis (:mod:`.health`), deadline admission + brown-out + circuit
+breaker (:mod:`.admission`, threaded through the service), health-aware
+replica routing with hedged requests (:mod:`.router`), chaos-tested index
+hot-swap with parity probes and rollback
+(:meth:`QueryEngine.swap_index`), and a watchdog that recovers from
+worker-thread death. ``make chaos-smoke`` drives every registered serve
+fault site against those guarantees.
+
 See docs/serving.md for the artifact format, bucket policy and latency
 tuning knobs, and ``python -m splink_tpu.serve`` for the CLI.
 """
 
+from .admission import CircuitBreaker, WaitEstimator
 from .bucketing import BucketPolicy, bucket_for
-from .engine import QueryEngine
+from .engine import IndexSwapError, QueryEngine
+from .health import BROKEN, DEGRADED, HEALTHY, HealthMonitor
 from .index import (
     IndexMismatchError,
     LinkageIndex,
@@ -31,12 +42,14 @@ from .index import (
     build_index,
     load_index,
 )
+from .router import ReplicaRouter
 from .service import LinkageService, QueryResult
 
 __all__ = [
     "BucketPolicy",
     "bucket_for",
     "QueryEngine",
+    "IndexSwapError",
     "LinkageIndex",
     "QueryBatch",
     "ServeRule",
@@ -46,4 +59,11 @@ __all__ = [
     "load_index",
     "LinkageService",
     "QueryResult",
+    "ReplicaRouter",
+    "HealthMonitor",
+    "HEALTHY",
+    "DEGRADED",
+    "BROKEN",
+    "CircuitBreaker",
+    "WaitEstimator",
 ]

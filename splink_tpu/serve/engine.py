@@ -39,12 +39,19 @@ zero recompiles (proven by the ``jax.monitoring`` compile counter in
 from __future__ import annotations
 
 import logging
+import threading
+import time
 
 import numpy as np
 
 from ..utils.logging_utils import warn_degraded
 
 logger = logging.getLogger("splink_tpu")
+
+
+class IndexSwapError(RuntimeError):
+    """A hot-swap candidate index failed to load or validate; the swap was
+    rolled back and the previous index is still serving."""
 
 
 # ---------------------------------------------------------------------------
@@ -196,8 +203,8 @@ class QueryEngine:
     """
 
     def __init__(self, index, *, top_k: int | None = None, policy=None,
-                 telemetry=None):
-        from .bucketing import BucketPolicy
+                 telemetry=None, brownout_top_k: int | None = None):
+        from .bucketing import BucketPolicy, bucket_for
 
         self.index = index
         settings = index.settings
@@ -214,10 +221,42 @@ class QueryEngine:
                 "serve_candidate_buckets — top-k cannot exceed the padded "
                 "candidate capacity"
             )
+        # Brown-out tier: a second, budgeted program — smaller top-k AND
+        # the smallest candidate bucket that covers it, so a degraded
+        # dispatch runs the CHEAPEST compiled shape combination instead of
+        # shedding outright (admission.py). 0 disables the tier.
+        self.brownout_top_k = int(
+            brownout_top_k
+            if brownout_top_k is not None
+            else settings.get("serve_brownout_top_k", 0) or 0
+        )
+        if self.brownout_top_k < 0 or self.brownout_top_k > self.top_k:
+            raise ValueError(
+                f"serve_brownout_top_k={self.brownout_top_k} must be in "
+                f"[0, serve_top_k={self.top_k}] — the brown-out tier serves "
+                "a REDUCED budget"
+            )
+        self.brownout_capacity = (
+            bucket_for(self.brownout_top_k, self.policy.candidate_buckets)
+            if self.brownout_top_k
+            else None
+        )
         self._obs = telemetry
         self._kernel = None
+        self._bkernel = None
         self._donate = None
         self._warmed: set[tuple[int, int]] = set()
+        self._warmed_brownout: set[tuple[int, int]] = set()
+        # serializes batch dispatch against index hot-swap: a dispatch in
+        # flight finishes on the index it started on (graceful drain), and
+        # the swap flip is atomic with respect to the next dispatch
+        self._swap_lock = threading.RLock()
+        # serializes swap_index against ITSELF (the dispatch lock must stay
+        # free during a swap's long validation, so it cannot do this job):
+        # without it two concurrent swaps both "commit", one silently lost
+        self._swap_mutex = threading.Lock()
+        self._probes = None  # (query df, recorded answer arrays)
+        self._generation = 0
         # float64 serving needs process-wide x64, same semantics as the
         # linker's float64 setting (jax silently downcasts otherwise)
         if index.dtype == "float64":
@@ -237,47 +276,64 @@ class QueryEngine:
 
     # -- kernel ---------------------------------------------------------
 
+    def _build_kernel(self, k: int):
+        """One jitted fused program for one top-k. ``capacity`` is a
+        static argument: each (capacity, shapes) combination compiles once
+        and is reused."""
+        import functools
+
+        import jax
+
+        index = self.index
+        n_rules = len(index.rules)
+        encode = make_encode_query_fn()
+        layout = index.layout
+        cols = tuple(index.settings["comparison_columns"])
+        score = make_score_topk_fn(layout, cols, k)
+
+        def fused(
+            capacity, packed_q, qbuckets, valid,
+            starts, sizes, rows, row_bucket, packed_ref, params,
+        ):
+            gather = make_candidate_gather_fn(n_rules, capacity)
+            packed_q, qbuckets = encode(packed_q, qbuckets, valid)
+            cand, cvalid, n_cand = gather(
+                qbuckets, starts, sizes, rows, row_bucket
+            )
+            top_p, top_rows, top_valid = score(
+                packed_q, packed_ref, cand, cvalid, params
+            )
+            return top_p, top_rows, top_valid, n_cand
+
+        # donate the per-request buffers (query rows + buckets); the
+        # CPU backend ignores donation with a warning, so gate it
+        donate = ()
+        if jax.default_backend() not in ("cpu",):
+            donate = (1, 2)
+        self._donate = donate
+        return functools.partial(
+            jax.jit, static_argnums=(0,), donate_argnums=donate
+        )(fused)
+
     def _fused_kernel(self):
-        """The ONE jitted program (built lazily, stable identity so the jit
-        cache persists across batches). ``capacity`` is a static argument:
-        each (capacity, shapes) combination compiles once and is reused."""
+        """The full-service jitted program (built lazily, stable identity
+        so the jit cache persists across batches)."""
         if self._kernel is None:
-            import functools
-
-            import jax
-
-            index = self.index
-            n_rules = len(index.rules)
-            encode = make_encode_query_fn()
-            layout = index.layout
-            cols = tuple(index.settings["comparison_columns"])
-            k = self.top_k
-            score = make_score_topk_fn(layout, cols, k)
-
-            def fused(
-                capacity, packed_q, qbuckets, valid,
-                starts, sizes, rows, row_bucket, packed_ref, params,
-            ):
-                gather = make_candidate_gather_fn(n_rules, capacity)
-                packed_q, qbuckets = encode(packed_q, qbuckets, valid)
-                cand, cvalid, n_cand = gather(
-                    qbuckets, starts, sizes, rows, row_bucket
-                )
-                top_p, top_rows, top_valid = score(
-                    packed_q, packed_ref, cand, cvalid, params
-                )
-                return top_p, top_rows, top_valid, n_cand
-
-            # donate the per-request buffers (query rows + buckets); the
-            # CPU backend ignores donation with a warning, so gate it
-            donate = ()
-            if jax.default_backend() not in ("cpu",):
-                donate = (1, 2)
-            self._donate = donate
-            self._kernel = functools.partial(
-                jax.jit, static_argnums=(0,), donate_argnums=donate
-            )(fused)
+            self._kernel = self._build_kernel(self.top_k)
         return self._kernel
+
+    def _brownout_kernel(self):
+        """The budgeted brown-out twin: top-k ``brownout_top_k``, always
+        dispatched at the (cheapest) ``brownout_capacity`` candidate
+        bucket. Registered as ``serve_score_topk_brownout`` in the jaxpr
+        audit tier."""
+        if not self.brownout_top_k:
+            raise RuntimeError(
+                "brown-out tier is disabled (serve_brownout_top_k=0)"
+            )
+        if self._bkernel is None:
+            self._bkernel = self._build_kernel(self.brownout_top_k)
+        return self._bkernel
 
     # -- query paths ----------------------------------------------------
 
@@ -285,28 +341,42 @@ class QueryEngine:
         """Host-side query encode (see LinkageIndex.encode_queries)."""
         return self.index.encode_queries(df)
 
-    def query_arrays(self, df):
+    def query_arrays(self, df, *, degraded: bool = False):
         """Score a query DataFrame; returns
         ``(top_p, top_rows, top_valid, n_candidates)`` numpy arrays of
         shape (n, k) / (n,). ``top_rows`` are reference ROW indices; map
-        through ``index.unique_id`` for ids (``query`` does)."""
-        batch = self.encode(df)
-        out_p = np.full((batch.n, self.top_k), -1.0, self.index.float_dtype)
-        out_rows = np.zeros((batch.n, self.top_k), np.int32)
-        out_valid = np.zeros((batch.n, self.top_k), bool)
-        out_ncand = np.zeros(batch.n, np.int64)
-        pos = 0
-        for q_pad, start, stop in self.policy.iter_query_chunks(batch.n):
-            p, r, v, nc = self._run_chunk(batch, start, stop, q_pad)
-            out_p[start:stop] = p[: stop - start]
-            out_rows[start:stop] = r[: stop - start]
-            out_valid[start:stop] = v[: stop - start]
-            out_ncand[start:stop] = nc[: stop - start]
-            pos = stop
-        assert pos == batch.n
-        return out_p, out_rows, out_valid, out_ncand
+        through ``index.unique_id`` for ids (``query`` does).
 
-    def _run_chunk(self, batch, start: int, stop: int, q_pad: int):
+        ``degraded=True`` runs the brown-out program: top-k
+        ``brownout_top_k`` over candidates truncated to the cheapest
+        bucket (``brownout_capacity``) — the budgeted answer the service
+        serves under pressure instead of shedding."""
+        with self._swap_lock:
+            k = self.brownout_top_k if degraded else self.top_k
+            if degraded and not k:
+                raise RuntimeError(
+                    "brown-out tier is disabled (serve_brownout_top_k=0)"
+                )
+            batch = self.encode(df)
+            out_p = np.full((batch.n, k), -1.0, self.index.float_dtype)
+            out_rows = np.zeros((batch.n, k), np.int32)
+            out_valid = np.zeros((batch.n, k), bool)
+            out_ncand = np.zeros(batch.n, np.int64)
+            pos = 0
+            for q_pad, start, stop in self.policy.iter_query_chunks(batch.n):
+                p, r, v, nc = self._run_chunk(
+                    batch, start, stop, q_pad, degraded=degraded
+                )
+                out_p[start:stop] = p[: stop - start]
+                out_rows[start:stop] = r[: stop - start]
+                out_valid[start:stop] = v[: stop - start]
+                out_ncand[start:stop] = nc[: stop - start]
+                pos = stop
+            assert pos == batch.n
+            return out_p, out_rows, out_valid, out_ncand
+
+    def _run_chunk(self, batch, start: int, stop: int, q_pad: int, *,
+                   degraded: bool = False):
         """One bucketed device dispatch: pad the chunk to ``q_pad`` queries
         and its candidate axis to a policy bucket, run the fused kernel,
         fetch once."""
@@ -315,19 +385,28 @@ class QueryEngine:
         index = self.index
         n = stop - start
         qb = batch.qbuckets[:, start:stop]
-        counts = index.candidate_counts(qb)
-        need = max(int(counts.max(initial=0)), self.top_k, 1)
-        capacity = self.policy.candidate_bucket(need)
-        if capacity is None:
-            capacity = self.policy.candidate_buckets[-1]
-            warn_degraded(
-                "serve_candidates",
-                "truncated",
-                f"largest candidate block needs {need} slots but the "
-                f"largest candidate bucket is {capacity}; blocks are "
-                "truncated to the bucket (top-k over the truncated set)",
-                queries=n,
-            )
+        if degraded:
+            # brown-out: the candidate budget IS the truncation — always
+            # the cheapest compiled shape, no per-batch warning spam (the
+            # service tags every result degraded and emits the episode
+            # events)
+            capacity = self.brownout_capacity
+            kernel = self._brownout_kernel()
+        else:
+            counts = index.candidate_counts(qb)
+            need = max(int(counts.max(initial=0)), self.top_k, 1)
+            capacity = self.policy.candidate_bucket(need)
+            if capacity is None:
+                capacity = self.policy.candidate_buckets[-1]
+                warn_degraded(
+                    "serve_candidates",
+                    "truncated",
+                    f"largest candidate block needs {need} slots but the "
+                    f"largest candidate bucket is {capacity}; blocks are "
+                    "truncated to the bucket (top-k over the truncated set)",
+                    queries=n,
+                )
+            kernel = self._fused_kernel()
         # pinned upload buffers are reused without a host memset: the
         # encode_query kernel zeroes padding rows on device
         packed_pad = np.empty((q_pad, index.n_lanes), np.uint32)
@@ -335,7 +414,6 @@ class QueryEngine:
         qb_pad = np.empty((len(index.rules), q_pad), np.int32)
         qb_pad[:, :n] = qb
         dev = index.device_state()
-        kernel = self._fused_kernel()
         top_p, top_rows, top_valid, n_cand = kernel(
             capacity,
             jnp.asarray(packed_pad),
@@ -348,7 +426,9 @@ class QueryEngine:
             dev["packed"],
             dev["params"],
         )
-        self._warmed.add((q_pad, capacity))
+        (self._warmed_brownout if degraded else self._warmed).add(
+            (q_pad, capacity)
+        )
         # the single host fetch for this batch
         return (
             np.asarray(top_p),
@@ -388,10 +468,13 @@ class QueryEngine:
 
     def warmup(self) -> dict:
         """Compile every (query-bucket, candidate-bucket) combination with
-        dummy batches so steady-state serving never compiles. Returns
-        ``{"combinations": N, "compiles": measured backend compiles}`` —
-        the compile count is the jax.monitoring-measured proof that one
-        combination costs exactly one compile (and, after this, zero)."""
+        dummy batches so steady-state serving never compiles — the
+        brown-out tier's (query-bucket, ``brownout_capacity``) shapes
+        included when enabled, so a brown-out EPISODE is also
+        recompile-free. Returns ``{"combinations": N, "compiles": measured
+        backend compiles}`` — the compile count is the jax.monitoring-
+        measured proof that one combination costs exactly one compile
+        (and, after this, zero)."""
         from ..obs.metrics import compile_totals, install_compile_monitor
 
         install_compile_monitor()
@@ -399,36 +482,227 @@ class QueryEngine:
         combos = self.policy.warmup_combinations()
         for q_pad, capacity in combos:
             self._warm_one(q_pad, capacity)
+        brownout_combos = []
+        if self.brownout_top_k:
+            brownout_combos = [
+                (qb, self.brownout_capacity)
+                for qb in self.policy.query_buckets
+            ]
+            for q_pad, capacity in brownout_combos:
+                self._warm_one(q_pad, capacity, degraded=True)
         c1, _ = compile_totals()
         if self._obs is not None:
             self._obs.count("serve_warmup_compiles", c1 - c0)
-        return {"combinations": len(combos), "compiles": c1 - c0}
+        return {
+            "combinations": len(combos) + len(brownout_combos),
+            "compiles": c1 - c0,
+        }
 
-    def _warm_one(self, q_pad: int, capacity: int) -> None:
+    def _warm_one(self, q_pad: int, capacity: int,
+                  degraded: bool = False) -> None:
         import jax.numpy as jnp
 
-        index = self.index
-        dev = index.device_state()
-        kernel = self._fused_kernel()
-        packed = np.zeros((q_pad, index.n_lanes), np.uint32)
-        qb = np.full((len(index.rules), q_pad), -1, np.int32)
-        out = kernel(
-            capacity,
-            jnp.asarray(packed),
-            jnp.asarray(qb),
-            np.int32(0),
-            dev["starts"],
-            dev["sizes"],
-            dev["rows"],
-            dev["row_bucket"],
-            dev["packed"],
-            dev["params"],
-        )
-        np.asarray(out[0])  # execute fully
-        self._warmed.add((q_pad, capacity))
+        with self._swap_lock:
+            index = self.index
+            dev = index.device_state()
+            kernel = (
+                self._brownout_kernel() if degraded else self._fused_kernel()
+            )
+            packed = np.zeros((q_pad, index.n_lanes), np.uint32)
+            qb = np.full((len(index.rules), q_pad), -1, np.int32)
+            out = kernel(
+                capacity,
+                jnp.asarray(packed),
+                jnp.asarray(qb),
+                np.int32(0),
+                dev["starts"],
+                dev["sizes"],
+                dev["rows"],
+                dev["row_bucket"],
+                dev["packed"],
+                dev["params"],
+            )
+            np.asarray(out[0])  # execute fully
+            (self._warmed_brownout if degraded else self._warmed).add(
+                (q_pad, capacity)
+            )
 
     @property
     def warmed_shapes(self) -> set:
         """The (query_bucket, candidate_bucket) combinations compiled so
-        far."""
+        far (full-service program; the brown-out program's shapes are in
+        ``warmed_brownout_shapes``)."""
         return set(self._warmed)
+
+    @property
+    def warmed_brownout_shapes(self) -> set:
+        return set(self._warmed_brownout)
+
+    def probe(self) -> None:
+        """Execute the smallest warmed shape end to end (kernel + device +
+        result fetch, no compile after warmup). The watchdog's circuit-
+        breaker recovery probe: success proves the engine can dispatch."""
+        self._warm_one(
+            self.policy.query_buckets[0], self.policy.candidate_buckets[0]
+        )
+
+    @property
+    def generation(self) -> int:
+        """How many hot-swaps this engine has committed."""
+        return self._generation
+
+    # -- parity probes & index hot-swap ---------------------------------
+
+    def capture_probes(self, df) -> int:
+        """Record ``df`` and this engine's CURRENT answers for it as the
+        parity probe set: :meth:`swap_index` replays these queries on a
+        candidate index and requires bit-identical answers before
+        committing. Returns the number of probes stored."""
+        df = df.reset_index(drop=True).copy()
+        # one lock span across compute AND store: a swap committing in
+        # between would attach answers recorded on the OLD index to the
+        # NEW one, failing the next (valid) swap's parity replay
+        with self._swap_lock:
+            answers = self.query_arrays(df)
+            self._probes = (df, answers)
+        return len(df)
+
+    @property
+    def probe_count(self) -> int:
+        return 0 if self._probes is None else len(self._probes[0])
+
+    def swap_index(self, source, *, refresh_probes: bool = False) -> dict:
+        """Hot-swap to a new :class:`LinkageIndex` with validation and
+        rollback (ISSUE tentpole 4):
+
+        1. load the candidate (a directory path or an in-memory index) —
+           ``load_index`` verifies format version, settings-hash binding
+           and the array fingerprint;
+        2. build + pre-warm a pending engine over it (every bucket
+           combination compiles BEFORE the flip, so post-swap steady
+           state stays recompile-free);
+        3. replay the stored parity probes against the recorded answers —
+           any drift (``refresh_probes=False``) fails the swap;
+        4. atomically flip index/kernels/warm-state under the swap lock —
+           an in-flight dispatch finishes on the old index first
+           (graceful drain), the next one runs on the new.
+
+        ANY failure before the flip emits a ``serve_index_swap``
+        degradation event and raises :class:`IndexSwapError` with the old
+        index untouched and still serving. ``refresh_probes=True`` skips
+        the parity comparison and re-records the probe answers on the new
+        index (an intentional content change). Concurrent ``swap_index``
+        calls serialize on the swap mutex — without it both would
+        "commit" and one new index would be silently lost."""
+        with self._swap_mutex:
+            return self._swap_index_serialized(source, refresh_probes)
+
+    def _swap_index_serialized(self, source, refresh_probes: bool) -> dict:
+        from ..obs.events import publish
+        from ..resilience.faults import active_plan
+        from .index import LinkageIndex, load_index
+
+        t0 = time.perf_counter()
+        plan = active_plan(self.index.settings)
+        generation = self._generation + 1
+        try:
+            plan.fire("swap_load", generation=generation)
+            if isinstance(source, LinkageIndex):
+                new_index = source
+            else:
+                new_index = load_index(source)
+        except Exception as e:  # noqa: BLE001 - every load failure rolls back
+            warn_degraded(
+                "serve_index_swap",
+                "rolled_back",
+                f"candidate index failed to load: {e}",
+                generation=generation,
+            )
+            raise IndexSwapError(
+                f"index swap rolled back (old index still serving): "
+                f"candidate failed to load: {e}"
+            ) from e
+        probes_checked = 0
+        new_probes = None
+        probes = self._probes  # snapshot: validation runs against THIS set
+        try:
+            pending = QueryEngine(
+                new_index,
+                top_k=self.top_k,
+                policy=self.policy,
+                telemetry=self._obs,
+                brownout_top_k=self.brownout_top_k,
+            )
+            warm = pending.warmup()
+            plan.fire("swap_validate", generation=generation)
+            if probes is not None:
+                probe_df, expected = probes
+                got = pending.query_arrays(probe_df)
+                if refresh_probes:
+                    new_probes = (probe_df, got)
+                else:
+                    _check_probe_parity(expected, got)
+                    probes_checked = len(probe_df)
+                    new_probes = (probe_df, got)
+        except Exception as e:  # noqa: BLE001 - every validation failure rolls back
+            warn_degraded(
+                "serve_index_swap",
+                "rolled_back",
+                f"candidate index failed validation: {e}",
+                generation=generation,
+            )
+            raise IndexSwapError(
+                f"index swap rolled back (old index still serving): {e}"
+            ) from e
+        with self._swap_lock:
+            self.index = pending.index
+            self._kernel = pending._kernel
+            self._bkernel = pending._bkernel
+            self._donate = pending._donate
+            self._warmed = pending._warmed
+            self._warmed_brownout = pending._warmed_brownout
+            if new_probes is not None:
+                self._probes = new_probes
+            elif self._probes is not probes:
+                # a concurrent capture landed DURING validation: its
+                # answers describe the outgoing index and must not gate
+                # the next swap — drop them so the service re-seeds its
+                # probe set from post-swap traffic
+                self._probes = None
+            self._generation = generation
+        stats = {
+            "generation": generation,
+            "n_rows": self.index.n_rows,
+            "warmup_combinations": warm["combinations"],
+            "warmup_compiles": warm["compiles"],
+            "probes_checked": probes_checked,
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+        }
+        publish("index_swap", **stats)
+        logger.info(
+            "serving index hot-swapped: generation %d, %d rows, "
+            "%d probe(s) parity-checked, %.3fs",
+            generation, self.index.n_rows, probes_checked, stats["elapsed_s"],
+        )
+        return stats
+
+
+def _check_probe_parity(expected, got) -> None:
+    """Raise with a precise diff summary unless the candidate engine's
+    probe answers are BIT-identical to the recorded ones (same dtypes,
+    same shapes, same values — the serve<->offline parity contract carried
+    across the swap)."""
+    names = ("top_p", "top_rows", "top_valid", "n_candidates")
+    for name, e, g in zip(names, expected, got):
+        if e.dtype != g.dtype or e.shape != g.shape:
+            raise ValueError(
+                f"probe parity failed on {name}: recorded "
+                f"{e.shape}/{e.dtype} vs candidate {g.shape}/{g.dtype}"
+            )
+        if not np.array_equal(e, g):
+            bad = int(np.sum(e != g))
+            raise ValueError(
+                f"probe parity failed on {name}: {bad}/{e.size} entries "
+                "differ from the recorded answers (bit-identity required; "
+                "pass refresh_probes=True for an intentional content change)"
+            )

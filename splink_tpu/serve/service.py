@@ -8,18 +8,41 @@ FIRST waiting record (or until a full largest query bucket accumulates,
 whichever comes first) into one engine dispatch, and each future resolves
 with its record's matches.
 
-Admission control is a bounded queue that SHEDS instead of OOMing: when
-``queue_depth`` records are already waiting, ``submit`` resolves the future
-immediately with ``shed=True`` and emits the structured degradation record
-(``logging_utils.warn_degraded`` — the same channel the offline degradation
-ladder uses), so overload is a measured, observable state rather than a
-crash. Nothing raises on the submit path.
+Resilience is graduated, not binary (serve/admission.py, serve/health.py):
+
+* **Admission control** — the bounded queue still SHEDS instead of OOMing
+  when ``queue_depth`` records wait, and a request carrying its own
+  ``deadline_ms`` is rejected AT ADMISSION when the estimated queue wait
+  (EWMA batch-time model) cannot meet it; queued requests whose deadline
+  lapses before dispatch are shed at the batcher, never scored late.
+* **Brown-out** — between full service and shedding sits the budgeted
+  tier: under pressure (queue past ``brownout_fill``, or health already
+  degraded) batches run the engine's brown-out program — reduced top-k
+  over the cheapest candidate bucket — and results are tagged
+  ``degraded=True``. Enabled by ``serve_brownout_top_k`` > 0.
+* **Circuit breaker** — ``serve_breaker_threshold`` consecutive batch
+  failures open the breaker: requests fail fast as shed (reason
+  ``breaker_open``) instead of queueing behind a broken engine, while the
+  first post-cooldown batch — or the watchdog's synthetic engine probe
+  when traffic has stopped — tests recovery.
+* **Watchdog** — a supervisor thread that detects a dead worker, resolves
+  its orphaned futures shed (a crashed worker previously hung every
+  outstanding future forever), restarts the thread, runs breaker recovery
+  probes, and drives the per-replica health state machine
+  (:class:`~.health.HealthMonitor`) from live signals: queue fill, shed
+  rate, recent p95, compile stalls, breaker state.
+
+Nothing raises on the submit path, no exception ever escapes to a caller
+through a future, and every degradation flows through the structured
+channel (``logging_utils.warn_degraded`` + ambient obs events) — overload
+and faults are measured, observable states rather than crashes.
+``scripts/chaos_smoke.py`` (`make chaos-smoke`) drives every registered
+serve fault site against these guarantees.
 
 Per-request latency (enqueue -> result set) feeds a bounded reservoir;
 :meth:`latency_summary` reports p50/p95/p99 and throughput, and with a
 telemetry ``RunContext`` the summary lands in the run record (``python -m
-splink_tpu.obs summarize`` renders it) alongside per-batch ``serve_batch``
-spans.
+splink_tpu.obs summarize``) alongside per-batch ``serve_batch`` spans.
 """
 
 from __future__ import annotations
@@ -28,26 +51,38 @@ import logging
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FuturesTimeout
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..resilience.faults import active_plan
 from ..utils.logging_utils import warn_degraded
+from .admission import CircuitBreaker, WaitEstimator, brownout_active
+from .health import HealthMonitor
 
 logger = logging.getLogger("splink_tpu")
 
 _LATENCY_RESERVOIR = 65536  # newest-N latency samples kept for percentiles
+_RECENT_WINDOW = 512  # newest-N samples for the health monitor's p95
 
 
 @dataclass
 class QueryResult:
-    """One query's outcome."""
+    """One query's outcome.
+
+    ``shed`` requests carry a machine-readable ``reason``:
+    ``queue_full`` / ``deadline`` / ``timeout`` / ``breaker_open`` /
+    ``batch_error`` / ``worker_restart`` / ``closed``. ``degraded`` marks
+    a brown-out answer (served under a reduced candidate/top-k budget)."""
 
     matches: list = field(default_factory=list)  # [(ref_uid, probability)]
     n_candidates: int = 0
     shed: bool = False
     latency_ms: float | None = None
+    degraded: bool = False
+    reason: str | None = None
 
 
 class LinkageService:
@@ -62,9 +97,18 @@ class LinkageService:
         deadline_ms: float | None = None,
         autostart: bool = True,
         telemetry=None,
+        name: str = "serve",
+        breaker_threshold: int | None = None,
+        breaker_cooldown_s: float = 1.0,
+        brownout_fill: float = 0.5,
+        watchdog_interval_s: float = 0.1,
+        compile_stall_s: float = 0.25,
+        probe_queries: int | None = None,
+        health_monitor: HealthMonitor | None = None,
     ):
         settings = engine.index.settings
         self.engine = engine
+        self.name = name
         self.queue_depth = int(
             queue_depth
             if queue_depth is not None
@@ -75,54 +119,120 @@ class LinkageService:
             if deadline_ms is not None
             else settings.get("serve_deadline_ms", 5.0)
         )
+        self.breaker = CircuitBreaker(
+            threshold=int(
+                breaker_threshold
+                if breaker_threshold is not None
+                else settings.get("serve_breaker_threshold", 3) or 3
+            ),
+            cooldown_s=breaker_cooldown_s,
+        )
+        self.brownout_fill = float(brownout_fill)
+        self.brownout_enabled = engine.brownout_top_k > 0
+        self.watchdog_interval_s = float(watchdog_interval_s)
+        self.compile_stall_s = float(compile_stall_s)
+        self._probe_queries = int(
+            probe_queries
+            if probe_queries is not None
+            else settings.get("serve_probe_queries", 16) or 0
+        )
+        self._settings = settings
         self._obs = telemetry
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
-        self._queue: deque = deque()  # (record, future, t_enqueue)
+        self._queue: deque = deque()  # (record, future, t_enqueue, deadline)
+        self._inflight: list = []  # entries popped by the worker, unresolved
+        self._probe_buffer: list = []  # records accumulating toward capture
         self._latencies: deque = deque(maxlen=_LATENCY_RESERVOIR)
+        self._recent_lat: deque = deque(maxlen=_RECENT_WINDOW)
+        self._admission = WaitEstimator()
+        self._health = health_monitor or HealthMonitor(name=name)
         self._shed_count = 0
         self._served = 0
         self._batches = 0
+        self._timeouts = 0
+        self._degraded_served = 0
+        self._brownout_episodes = 0
+        self._worker_crashes = 0
+        self._brownout_active = False
+        self._take_fill = 0.0
+        self._swap_in_progress = False
+        self._summary_recorded = False
         self._t_start = time.monotonic()
         self._stop = False
         self._thread: threading.Thread | None = None
+        self._watchdog: threading.Thread | None = None
+        self._watchdog_stop = threading.Event()
+        # health-window marks (consumed by _health_signals deltas; the
+        # watchdog and on-demand health() calls share them, so updates go
+        # through _signals_lock)
+        self._signals_lock = threading.Lock()
+        self._hw_served = 0
+        self._hw_shed = 0
+        self._stall_accum = 0.0
+        self._last_health_eval = float("-inf")
+        from ..obs.metrics import compile_totals
+
+        self._last_compile_s = compile_totals()[1]
         if autostart:
             self.start()
 
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> "LinkageService":
-        if self._thread is None:
-            self._stop = False
-            self._thread = threading.Thread(
-                target=self._worker, name="splink-serve", daemon=True
+        """Start (or restart after :meth:`close`) the worker + watchdog."""
+        with self._nonempty:
+            if self._thread is None:
+                self._stop = False
+                self._summary_recorded = False  # a reopen closes again later
+                self._thread = threading.Thread(
+                    target=self._worker, name="splink-serve", daemon=True
+                )
+                self._thread.start()
+        if self._watchdog is None or not self._watchdog.is_alive():
+            self._watchdog_stop = threading.Event()
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name="splink-serve-watchdog",
+                daemon=True,
             )
-            self._thread.start()
+            self._watchdog.start()
         return self
 
     def close(self, drain: bool = True) -> None:
-        """Stop the worker. With ``drain`` (default) queued requests are
-        served first; otherwise they resolve shed."""
+        """Stop the worker and watchdog. With ``drain`` (default) queued
+        requests are served first; otherwise they resolve shed. Idempotent
+        — a second close is a no-op and never hangs a future."""
+        self._watchdog_stop.set()
+        watchdog = self._watchdog
+        if watchdog is not None and watchdog is not threading.current_thread():
+            watchdog.join(timeout=10)
+        self._watchdog = None
+        to_shed: list = []
         with self._nonempty:
             self._stop = True
             if not drain:
                 while self._queue:
-                    _, fut, _ = self._queue.popleft()
-                    self._shed_count += 1
-                    fut.set_result(QueryResult(shed=True))
+                    to_shed.append(self._queue.popleft())
             self._nonempty.notify_all()
+        for entry in to_shed:
+            self._resolve_shed(entry[1], "closed")
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
         # a submit racing the shutdown can enqueue after the worker's last
-        # batch; resolve any stragglers shed so no future hangs forever
+        # batch — and a worker that DIED mid-batch leaves in-flight entries
+        # — resolve all stragglers shed so no future hangs forever
         with self._nonempty:
-            while self._queue:
-                _, fut, _ = self._queue.popleft()
-                self._shed_count += 1
-                if not fut.done():
-                    fut.set_result(QueryResult(shed=True))
-        if self._obs is not None:
+            stragglers = list(self._queue) + self._inflight
+            self._queue.clear()
+            self._inflight = []
+        for entry in stragglers:
+            self._resolve_shed(entry[1], "closed")
+        if self._obs is not None and not self._summary_recorded:
+            # once per lifetime: close() is idempotent and must not emit
+            # duplicate serve_latency records on repeated calls
+            self._summary_recorded = True
             self._obs.record("serve_latency", self.latency_summary())
 
     def __enter__(self) -> "LinkageService":
@@ -133,50 +243,135 @@ class LinkageService:
 
     # -- submission -----------------------------------------------------
 
-    def submit(self, record: dict) -> Future:
-        """Enqueue one query record; never raises. Over ``queue_depth``
-        waiting records — or after :meth:`close` (no worker will ever
-        drain the queue again) — the request is shed: the future resolves
-        immediately with ``shed=True`` and a degradation event is
-        emitted."""
+    def submit(self, record: dict, deadline_ms: float | None = None) -> Future:
+        """Enqueue one query record; never raises. Sheds immediately
+        (future resolves ``shed=True`` + degradation event) when the
+        service is closed, the bounded queue is full, or ``deadline_ms``
+        is given and the estimated queue wait already exceeds it
+        (reject-early admission, module docstring). A queued request's
+        ``deadline_ms`` also rides into the batcher: lapsed requests are
+        shed at dispatch, never scored late."""
         fut: Future = Future()
+        reason = None
         with self._nonempty:
             closed = self._stop and self._thread is None
-            if closed or len(self._queue) >= self.queue_depth:
-                self._shed_count += 1
-                shed_total = self._shed_count
-                fut.set_result(QueryResult(shed=True))
-                reason = (
-                    "service is closed; submissions resolve shed"
-                    if closed
-                    else f"bounded queue full ({self.queue_depth} waiting); "
+            if closed:
+                reason = "closed"
+                reason_text = "service is closed; submissions resolve shed"
+            elif len(self._queue) >= self.queue_depth:
+                reason = "queue_full"
+                reason_text = (
+                    f"bounded queue full ({self.queue_depth} waiting); "
                     "shedding instead of growing without bound"
                 )
+            elif deadline_ms is not None:
+                est = self._admission.estimate_wait_ms(
+                    len(self._queue),
+                    self.engine.policy.max_batch,
+                    self.deadline_ms,
+                    inflight_batches=1 if self._inflight else 0,
+                )
+                if est > deadline_ms:
+                    reason = "deadline"
+                    reason_text = (
+                        f"estimated queue wait {est:.1f}ms exceeds the "
+                        f"request deadline {deadline_ms:.1f}ms; rejected at "
+                        "admission instead of timing out in the queue"
+                    )
+            if reason is not None:
+                self._shed_count += 1
+                shed_total = self._shed_count
+                fut.set_result(QueryResult(shed=True, reason=reason))
             else:
-                self._queue.append((record, fut, time.monotonic()))
+                deadline = (
+                    None
+                    if deadline_ms is None
+                    else time.monotonic() + deadline_ms / 1000.0
+                )
+                self._queue.append((record, fut, time.monotonic(), deadline))
                 self._nonempty.notify()
                 return fut
         # outside the lock: warn_degraded publishes + warns, both of which
         # may run user hooks
-        warn_degraded("serve_queue", "shed", reason, shed_total=shed_total)
+        warn_degraded(
+            "serve_admission" if reason == "deadline" else "serve_queue",
+            "shed",
+            reason_text,
+            shed_total=shed_total,
+        )
         return fut
 
-    def query(self, record: dict, timeout: float | None = None) -> QueryResult:
-        """Submit one record and wait for its result."""
-        return self.submit(record).result(timeout=timeout)
+    def query(
+        self,
+        record: dict,
+        timeout: float | None = None,
+        deadline_ms: float | None = None,
+    ) -> QueryResult:
+        """Submit one record and wait for its result. A ``timeout`` that
+        expires CANCELS the request: it is removed from the queue (a
+        timed-out request used to stay queued and get scored anyway),
+        counted shed (reason ``timeout``) and the degradation event is
+        emitted — unless its real result won the race, which is returned."""
+        fut = self.submit(record, deadline_ms=deadline_ms)
+        try:
+            return fut.result(timeout=timeout)
+        except FuturesTimeout:
+            return self._cancel_timed_out(fut, timeout)
+
+    def _cancel_timed_out(self, fut: Future, timeout) -> QueryResult:
+        with self._nonempty:
+            for i, entry in enumerate(self._queue):
+                if entry[1] is fut:
+                    del self._queue[i]
+                    break
+        res = QueryResult(shed=True, reason="timeout")
+        won = False
+        if not fut.done():
+            try:
+                fut.set_result(res)
+                won = True
+            except InvalidStateError:  # the worker resolved it first
+                pass
+        if not won:
+            return fut.result(timeout=0)
+        with self._lock:
+            self._shed_count += 1
+            self._timeouts += 1
+        warn_degraded(
+            "serve_timeout",
+            "shed",
+            f"request result not ready within its {timeout}s timeout; "
+            "cancelled (dequeued) and counted shed",
+            timeout_s=timeout,
+        )
+        return res
 
     # -- worker ---------------------------------------------------------
 
     def _worker(self) -> None:
-        while True:
-            batch = self._take_batch()
-            if batch is None:
-                return
-            self._serve_batch(batch)
+        try:
+            while True:
+                # fault site OUTSIDE the batch try-block: a raise here
+                # kills the worker thread — the failure mode the watchdog
+                # recovers from (resilience/faults.py SERVE_SITES)
+                active_plan(self._settings).fire(
+                    "serve_worker", batch=self._batches
+                )
+                batch = self._take_batch()
+                if batch is None:
+                    return
+                self._serve_batch(batch)
+        except Exception:  # noqa: BLE001 - a dying worker must not spam stderr
+            logger.exception(
+                "serve worker thread died; the watchdog will shed its "
+                "orphaned requests and restart it"
+            )
 
     def _take_batch(self):
         """Block until work exists, then coalesce until the deadline (from
-        the FIRST waiting record) or a full largest bucket."""
+        the FIRST waiting record) or a full largest bucket. The taken
+        entries are tracked as in-flight so a worker death cannot orphan
+        them past the watchdog."""
         max_batch = self.engine.policy.max_batch
         with self._nonempty:
             while not self._queue:
@@ -189,42 +384,219 @@ class LinkageService:
                 if remaining <= 0:
                     break
                 self._nonempty.wait(timeout=remaining)
+            # pressure is measured BEFORE the take: a large coalesced batch
+            # drains the queue, which must not hide the pressure it is
+            # itself the evidence of (the brown-out decision reads this)
+            self._take_fill = len(self._queue) / self.queue_depth
             take = min(len(self._queue), max_batch)
-            return [self._queue.popleft() for _ in range(take)]
+            batch = [self._queue.popleft() for _ in range(take)]
+            self._inflight = batch
+            return batch
+
+    def _clear_inflight(self) -> None:
+        with self._lock:
+            self._inflight = []
+
+    def _resolve_shed(self, fut: Future, reason: str) -> bool:
+        """Resolve one future shed (if still unresolved) and count it."""
+        if fut.done():
+            return False
+        try:
+            fut.set_result(QueryResult(shed=True, reason=reason))
+        except InvalidStateError:  # lost a resolution race
+            return False
+        with self._lock:
+            self._shed_count += 1
+        return True
 
     def _serve_batch(self, batch) -> None:
         import pandas as pd
 
-        records = [b[0] for b in batch]
-        futures = [b[1] for b in batch]
-        t_enq = [b[2] for b in batch]
+        now = time.monotonic()
+        live, expired = [], 0
+        for entry in batch:
+            fut = entry[1]
+            if fut.done():  # cancelled on timeout; already counted
+                continue
+            dl = entry[3]
+            if dl is not None and now > dl:
+                self._resolve_shed(fut, "deadline")
+                expired += 1
+                continue
+            live.append(entry)
+        if expired:
+            warn_degraded(
+                "serve_deadline",
+                "shed",
+                f"{expired} request(s) exceeded their deadline waiting in "
+                "the queue; shed at dispatch instead of scored late",
+                expired=expired,
+            )
+        if not live:
+            self._clear_inflight()
+            return
+        if self.breaker.should_fail_fast():
+            for entry in live:
+                self._resolve_shed(entry[1], "breaker_open")
+            warn_degraded(
+                "serve_breaker",
+                "shed",
+                f"circuit breaker open ({self.breaker.threshold} "
+                "consecutive batch failures); failing fast until a "
+                "recovery probe succeeds",
+                requests=len(live),
+            )
+            self._clear_inflight()
+            return
+        q_fill = self._take_fill
+        degraded = brownout_active(
+            q_fill,
+            self._health.state,
+            enabled=self.brownout_enabled,
+            fill_threshold=self.brownout_fill,
+        )
+        self._note_brownout(degraded, q_fill)
+        records = [e[0] for e in live]
+        futures = [e[1] for e in live]
+        t_enq = [e[2] for e in live]
+        t0 = time.perf_counter()
         try:
+            active_plan(self._settings).fire(
+                "serve_batch", batch=self._batches
+            )
             df = pd.DataFrame.from_records(records)
             if self._obs is not None:
-                with self._obs.span("serve_batch", batch=len(batch)):
-                    results = self._score(df)
+                with self._obs.span(
+                    "serve_batch", batch=len(live), degraded=degraded
+                ):
+                    results = self._score(df, degraded)
             else:
-                results = self._score(df)
+                results = self._score(df, degraded)
         except Exception as e:  # noqa: BLE001 - one bad batch must not kill the loop
-            logger.exception("serve batch failed")
+            logger.exception("serve batch failed; shedding %d request(s)",
+                             len(live))
+            opened = self.breaker.on_failure()
             for fut in futures:
-                if not fut.done():
-                    fut.set_exception(e)
+                self._resolve_shed(fut, "batch_error")
+            warn_degraded(
+                "serve_batch",
+                "shed",
+                f"batch scoring failed ({type(e).__name__}: {e}); "
+                f"{len(live)} request(s) resolved shed, no exception "
+                "escapes to callers",
+                requests=len(live),
+            )
+            if opened:
+                warn_degraded(
+                    "serve_engine",
+                    "breaker_open",
+                    f"{self.breaker.threshold} consecutive batch failures; "
+                    "failing fast while probes test recovery",
+                    cooldown_s=self.breaker.cooldown_s,
+                )
+            self._clear_inflight()
             return
+        batch_ms = (time.perf_counter() - t0) * 1000.0
+        if self.breaker.on_success():
+            from ..obs.events import publish
+
+            publish("breaker", state="closed", reason="probe batch succeeded")
+            logger.info("serve circuit breaker closed: probe batch succeeded")
+        self._admission.observe(batch_ms)
         now = time.monotonic()
-        self._batches += 1
+        # deliver first, count after: a request cancelled by
+        # query(timeout=) mid-score was already counted shed there —
+        # counting it served too would make served+shed exceed
+        # submissions and skew the health monitor's shed-rate window
+        delivered = []
         for i, fut in enumerate(futures):
             res = results[i]
+            res.degraded = degraded
             res.latency_ms = (now - t_enq[i]) * 1000.0
-            self._latencies.append(res.latency_ms)
-            self._served += 1
+            if fut.done():
+                continue
+            try:
+                fut.set_result(res)
+            except InvalidStateError:  # timed out in the same instant
+                continue
+            delivered.append(res)
             if self._obs is not None:
                 self._obs.observe("serve_latency_ms", res.latency_ms)
-            if not fut.done():
-                fut.set_result(res)
+        # counters AND latency deques under the lock: _health_signals
+        # list()s the deques concurrently, and deque iteration raises on
+        # mutation mid-iteration
+        with self._lock:
+            self._batches += 1
+            first_batch = self._batches == 1
+            self._served += len(delivered)
+            if degraded:
+                self._degraded_served += len(delivered)
+            for res in delivered:
+                self._latencies.append(res.latency_ms)
+                self._recent_lat.append(res.latency_ms)
+        if first_batch:
+            # re-baseline compile-stall detection at first traffic: an
+            # engine warmed AFTER service construction must not read as a
+            # steady-state compile stall (stall means compiles while
+            # serving, not before it)
+            from ..obs.metrics import compile_totals
 
-    def _score(self, df) -> list[QueryResult]:
-        top_p, top_rows, top_valid, n_cand = self.engine.query_arrays(df)
+            with self._signals_lock:
+                self._last_compile_s = compile_totals()[1]
+                self._stall_accum = 0.0
+        self._clear_inflight()
+        if (
+            self._probe_queries
+            and not degraded
+            and self.engine.probe_count == 0
+        ):
+            # seed the hot-swap parity probe set from live traffic:
+            # accumulate full-service records across batches until the
+            # probe budget is met (a single small batch must not leave a
+            # one-probe parity set), then capture once; best-effort.
+            # capture_probes deliberately RE-SCORES the set as one batch
+            # (one extra dispatch, once per lifetime): the stored answers
+            # then come from exactly the single-batch scoring the swap
+            # replay performs, not rows stitched from differently-shaped
+            # batches
+            need = self._probe_queries - len(self._probe_buffer)
+            if need > 0:
+                self._probe_buffer.extend(records[:need])
+            if len(self._probe_buffer) >= self._probe_queries:
+                try:
+                    self.engine.capture_probes(
+                        pd.DataFrame.from_records(self._probe_buffer)
+                    )
+                except Exception as e:  # noqa: BLE001 - probes must not break serving
+                    logger.debug("probe capture failed: %s", e)
+                self._probe_buffer = []
+
+    def _note_brownout(self, active: bool, q_fill: float) -> None:
+        if active == self._brownout_active:
+            return
+        self._brownout_active = active
+        from ..obs.events import publish
+
+        if active:
+            with self._lock:
+                self._brownout_episodes += 1
+            warn_degraded(
+                "serve_brownout",
+                "active",
+                f"pressure (queue {q_fill:.0%} full, health "
+                f"{self._health.state}); serving budgeted top-"
+                f"{self.engine.brownout_top_k} answers instead of shedding",
+                queue_fill=round(q_fill, 3),
+            )
+        else:
+            publish("brownout_end", queue_fill=round(q_fill, 3))
+            logger.info("serve brown-out ended (queue %.0f%% full)",
+                        q_fill * 100)
+
+    def _score(self, df, degraded: bool = False) -> list[QueryResult]:
+        top_p, top_rows, top_valid, n_cand = self.engine.query_arrays(
+            df, degraded=degraded
+        )
         uids = self.engine.index.unique_id
         out = []
         for i in range(len(df)):
@@ -238,18 +610,193 @@ class LinkageService:
             )
         return out
 
+    # -- watchdog -------------------------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        while not self._watchdog_stop.wait(self.watchdog_interval_s):
+            try:
+                self._watchdog_tick()
+            except Exception as e:  # noqa: BLE001 - the supervisor must survive
+                logger.warning("serve watchdog tick failed: %s", e)
+
+    def _watchdog_tick(self) -> None:
+        from ..obs.events import publish
+
+        # 1. dead-worker recovery: shed orphans, restart, emit events
+        orphans = None
+        with self._nonempty:
+            t = self._thread
+            if t is not None and not t.is_alive() and not self._stop:
+                orphans = self._inflight + list(self._queue)
+                self._inflight = []
+                self._queue.clear()
+                self._worker_crashes += 1
+                crashes = self._worker_crashes
+                self._thread = threading.Thread(
+                    target=self._worker, name="splink-serve", daemon=True
+                )
+                self._thread.start()
+        if orphans is not None:
+            n = sum(
+                self._resolve_shed(entry[1], "worker_restart")
+                for entry in orphans
+            )
+            publish("serve_worker_restart", orphaned=n, crashes=crashes)
+            warn_degraded(
+                "serve_worker",
+                "restarted",
+                f"worker thread died; {n} orphaned request(s) resolved "
+                "shed and the worker was restarted",
+                orphaned=n,
+                crashes=crashes,
+            )
+        # 2. breaker recovery probe when traffic has stopped
+        if self.breaker.probe_due():
+            with self._lock:
+                idle = not self._queue and not self._inflight
+            if idle:
+                try:
+                    self.engine.probe()
+                except Exception as e:  # noqa: BLE001 - a failed probe re-opens
+                    self.breaker.on_failure()
+                    logger.warning("breaker recovery probe failed: %s", e)
+                else:
+                    if self.breaker.on_success():
+                        publish(
+                            "breaker",
+                            state="closed",
+                            reason="watchdog probe succeeded",
+                        )
+                        logger.info(
+                            "serve circuit breaker closed: watchdog probe "
+                            "succeeded"
+                        )
+        # 3. health evaluation from live signals
+        self._maybe_evaluate_health()
+
+    # -- health ---------------------------------------------------------
+
+    def _health_signals(self) -> dict:
+        from ..obs.metrics import compile_totals
+
+        with self._lock:
+            served, shed = self._served, self._shed_count
+            q_fill = (
+                len(self._queue) / self.queue_depth if self.queue_depth else 0.0
+            )
+            worker = self._thread
+            alive = worker is not None and worker.is_alive()
+            brownout = self._brownout_active
+            recent = list(self._recent_lat)
+            swapping = self._swap_in_progress
+        _, c_secs = compile_totals()
+        # the window marks are shared state consumed by BOTH the watchdog
+        # tick and on-demand health() calls: the read-update must be
+        # atomic, and compile-stall detection accumulates across windows
+        # so a real stall cannot hide in the slivers concurrent pollers
+        # split the window into (a compile-free window clears it)
+        with self._signals_lock:
+            d_served = served - self._hw_served
+            d_shed = shed - self._hw_shed
+            self._hw_served, self._hw_shed = served, shed
+            delta_c = c_secs - self._last_compile_s
+            self._last_compile_s = c_secs
+            if swapping or delta_c <= 0:
+                self._stall_accum = 0.0
+            else:
+                self._stall_accum += delta_c
+            stall = self._stall_accum > self.compile_stall_s
+        total = d_served + d_shed
+        shed_rate = (d_shed / total) if total else 0.0
+        p95 = (
+            float(np.percentile(np.asarray(recent, np.float64), 95))
+            if recent
+            else None
+        )
+        return {
+            "worker_alive": alive,
+            "breaker": self.breaker.state,
+            "queue_fill": round(q_fill, 4),
+            "shed_rate": round(shed_rate, 4),
+            "p95_ms": p95,
+            "compile_stall": stall,
+            "brownout": brownout,
+        }
+
+    def _maybe_evaluate_health(self) -> None:
+        """Advance the health state machine at most once per watchdog
+        interval: ``recover_ticks`` hysteresis is calibrated to that
+        cadence, and a fast external poller must not inflate the recovery
+        streak (or starve the shed-rate window)."""
+        now = time.monotonic()
+        with self._signals_lock:
+            if now - self._last_health_eval < self.watchdog_interval_s:
+                return
+            self._last_health_eval = now
+        self._health.evaluate(self._health_signals())
+
+    def health(self) -> dict:
+        """The replica's live health: advances the state machine (rate-
+        limited to the watchdog cadence — polling cannot defeat the
+        recovery hysteresis) and returns its snapshot plus breaker/engine
+        context (the endpoint the :class:`~.router.ReplicaRouter` routes
+        on)."""
+        self._maybe_evaluate_health()
+        snap = self._health.snapshot()
+        snap["breaker"] = self.breaker.snapshot()
+        snap["generation"] = self.engine.generation
+        snap["worker_crashes"] = self._worker_crashes
+        snap["brownout_episodes"] = self._brownout_episodes
+        return snap
+
+    @property
+    def health_state(self) -> str:
+        """Current state WITHOUT re-evaluating (router fast path)."""
+        return self._health.state
+
+    # -- index hot-swap -------------------------------------------------
+
+    def swap_index(self, source, *, refresh_probes: bool = False) -> dict:
+        """Hot-swap the engine's index (see
+        :meth:`~.engine.QueryEngine.swap_index`): validation and pre-warm
+        happen while this service KEEPS SERVING the old index; the flip is
+        atomic and in-flight batches drain on the old index. The swap's
+        own compiles are excluded from the health monitor's compile-stall
+        signal."""
+        from ..obs.metrics import compile_totals
+
+        self._swap_in_progress = True
+        try:
+            return self.engine.swap_index(source, refresh_probes=refresh_probes)
+        finally:
+            self._swap_in_progress = False
+            with self._signals_lock:
+                self._last_compile_s = compile_totals()[1]
+                self._stall_accum = 0.0
+
     # -- reporting ------------------------------------------------------
 
     def latency_summary(self) -> dict:
-        """p50/p95/p99 request latency (ms), counts and throughput over the
-        service's lifetime."""
-        lats = np.asarray(self._latencies, np.float64)
+        """p50/p95/p99 request latency (ms), counts, throughput and the
+        resilience counters over the service's lifetime."""
+        # snapshot under the lock: the worker appends concurrently and
+        # deque iteration raises on mutation
+        with self._lock:
+            lats = np.asarray(self._latencies, np.float64)
         elapsed = max(time.monotonic() - self._t_start, 1e-9)
         out = {
             "served": self._served,
             "shed": self._shed_count,
             "batches": self._batches,
             "queries_per_sec": self._served / elapsed,
+            "degraded_served": self._degraded_served,
+            "timeouts": self._timeouts,
+            "brownout_episodes": self._brownout_episodes,
+            "worker_crashes": self._worker_crashes,
+            "breaker_state": self.breaker.state,
+            "breaker_opened_total": self.breaker.opened_total,
+            "health": self._health.state,
+            "index_generation": self.engine.generation,
         }
         if len(lats):
             p50, p95, p99 = np.percentile(lats, [50, 95, 99])

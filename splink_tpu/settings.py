@@ -73,6 +73,10 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "serve_queue_depth",
     "serve_deadline_ms",
     "serve_top_k",
+    "serve_brownout_top_k",
+    "serve_breaker_threshold",
+    "serve_hedge_ms",
+    "serve_probe_queries",
 ]
 
 

@@ -1,0 +1,710 @@
+"""Serving-tier resilience (splink_tpu/serve/ health/admission/router +
+service watchdog + index hot-swap).
+
+Unit tiers (no jax): the circuit breaker, the wait estimator, the health
+state machine's classification and hysteresis, the slow fault kind, and
+the replica router driven by duck-typed fake replicas (deterministic
+failover/hedging without timing on real engines).
+
+Service tiers (one module-scoped trained fixture): the query-timeout
+cancellation regression, lifecycle races (submit vs close, double close,
+start after close), watchdog worker-crash recovery, deadline admission,
+the brown-out tier's budget + zero-recompile contract, the health
+endpoint, and hot-swap parity/rollback. Every test asserts the core
+contract: no future hangs, no exception escapes through a future.
+"""
+
+import threading
+import time
+import warnings
+from concurrent.futures import Future
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu import Splink
+from splink_tpu.resilience import faults
+from splink_tpu.serve import (
+    BROKEN,
+    DEGRADED,
+    HEALTHY,
+    BucketPolicy,
+    CircuitBreaker,
+    HealthMonitor,
+    IndexSwapError,
+    LinkageService,
+    QueryEngine,
+    QueryResult,
+    ReplicaRouter,
+    WaitEstimator,
+    build_index,
+)
+from splink_tpu.utils.logging_utils import DegradationWarning
+
+WAIT = 30  # "never hangs" budget per future
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: admission primitives
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    b = CircuitBreaker(threshold=2, cooldown_s=0.05)
+    assert b.state == "closed" and not b.should_fail_fast()
+    assert not b.on_failure()
+    assert b.state == "closed"  # below threshold
+    assert b.on_failure()  # second consecutive failure opens
+    assert b.state == "open" and b.should_fail_fast()
+    time.sleep(0.06)
+    assert b.probe_due()
+    assert not b.should_fail_fast()  # post-cooldown caller is the probe
+    assert b.state == "half_open"
+    assert b.on_failure()  # failed probe re-opens with a fresh cooldown
+    assert b.state == "open" and b.should_fail_fast()
+    time.sleep(0.06)
+    assert not b.should_fail_fast()
+    assert b.on_success()  # successful probe closes
+    assert b.state == "closed" and b.opened_total == 2
+    assert not b.on_success()  # already closed: not a recovery
+
+
+def test_breaker_threshold_validated():
+    with pytest.raises(ValueError, match="threshold"):
+        CircuitBreaker(threshold=0)
+
+
+def test_wait_estimator_ewma_and_estimate():
+    w = WaitEstimator()
+    # cold: no made-up batch time, only the coalescing window
+    assert w.estimate_wait_ms(0, 16, 5.0) == 5.0
+    w.observe(40.0)
+    assert w.batch_ms == 40.0
+    # 31 queued ahead + self = 2 batches of 16
+    assert w.estimate_wait_ms(31, 16, 5.0) == pytest.approx(5.0 + 2 * 40.0)
+    w.observe(80.0)  # EWMA moves toward the new sample
+    assert 40.0 < w.batch_ms < 80.0
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: health state machine
+# ---------------------------------------------------------------------------
+
+
+def _healthy_signals(**over):
+    s = {
+        "worker_alive": True,
+        "breaker": "closed",
+        "queue_fill": 0.0,
+        "shed_rate": 0.0,
+        "p95_ms": 5.0,
+        "compile_stall": False,
+        "brownout": False,
+    }
+    s.update(over)
+    return s
+
+
+def test_health_classification_levels():
+    m = HealthMonitor()
+    assert m.classify(_healthy_signals())[0] == HEALTHY
+    for broken in (
+        {"worker_alive": False},
+        {"breaker": "open"},
+        {"shed_rate": 0.9},
+    ):
+        assert m.classify(_healthy_signals(**broken))[0] == BROKEN, broken
+    for degraded in (
+        {"breaker": "half_open"},
+        {"shed_rate": 0.1},
+        {"queue_fill": 0.8},
+        {"compile_stall": True},
+    ):
+        lvl, reasons = m.classify(_healthy_signals(**degraded))
+        assert lvl == DEGRADED and reasons, degraded
+    # brown-out is informational, never classified: it is an OUTPUT of
+    # pressure and classifying it would self-sustain the degraded state
+    assert m.classify(_healthy_signals(brownout=True))[0] == HEALTHY
+
+
+def test_health_hysteresis_down_fast_up_slow():
+    m = HealthMonitor(recover_ticks=2)
+    assert m.evaluate(_healthy_signals()) == HEALTHY
+    # worsening is immediate
+    assert m.evaluate(_healthy_signals(worker_alive=False)) == BROKEN
+    # recovery needs recover_ticks consecutive better evaluations, and
+    # climbs ONE level per satisfied streak
+    assert m.evaluate(_healthy_signals()) == BROKEN
+    assert m.evaluate(_healthy_signals()) == DEGRADED
+    assert m.evaluate(_healthy_signals()) == DEGRADED
+    assert m.evaluate(_healthy_signals()) == HEALTHY
+    snap = m.snapshot()
+    assert snap["transitions"] == 3 and snap["state"] == HEALTHY
+
+
+def test_health_transition_publishes_event():
+    from splink_tpu.obs import events
+
+    captured = []
+
+    class _Sink:
+        def emit(self, kind, **fields):
+            captured.append((kind, fields))
+
+    sink = _Sink()
+    events.register_ambient(sink)
+    try:
+        m = HealthMonitor(name="r7")
+        m.evaluate(_healthy_signals())
+        m.evaluate(_healthy_signals(breaker="open"))
+    finally:
+        events.unregister_ambient(sink)
+    health = [f for k, f in captured if k == "health"]
+    assert health and health[0]["replica"] == "r7"
+    assert health[0]["from"] == HEALTHY and health[0]["to"] == BROKEN
+    assert any("breaker" in r for r in health[0]["reasons"])
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: slow fault kind
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_slow_kind_stalls_then_exhausts():
+    plan = faults.FaultPlan.from_spec("svc@kind=slow:delay_ms=60")
+    t0 = time.monotonic()
+    plan.fire("svc")  # stalls, does not raise
+    assert time.monotonic() - t0 >= 0.05
+    t0 = time.monotonic()
+    plan.fire("svc")  # budget exhausted: no-op
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_fault_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="kind"):
+        faults.FaultPlan.from_spec("svc@kind=sluggish")
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: replica router over duck-typed fakes
+# ---------------------------------------------------------------------------
+
+
+class FakeReplica:
+    """Duck-typed replica: resolves with a result naming itself, after an
+    optional delay, or sheds."""
+
+    def __init__(self, name, state=HEALTHY, delay_s=0.0, shed_reason=None):
+        self.name = name
+        self.state = state
+        self.delay_s = delay_s
+        self.shed_reason = shed_reason
+        self.submissions = 0
+
+    @property
+    def health_state(self):
+        return self.state
+
+    def health(self):
+        return {"state": self.state, "replica": self.name}
+
+    def latency_summary(self):
+        return {"p95_ms": 10.0}
+
+    def _result(self):
+        if self.shed_reason:
+            return QueryResult(shed=True, reason=self.shed_reason)
+        return QueryResult(matches=[(self.name, 1.0)], n_candidates=1)
+
+    def submit(self, record, deadline_ms=None):
+        self.submissions += 1
+        fut = Future()
+        if self.delay_s:
+            t = threading.Timer(self.delay_s, fut.set_result, [self._result()])
+            t.daemon = True
+            t.start()
+        else:
+            fut.set_result(self._result())
+        return fut
+
+
+def test_router_routes_around_broken_replica():
+    a = FakeReplica("a", state=BROKEN)
+    b = FakeReplica("b")
+    router = ReplicaRouter([a, b], hedge_ms=0)
+    for _ in range(4):
+        res = router.query({"x": 1}, timeout=WAIT)
+        assert res.matches[0][0] == "b"
+    assert a.submissions == 0  # healthy replica absorbs all traffic
+
+
+def test_router_fails_over_on_shed():
+    a = FakeReplica("a", shed_reason="closed")
+    b = FakeReplica("b", state=DEGRADED)  # ranked after a, still tried
+    router = ReplicaRouter([a, b], hedge_ms=0)
+    res = router.query({"x": 1}, timeout=WAIT)
+    assert not res.shed and res.matches[0][0] == "b"
+    assert router.failovers == 1
+
+
+def test_router_all_shed_resolves_shed():
+    a = FakeReplica("a", shed_reason="queue_full")
+    b = FakeReplica("b", shed_reason="breaker_open")
+    router = ReplicaRouter([a, b], hedge_ms=0)
+    res = router.query({"x": 1}, timeout=WAIT)
+    assert res.shed and res.reason in ("queue_full", "breaker_open")
+    assert a.submissions == 1 and b.submissions == 1
+
+
+def test_router_hedges_slow_primary():
+    a = FakeReplica("a", delay_s=0.8)
+    b = FakeReplica("b", delay_s=0.0)
+    router = ReplicaRouter([a, b], hedge_ms=40)
+    # pin the rotation so the slow replica is primary
+    router._rr = 0
+    t0 = time.monotonic()
+    res = router.query({"x": 1}, timeout=WAIT)
+    elapsed = time.monotonic() - t0
+    assert res.matches[0][0] == "b"
+    assert elapsed < 0.6, "hedge must beat the slow primary"
+    assert router.hedges == 1 and router.hedge_wins == 1
+
+
+def test_router_hedge_disabled_waits_for_primary():
+    a = FakeReplica("a", delay_s=0.15)
+    b = FakeReplica("b")
+    router = ReplicaRouter([a, b], hedge_ms=0)
+    router._rr = 0
+    res = router.query({"x": 1}, timeout=WAIT)
+    assert res.matches[0][0] == "a"
+    assert router.hedges == 0 and b.submissions == 0
+
+
+def test_router_p95_derived_hedge_delay():
+    a = FakeReplica("a")
+    router = ReplicaRouter([a, FakeReplica("b")], hedge_ms="p95")
+    # p95 10ms -> floored to the default 20ms
+    assert router._hedge_delay_ms(a) == 20.0
+    assert ReplicaRouter([a], hedge_ms=50)._hedge_delay_ms(a) is None
+
+
+# ---------------------------------------------------------------------------
+# Service tier: one trained fixture
+# ---------------------------------------------------------------------------
+
+
+def people_df(n=80, seed=13):
+    rng = np.random.default_rng(seed)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    return pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+
+
+def resilience_settings(**over):
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.surname = r.surname"],
+        "max_iterations": 3,
+        "serve_top_k": 16,
+        "serve_brownout_top_k": 2,
+        "serve_breaker_threshold": 2,
+        "serve_probe_queries": 4,
+    }
+    s.update(over)
+    return s
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """(df, linker, index): one trained linker + frozen index shared
+    across the module (training dominates the suite's cost)."""
+    df = people_df()
+    linker = Splink(resilience_settings(), df=df)
+    linker.estimate_parameters()
+    index = linker.export_index()
+    return df, linker, index
+
+
+@pytest.fixture(scope="module")
+def engine(trained):
+    _, _, index = trained
+    eng = QueryEngine(index, policy=BucketPolicy((16,), (64, 256)))
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture()
+def clean_faults(monkeypatch):
+    """Reset fault-plan budgets around each injection test."""
+    faults.reset_plans()
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    yield monkeypatch
+    faults.reset_plans()
+
+
+def _service(engine, **over):
+    kw = dict(deadline_ms=2.0, watchdog_interval_s=0.02,
+              breaker_cooldown_s=0.2)
+    kw.update(over)
+    return LinkageService(engine, **kw)
+
+
+def test_warmup_covers_brownout_shapes(trained):
+    from splink_tpu.obs.metrics import compile_totals
+
+    _, _, index = trained
+    eng = QueryEngine(index, policy=BucketPolicy((16,), (64,)))
+    assert eng.brownout_top_k == 2 and eng.brownout_capacity == 64
+    stats = eng.warmup()
+    assert stats["combinations"] == 2  # 1 full-service + 1 brown-out shape
+    assert stats["compiles"] == 2
+    c0, _ = compile_totals()
+    df, _, _ = trained
+    eng.query_arrays(df.head(5))
+    eng.query_arrays(df.head(5), degraded=True)
+    c1, _ = compile_totals()
+    assert c1 - c0 == 0, "warmed brown-out episode must not recompile"
+
+
+def test_brownout_disabled_engine_rejects_degraded(trained):
+    _, _, index = trained
+    eng = QueryEngine(index, brownout_top_k=0,
+                      policy=BucketPolicy((16,), (64,)))
+    assert eng.warmup()["combinations"] == 1
+    with pytest.raises(RuntimeError, match="disabled"):
+        eng.query_arrays(people_df(4), degraded=True)
+
+
+def test_brownout_budget_validated(trained):
+    _, _, index = trained
+    with pytest.raises(ValueError, match="serve_brownout_top_k"):
+        QueryEngine(index, top_k=4, brownout_top_k=8,
+                    policy=BucketPolicy((16,), (64,)))
+
+
+def test_query_timeout_cancels_and_sheds(engine, trained, clean_faults):
+    """The satellite regression: a timed-out request must be CANCELLED —
+    dequeued, counted shed, degradation event — not scored anyway."""
+    df, _, _ = trained
+    clean_faults.setenv(
+        faults.ENV_VAR, "serve_batch@times=1:kind=slow:delay_ms=400"
+    )
+    svc = _service(engine, autostart=False)
+    filler = [svc.submit(r) for r in df.head(6).to_dict(orient="records")]
+    svc.start()
+    with pytest.warns(DegradationWarning, match="timeout"):
+        res = svc.query(df.iloc[10].to_dict(), timeout=0.1)
+    assert res.shed and res.reason == "timeout"
+    for f in filler:  # the stalled batch itself still serves
+        assert not f.result(timeout=WAIT).shed
+    with svc._nonempty:
+        assert not svc._queue, "the timed-out request must leave the queue"
+    summary = svc.latency_summary()
+    assert summary["timeouts"] == 1
+    res2 = svc.query(df.iloc[11].to_dict(), timeout=WAIT)
+    assert not res2.shed
+    svc.close()
+
+
+def test_submit_racing_close_never_hangs(engine, trained):
+    df, _, _ = trained
+    records = df.head(4).to_dict(orient="records")
+    futures: list = []
+    flock = threading.Lock()
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            fut = svc.submit(dict(records[0]))
+            with flock:
+                futures.append(fut)
+
+    svc = _service(engine)
+    threads = [threading.Thread(target=pound) for _ in range(4)]
+    with warnings.catch_warnings():
+        # every post-close submit degrades loudly (by design); thousands
+        # of identical warnings would drown the suite's warning summary
+        warnings.simplefilter("ignore", DegradationWarning)
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        svc.close()
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    with flock:
+        snapshot = list(futures)
+    assert snapshot
+    for f in snapshot:
+        res = f.result(timeout=WAIT)  # resolved served OR shed — never hung
+        assert isinstance(res, QueryResult)
+
+
+def test_double_close_and_start_after_close(engine, trained):
+    df, _, _ = trained
+    svc = _service(engine)
+    assert not svc.query(df.iloc[0].to_dict(), timeout=WAIT).shed
+    svc.close()
+    svc.close()  # idempotent
+    with pytest.warns(DegradationWarning, match="closed"):
+        res = svc.submit(df.iloc[1].to_dict()).result(timeout=WAIT)
+    assert res.shed and res.reason == "closed"
+    svc.start()  # clean reopen
+    assert not svc.query(df.iloc[2].to_dict(), timeout=WAIT).shed
+    svc.close()
+
+
+def test_worker_crash_watchdog_recovers(engine, trained, clean_faults):
+    """A dead worker must not hang a single future: the watchdog sheds
+    the orphans, restarts the thread, and serving resumes."""
+    from splink_tpu.obs import events
+
+    df, _, _ = trained
+    captured = []
+
+    class _Sink:
+        def emit(self, etype, **fields):
+            captured.append((etype, fields))
+
+    sink = _Sink()
+    events.register_ambient(sink)
+    clean_faults.setenv(faults.ENV_VAR, "serve_worker@batch=0")
+    try:
+        svc = _service(engine, autostart=False)
+        futures = [
+            svc.submit(r) for r in df.head(8).to_dict(orient="records")
+        ]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            svc.start()  # worker dies immediately at the injected site
+            results = [f.result(timeout=WAIT) for f in futures]
+            assert all(
+                r.shed and r.reason == "worker_restart" for r in results
+            )
+            deadline = time.monotonic() + WAIT
+            res = svc.query(df.iloc[0].to_dict(), timeout=WAIT)
+            assert not res.shed and time.monotonic() < deadline
+        summary = svc.latency_summary()
+        assert summary["worker_crashes"] == 1
+        svc.close()
+    finally:
+        events.unregister_ambient(sink)
+    kinds = {k for k, _ in captured}
+    assert "fault" in kinds and "serve_worker_restart" in kinds
+
+
+def test_breaker_opens_fails_fast_recovers(engine, trained, clean_faults):
+    df, _, _ = trained
+    clean_faults.setenv(faults.ENV_VAR, "serve_batch@times=2")
+    svc = _service(engine, autostart=False)
+    records = df.head(6).to_dict(orient="records")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        storm1 = [svc.submit(dict(r)) for r in records]
+        svc.start()
+        storm1 = [f.result(timeout=WAIT) for f in storm1]
+        storm2 = [svc.submit(dict(r)).result(timeout=WAIT) for r in records[:1]]
+        assert all(
+            r.shed and r.reason in ("batch_error", "breaker_open")
+            for r in storm1 + storm2
+        )
+        assert svc.breaker.state == "open"
+        fast = svc.submit(dict(records[0])).result(timeout=WAIT)
+        assert fast.shed and fast.reason == "breaker_open"
+        deadline = time.monotonic() + 10
+        while svc.breaker.state != "closed" and time.monotonic() < deadline:
+            time.sleep(0.02)  # the watchdog probe closes it post-cooldown
+        assert svc.breaker.state == "closed"
+        assert not svc.query(dict(records[0]), timeout=WAIT).shed
+    assert svc.latency_summary()["breaker_opened_total"] == 1
+    svc.close()
+
+
+def test_deadline_rejected_at_admission_and_at_dispatch(engine, trained):
+    df, _, _ = trained
+    svc = _service(engine, autostart=False)
+    svc._admission.observe(50.0)  # prime the wait model: 50ms/batch
+    ok = svc.submit(df.iloc[0].to_dict(), deadline_ms=1000.0)
+    with pytest.warns(DegradationWarning, match="deadline"):
+        rejected = svc.submit(df.iloc[1].to_dict(), deadline_ms=10.0)
+    res = rejected.result(timeout=WAIT)
+    assert res.shed and res.reason == "deadline"
+    # dispatch-time expiry: a deadline generous enough to pass admission
+    # (est ~52ms) but lapsed by the time the batcher dispatches it
+    lapsing = svc.submit(df.iloc[2].to_dict(), deadline_ms=60.0)
+    time.sleep(0.08)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        svc.start()
+        assert not ok.result(timeout=WAIT).shed
+        lapsed = lapsing.result(timeout=WAIT)
+    assert lapsed.shed and lapsed.reason == "deadline"
+    svc.close()
+
+
+def test_brownout_serves_degraded_without_recompiles(engine, trained):
+    from splink_tpu.obs.metrics import compile_totals
+
+    df, _, _ = trained
+    svc = _service(engine, autostart=False, queue_depth=16)
+    futures = [
+        svc.submit(r) for r in df.head(12).to_dict(orient="records")
+    ]  # 75% full at dispatch
+    c0, _ = compile_totals()
+    with pytest.warns(DegradationWarning, match="brown"):
+        svc.start()
+        results = [f.result(timeout=WAIT) for f in futures]
+    c1, _ = compile_totals()
+    assert all(not r.shed and r.degraded for r in results)
+    assert all(len(r.matches) <= engine.brownout_top_k for r in results)
+    assert c1 - c0 == 0, "a warmed brown-out episode must not recompile"
+    summary = svc.latency_summary()
+    assert summary["brownout_episodes"] == 1
+    assert summary["degraded_served"] == 12
+    svc.close()
+
+
+def test_health_endpoint_degrades_and_recovers(engine, trained):
+    df, _, _ = trained
+    monitor = HealthMonitor(name="t", recover_ticks=2)
+    svc = _service(engine, autostart=False, queue_depth=4,
+                   health_monitor=monitor)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        futures = [
+            svc.submit(r) for r in df.head(10).to_dict(orient="records")
+        ]
+        # first evaluation is always admitted: shed storm + dead worker
+        assert svc.health()["state"] == BROKEN
+        # polling faster than the watchdog cadence must NOT advance the
+        # state machine (the recovery hysteresis is poll-rate-independent)
+        svc.start()
+        for f in futures:
+            f.result(timeout=WAIT)
+        for _ in range(20):
+            assert svc.health_state in (BROKEN, DEGRADED, HEALTHY)
+        # the watchdog climbs one level per recover_ticks clean ticks
+        deadline = time.monotonic() + WAIT
+        while svc.health_state != HEALTHY and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert svc.health_state == HEALTHY
+    # healthy -> broken -> degraded -> healthy = 3 transitions (the climb
+    # passed through the intermediate level, one step per streak)
+    assert monitor.snapshot()["transitions"] == 3
+    time.sleep(0.05)  # past the rate-limit window
+    snap = svc.health()
+    assert snap["state"] == HEALTHY
+    assert snap["breaker"]["state"] == "closed"
+    assert snap["generation"] == 0
+    svc.close()
+    time.sleep(0.05)  # past the rate-limit window
+    assert svc.health()["state"] == BROKEN  # closed replica reports broken
+
+
+# ---------------------------------------------------------------------------
+# Index hot-swap: parity probes, rollback, drain
+# ---------------------------------------------------------------------------
+
+
+def test_hot_swap_parity_commit_and_rollbacks(trained, tmp_path, clean_faults):
+    from splink_tpu.obs.metrics import compile_totals
+
+    df, linker, index = trained
+    eng = QueryEngine(index, policy=BucketPolicy((16,), (64, 256)))
+    eng.warmup()
+    assert eng.capture_probes(df.head(6)) == 6
+    before = eng.query_arrays(df.head(20))
+
+    # commit: same content re-exported -> parity holds, generation bumps
+    path2 = tmp_path / "idx2"
+    linker.export_index(path2)
+    stats = eng.swap_index(path2)
+    assert stats["generation"] == 1 and stats["probes_checked"] == 6
+    c0, _ = compile_totals()
+    after = eng.query_arrays(df.head(20))
+    c1, _ = compile_totals()
+    assert c1 - c0 == 0, "post-swap steady state must not recompile"
+    for a, b in zip(before, after):
+        assert np.array_equal(a, b), "post-swap answers must be bit-identical"
+
+    # rollback: corrupted candidate artifact
+    import shutil
+
+    bad = tmp_path / "idx_bad"
+    shutil.copytree(path2, bad)
+    for p in bad.iterdir():
+        if p.suffix == ".npz":
+            payload = bytearray(p.read_bytes())
+            payload[len(payload) // 2] ^= 0xFF
+            p.write_bytes(bytes(payload))
+    with pytest.warns(DegradationWarning, match="rolled_back|load"):
+        with pytest.raises(IndexSwapError, match="load"):
+            eng.swap_index(bad)
+    assert eng.generation == 1
+    assert np.array_equal(eng.query_arrays(df.head(20))[0], after[0])
+
+    # rollback: injected validation failure
+    clean_faults.setenv(faults.ENV_VAR, "swap_validate@")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(IndexSwapError, match="injected"):
+            eng.swap_index(path2)
+    assert eng.generation == 1
+    clean_faults.delenv(faults.ENV_VAR)
+    faults.reset_plans()
+
+    # rollback: parity-failing candidate (different reference content),
+    # then refresh_probes commits the intentional change
+    other = Splink(resilience_settings(), df=df.head(50))
+    other_index = build_index(other)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(IndexSwapError, match="parity"):
+            eng.swap_index(other_index)
+        assert eng.generation == 1
+        stats = eng.swap_index(other_index, refresh_probes=True)
+    assert stats["generation"] == 2 and eng.index.n_rows == 50
+    p, _, v, _ = eng.query_arrays(df.head(10))
+    assert v.any(), "the refreshed index must keep serving"
+
+
+def test_swap_without_probes_commits_on_fingerprints(trained, tmp_path):
+    _, linker, index = trained
+    eng = QueryEngine(index, policy=BucketPolicy((16,), (64,)))
+    eng.warmup()
+    path = tmp_path / "idx"
+    linker.export_index(path)
+    stats = eng.swap_index(path)
+    assert stats["generation"] == 1 and stats["probes_checked"] == 0
+
+
+def test_service_auto_captures_probes_from_traffic(trained):
+    df, _, index = trained
+    eng = QueryEngine(index, policy=BucketPolicy((16,), (64, 256)))
+    eng.warmup()
+    svc = _service(eng, probe_queries=4, autostart=False)
+    futures = [svc.submit(r) for r in df.head(6).to_dict(orient="records")]
+    svc.start()  # one batch of 6: the first 4 become the probe set
+    for f in futures:
+        assert not f.result(timeout=WAIT).shed
+    deadline = time.monotonic() + WAIT
+    while eng.probe_count == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert eng.probe_count == 4
+    svc.close()

@@ -265,3 +265,39 @@ def test_telemetry_settings_flow_into_run_context(tmp_path):
     assert on._obs.memory_snapshots is False
     assert on._obs.sink.path.startswith(str(tmp_path))
     on._obs.close()
+
+
+def test_serve_resilience_defaults_filled():
+    """The serving-resilience keys complete from the schema: brown-out and
+    hedging OFF by default, breaker threshold 3, 16 parity probes."""
+    s = complete_settings_dict(_minimal())
+    assert s["serve_brownout_top_k"] == 0
+    assert s["serve_breaker_threshold"] == 3
+    assert s["serve_hedge_ms"] == 0
+    assert s["serve_probe_queries"] == 16
+
+
+def test_serve_resilience_key_types_validated():
+    """Type/bound violations on the resilience keys are rejected by the
+    schema validator, not silently served."""
+    for bad in (
+        {"serve_breaker_threshold": "3"},
+        {"serve_breaker_threshold": 0},
+        {"serve_brownout_top_k": -1},
+        {"serve_brownout_top_k": 2.5},
+        {"serve_hedge_ms": "fast"},
+        {"serve_hedge_ms": -5},
+        {"serve_probe_queries": -1},
+        {"serve_probe_queries": "many"},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    # valid values pass (hedge_ms is a number: floats allowed)
+    validate_settings(
+        _minimal(
+            serve_breaker_threshold=5,
+            serve_brownout_top_k=2,
+            serve_hedge_ms=12.5,
+            serve_probe_queries=0,
+        )
+    )
