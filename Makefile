@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -51,6 +51,13 @@ serve-smoke:
 chaos-smoke:
 	python scripts/chaos_smoke.py
 
+# Device-blocking smoke: device<->host pair-set parity (the host join is
+# the oracle) over sequential/null/asymmetric rules with budgeted chunked
+# emission, plus zero steady-state recompiles across chunk shapes
+# (docs/blocking.md).
+blocking-smoke:
+	python scripts/blocking_smoke.py
+
 bench:
 	python bench.py
 
@@ -58,4 +65,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke serve-smoke chaos-smoke bench
+all: lint test tpu-smoke blocking-smoke serve-smoke chaos-smoke bench

@@ -349,6 +349,122 @@ def bench_serve():
     }))
 
 
+def bench_blocking():
+    """Blocking-tier benchmark (`python bench.py blocking`): host join vs
+    the device-native candidate-generation tier over the same rules and
+    corpus, pairs/sec end to end through block_using_rules (sink
+    included). The device tier is measured twice: budgeted CHUNKED
+    emission (the production default — fixed-shape chunks under
+    blocking_chunk_pairs) and RESIDENT emission (one batch per rule, the
+    shape a single-pass consumer would drive). Warmup runs precede every
+    timed pass so steady state is what's measured; the compile counter
+    proves the chunk contract (steady state == ZERO recompiles)."""
+    tier = _probe_device_init()
+    import jax
+
+    from splink_tpu.blocking import block_using_rules
+    from splink_tpu.blocking_device import (
+        build_device_plan,
+        iter_device_pairs,
+    )
+    from splink_tpu.data import encode_table
+    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.settings import complete_settings_dict
+
+    install_compile_monitor()
+    n_rows = int(os.environ.get("SPLINK_TPU_BENCH_BLOCKING_ROWS", 1_000_000))
+    rng = np.random.default_rng(0)
+    df = _make_df(rng, n_rows)
+    settings = complete_settings_dict(
+        {
+            **{k: v for k, v in SETTINGS.items()},
+            # two rules: the ~16M-pair blk key plus a 3-column conjunction,
+            # so the sequential-rule dedup mask is on the measured path
+            "blocking_rules": [
+                "l.blk = r.blk",
+                "l.first_name = r.first_name and l.surname = r.surname "
+                "and l.city = r.city",
+            ],
+        }
+    )
+    table = encode_table(df, settings)
+
+    host_cfg = dict(settings)
+    host_cfg["device_blocking"] = "off"
+    t0 = time.perf_counter()
+    host_pairs = block_using_rules(host_cfg, table)
+    host_s = time.perf_counter() - t0
+    n_pairs = host_pairs.n_pairs
+    del host_pairs
+
+    dev_cfg = dict(settings)
+    dev_cfg["device_blocking"] = "on"
+    # warmup compiles the per-rule kernels (cached on nothing persistent
+    # across block_using_rules calls — so time the DRIVER level, where the
+    # plan's kernel cache persists, for the steady-state numbers)
+    t0 = time.perf_counter()
+    plan = build_device_plan(dev_cfg, table)
+    plan_s = time.perf_counter() - t0
+    if plan is None:
+        print(json.dumps({
+            "metric": "blocking_pairs_per_sec",
+            "value": round(n_pairs / host_s),
+            "unit": "pairs/sec",
+            "blocking_error": "device plan rejected",
+            "host_pairs_per_sec": round(n_pairs / host_s),
+            **tier,
+        }))
+        return
+    chunk = int(dev_cfg["blocking_chunk_pairs"])
+
+    def drive(budget):
+        total = 0
+        for _r, i, _j in iter_device_pairs(plan, budget):
+            total += len(i)
+        return total
+
+    drive(chunk)  # warmup: compiles every per-rule chunked kernel
+    c0, _ = compile_totals()
+    t0 = time.perf_counter()
+    emitted = drive(chunk)
+    chunked_s = time.perf_counter() - t0
+    c1, _ = compile_totals()
+    resident_budget = max(rp.total for rp in plan.rules)
+    drive(resident_budget)  # warmup the resident-shape kernels
+    t0 = time.perf_counter()
+    drive(resident_budget)
+    resident_s = time.perf_counter() - t0
+    # end-to-end through the sink (what a linker run pays)
+    t0 = time.perf_counter()
+    dev_pairs = block_using_rules(dev_cfg, table)
+    e2e_s = time.perf_counter() - t0
+    assert dev_pairs.n_pairs == n_pairs == emitted, (
+        n_pairs, emitted, dev_pairs.n_pairs,
+    )
+
+    print(json.dumps({
+        "metric": "blocking_pairs_per_sec",
+        "value": round(n_pairs / chunked_s),
+        "unit": "pairs/sec",
+        "n_rows": n_rows,
+        "n_pairs": n_pairs,
+        "candidates": plan.n_candidates,
+        "host_pairs_per_sec": round(n_pairs / host_s),
+        "host_seconds": round(host_s, 3),
+        "device_chunked_pairs_per_sec": round(n_pairs / chunked_s),
+        "device_chunked_seconds": round(chunked_s, 3),
+        "device_resident_pairs_per_sec": round(n_pairs / resident_s),
+        "device_resident_seconds": round(resident_s, 3),
+        "device_e2e_pairs_per_sec": round(n_pairs / e2e_s),
+        "plan_seconds": round(plan_s, 3),
+        "chunk_pairs": chunk,
+        "speedup_vs_host": round(host_s / chunked_s, 2),
+        "steady_state_recompiles": c1 - c0,
+        "device": str(jax.devices()[0]),
+        **tier,
+    }))
+
+
 def main():
     tier = _probe_device_init()
     import jax
@@ -583,5 +699,7 @@ def main():
 if __name__ == "__main__":
     if "serve" in sys.argv[1:]:
         bench_serve()
+    elif "blocking" in sys.argv[1:]:
+        bench_blocking()
     else:
         main()

@@ -847,6 +847,46 @@ def _ensure_default_registry() -> None:
         params = jax.device_put(params_small, rep)
         return fn, (packed_q, packed_ref, cand, valid, params), {}
 
+    # Device-blocking emission decode+mask body sharded over the pair-
+    # POSITION axis (the blocking analogue of the pair axis): the unit
+    # tables, ranks, codes and meta replicate, each shard decodes and
+    # masks its own slice of every chunk, outputs come back position-
+    # sharded. ZERO collectives — the compaction prefix-sum is
+    # deliberately single-device (the host compacts per shard in the
+    # mesh driver), so nothing here may force cross-device movement.
+    @register_shard_kernel("block_pair_decode_sharded", n_pairs=64)
+    def _build_block_pair_decode_sharded():
+        import jax
+        import numpy as np
+
+        from ..blocking_device import make_pair_emit_fn
+        from ..parallel.mesh import pair_sharding, replicated
+
+        mesh = audit_mesh()
+        bs = 64
+        fn = make_pair_emit_fn(
+            bs, n_prev=1, has_uid_mask=True, rank_filter=True, mesh=mesh
+        )
+        shard, rep = pair_sharding(mesh), replicated(mesh)
+        imax = np.int32(np.iinfo(np.int32).max)
+        pos = jax.device_put(np.arange(bs, dtype=np.int32), shard)
+        order = jax.device_put(np.arange(8, dtype=np.int32), rep)
+        units = jax.device_put(np.zeros(4, np.int32), rep)
+        lens = jax.device_put(np.full(4, 3, np.int32), rep)
+        ranks = jax.device_put(np.arange(8, dtype=np.int32), rep)
+        prev_l = jax.device_put(np.zeros((1, 8), np.int32), rep)
+        prev_r = jax.device_put(np.zeros((1, 8), np.int32), rep)
+        uid = jax.device_put(np.zeros(8, np.int32), rep)
+        meta = jax.device_put(
+            np.array([0, bs, 0, imax, imax, imax], np.int32), rep
+        )
+        return (
+            fn,
+            (pos, order, units, lens, units, lens, ranks, prev_l, prev_r,
+             uid, (), meta),
+            {},
+        )
+
     # String similarity is per-pair elementwise: zero collectives, output
     # sharded.
     @register_shard_kernel("jaro_winkler_sharded", n_pairs=64)

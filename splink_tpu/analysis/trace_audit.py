@@ -645,6 +645,77 @@ def _ensure_default_registry() -> None:
         # no-embedded-constant design TA-CONST pins for gamma_batch
         return fn, (packed_q, program._packed, cand, valid, params), {}
 
+    # ----- device-native blocking (splink_tpu/blocking_device.py) -----
+    # These kernels sit on the TRAINING-time hot path (candidate
+    # generation for every materialised-pair run), so they are gated like
+    # the gamma kernels: pinned int32 widths (the x64 tier catches any
+    # constructor deriving width from ambient config), no embedded plan
+    # arrays, no host callbacks, deterministic traces.
+
+    @register_kernel("block_segment_sort")
+    def _build_block_segment_sort():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..blocking_device import make_segment_sort_fn
+
+        fn = make_segment_sort_fn()
+        rng = np.random.default_rng(0)
+        codes = jnp.asarray(
+            rng.integers(-1, 5, size=32).astype(np.int32)
+        )
+        side = jnp.asarray((np.arange(32) % 2).astype(np.int32))
+        rank = jnp.asarray(np.arange(32, dtype=np.int32))
+        row = jnp.asarray(np.arange(32, dtype=np.int32))
+        return fn, (codes, side, rank, row), {}
+
+    @register_kernel("block_bucket_csr")
+    def _build_block_bucket_csr():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..blocking_device import make_bucket_csr_fn
+
+        fn = make_bucket_csr_fn()
+        rng = np.random.default_rng(0)
+        codes = jnp.asarray(
+            rng.integers(-1, 5, size=32).astype(np.int32)
+        )
+        return fn, (codes,), {}
+
+    @register_kernel("block_pair_emit")
+    def _build_block_pair_emit():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..blocking_device import make_pair_emit_fn
+
+        bs = 64
+        fn = make_pair_emit_fn(
+            bs, n_prev=1, has_uid_mask=True, rank_filter=True
+        )
+        imax = np.int32(np.iinfo(np.int32).max)
+        pos = jnp.arange(bs, dtype=jnp.int32)
+        order = jnp.asarray(np.arange(8, dtype=np.int32))
+        units = jnp.asarray(np.zeros(4, np.int32))
+        lens = jnp.asarray(np.full(4, 3, np.int32))
+        ranks = jnp.asarray(np.arange(8, dtype=np.int32))
+        prev_l = jnp.asarray(np.zeros((1, 8), np.int32))
+        prev_r = jnp.asarray(np.zeros((1, 8), np.int32))
+        uid = jnp.asarray(np.zeros(8, np.int32))
+        # meta row layout: [u0, valid, pc_rel... (power-of-two padded with
+        # int32 max)] — values are irrelevant to the trace, shapes/dtypes
+        # are what the audit checks
+        meta = jnp.asarray(
+            np.array([0, bs, 0, imax, imax, imax], np.int32)
+        )
+        return (
+            fn,
+            (pos, order, units, lens, units, lens, ranks, prev_l, prev_r,
+             uid, (), meta),
+            {},
+        )
+
     # the brown-out tier's budgeted twin (engine._brownout_kernel): same
     # factory, reduced top-k over a small candidate capacity — the shape
     # the service dispatches under pressure, so it is gated like the

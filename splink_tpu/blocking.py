@@ -8,6 +8,14 @@ static shapes — and produces *pair index arrays* into the encoded table; the
 quadratic pair data itself never materialises on the host beyond two int
 arrays, and device gathers do the rest.
 
+Round 7 moved the join itself onto the device for the common shapes:
+``block_using_rules`` dispatches to the device-native sort-join tier
+(splink_tpu/blocking_device.py — segmented sort, run-length segment
+detection, budgeted on-device pair expansion) on accelerator backends or
+when ``device_blocking: "on"``; the host joins below remain the fallback
+for unsupported shapes AND the parity oracle the device tier is tested
+against (docs/blocking.md).
+
 Pair-set semantics are preserved exactly:
   * equality-conjunction rules (``l.a = r.a AND l.b = r.b``) become hash
     joins on combined key codes; rows with a null key never match (SQL
@@ -55,10 +63,15 @@ _CARTESIAN_CHUNK = 1 << 22
 
 @dataclass
 class PairIndex:
-    """Candidate pairs as row indices into one EncodedTable."""
+    """Candidate pairs as row indices into one EncodedTable.
 
-    idx_l: np.ndarray  # (n_pairs,) int64
-    idx_r: np.ndarray  # (n_pairs,) int64
+    Indices are int32 whenever the table allows (n_rows < 2^31 — i.e.
+    always, in practice): at billions of candidate pairs the narrow dtype
+    halves both the resident footprint and the spill size. The int64 path
+    survives behind the ``_idx_dtype`` size check only."""
+
+    idx_l: np.ndarray  # (n_pairs,) int32 (int64 iff n_rows >= 2^31)
+    idx_r: np.ndarray  # (n_pairs,) int32 (int64 iff n_rows >= 2^31)
     # When blocking streamed the pairs straight to disk (spill_dir set),
     # idx_l/idx_r are memmaps living in this directory; the linker adopts it
     # for lifetime management.
@@ -202,6 +215,10 @@ class _PairSink:
 
     def finish(self) -> PairIndex:
         if self.spill_tmp is None:
+            if not self._chunks_l:  # chunked emission may sink nothing
+                return PairIndex(
+                    np.zeros(0, self.idx_dtype), np.zeros(0, self.idx_dtype)
+                )
             if len(self._chunks_l) == 1:
                 # np.concatenate on a one-element list still copies
                 return PairIndex(self._chunks_l[0], self._chunks_r[0])
@@ -427,42 +444,118 @@ def _idx_dtype(n_rows: int):
     return np.int32 if n_rows < 2**31 else np.int64
 
 
-def _self_join(
-    codes: np.ndarray, order: np.ndarray | None = None
-) -> tuple[np.ndarray, np.ndarray]:
-    """All unordered within-group pairs for non-null codes.
+def _iter_self_join_chunks(
+    codes: np.ndarray, order: np.ndarray | None = None,
+    chunk: int | None = None,
+):
+    """Yield (i, j) chunks of at most ~``chunk`` pairs for the within-group
+    self-join, in :func:`_self_join`'s emission order.
 
     With ``order`` (per-row ranks), group members are pre-sorted by rank so
     each emitted pair already satisfies rank_i < rank_j — orientation comes
     out of the join for free instead of costing a full-size gather + where
     pass over billions of pairs. Emits int32 indices when the table allows.
+
+    The expansion intermediates (``np.repeat`` over sizes, :func:`_ranges`)
+    are built PER CHUNK, so peak host RAM is O(chunk) no matter how many
+    pairs the rule produces — previously a budget/spill run still built the
+    full-pair-count repeat arrays in one shot.
     """
     rows = np.flatnonzero(codes >= 0).astype(_idx_dtype(len(codes)))
     if order is not None:
         rows = rows[np.argsort(order[rows], kind="stable")]
     rows_sorted, _, starts, sizes = _sort_groups(codes, rows)
-    native_out = native.self_join_pairs(rows_sorted, starts, sizes)
-    if native_out is not None:
-        return native_out
-    # numpy fallback: position k within its group pairs with the (s-1-k)
-    # following positions
-    pos_in_group = _ranges(sizes)
-    rep = np.repeat(sizes, sizes) - pos_in_group - 1  # s-1-k per sorted row
-    p = np.repeat(np.arange(len(rows_sorted), dtype=np.int64), rep)
-    q = p + 1 + _ranges(rep)
-    return rows_sorted[p], rows_sorted[q]
+    counts = (sizes * (sizes - 1)) // 2
+    cap = chunk if chunk else max(int(counts.sum()), 1)
+    g, n_groups = 0, len(sizes)
+    while g < n_groups:
+        if counts[g] > cap:
+            # giant group: split its triangle by a-rows so each slice
+            # emits at most ~cap pairs; a single a-row wider than the cap
+            # (near-constant key) further splits its contiguous b-range,
+            # so the O(cap) bound holds for ANY group shape
+            s0, s = int(starts[g]), int(sizes[g])
+            rem = (s - 1) - np.arange(s - 1, dtype=np.int64)
+            cum = np.cumsum(rem)
+            k = 0
+            while k < s - 1:
+                if rem[k] > cap:
+                    i_row = rows_sorted[s0 + k]
+                    for b0 in range(k + 1, s, cap):
+                        q = rows_sorted[s0 + b0 : s0 + min(b0 + cap, s)]
+                        yield np.full(len(q), i_row, rows_sorted.dtype), q
+                    k += 1
+                    continue
+                base = int(cum[k - 1]) if k else 0
+                # last k2 with cum[k2-1] <= base + cap: the packed rows'
+                # pairs stay within the cap (rows wider than the cap were
+                # peeled off above)
+                k2 = int(np.searchsorted(cum, base + cap, side="right"))
+                k2 = min(max(k2, k + 1), s - 1)
+                sub = np.arange(k, k2, dtype=np.int64)
+                rep = (s - 1) - sub
+                p = np.repeat(sub, rep) + s0
+                q = p + 1 + _ranges(rep)
+                yield rows_sorted[p], rows_sorted[q]
+                k = k2
+            g += 1
+            continue
+        # greedy span of whole groups with total pairs <= cap
+        g2, tot = g, 0
+        while g2 < n_groups and tot + counts[g2] <= cap:
+            tot += counts[g2]
+            g2 += 1
+        g2 = max(g2, g + 1)
+        st, sz = starts[g:g2], sizes[g:g2]
+        native_out = native.self_join_pairs(rows_sorted, st, sz)
+        if native_out is not None:
+            yield native_out
+            g = g2
+            continue
+        # numpy fallback: position k within its group pairs with the
+        # (s-1-k) following positions; span rows are contiguous in
+        # rows_sorted so global positions are span-offset + local
+        pos_in_group = _ranges(sz)
+        rep = np.repeat(sz, sz) - pos_in_group - 1
+        span_len = int(sz.sum())
+        p = np.repeat(np.arange(span_len, dtype=np.int64), rep) + int(
+            st[0] if len(st) else 0
+        )
+        q = p + 1 + _ranges(rep)
+        yield rows_sorted[p], rows_sorted[q]
+        g = g2
 
 
-def _cross_join(
+def _self_join(
+    codes: np.ndarray, order: np.ndarray | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """All unordered within-group pairs for non-null codes, in one array
+    pair (see :func:`_iter_self_join_chunks` for the chunked form)."""
+    out = list(_iter_self_join_chunks(codes, order))
+    if not out:
+        dt = _idx_dtype(len(codes))
+        return np.zeros(0, dt), np.zeros(0, dt)
+    if len(out) == 1:
+        return out[0]
+    return (
+        np.concatenate([c[0] for c in out]),
+        np.concatenate([c[1] for c in out]),
+    )
+
+
+def _iter_cross_join_chunks(
     codes_l: np.ndarray,
     left_rows: np.ndarray,
     right_rows: np.ndarray,
     codes_r: np.ndarray | None = None,
+    chunk: int | None = None,
 ):
-    """All cross pairs (i from left_rows, j from right_rows) whose key codes
-    match. With ``codes_r`` the two sides read different code arrays (an
-    asymmetric key like ``l.a = r.b`` — both factorised over one shared
-    vocabulary by _key_codes_asym); otherwise one array serves both."""
+    """Yield (i, j) chunks of at most ~``chunk`` pairs for the cross join,
+    in :func:`_cross_join`'s emission order. With ``codes_r`` the two sides
+    read different code arrays (an asymmetric key like ``l.a = r.b`` — both
+    factorised over one shared vocabulary by _key_codes_asym); otherwise
+    one array serves both. Expansion intermediates are per chunk, like
+    :func:`_iter_self_join_chunks`."""
     if codes_r is None:
         codes_r = codes_l
     lrows, lcodes, lstarts, lsizes = _sort_groups(
@@ -474,18 +567,79 @@ def _cross_join(
     # intersect group keys
     common, li, ri = np.intersect1d(lcodes, rcodes, return_indices=True)
     if len(common) == 0:
-        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+        return
     ls, lz = lstarts[li], lsizes[li]
     rs, rz = rstarts[ri], rsizes[ri]
-    native_out = native.cross_join_pairs(lrows, ls, lz, rrows, rs, rz)
-    if native_out is not None:
-        return native_out
     counts = lz * rz
-    g = np.repeat(np.arange(len(common), dtype=np.int64), counts)
-    t = _ranges(counts)
-    a = t // rz[g] + ls[g]
-    b = t % rz[g] + rs[g]
-    return lrows[a], rrows[b]
+    cap = chunk if chunk else max(int(counts.sum()), 1)
+    g, n_groups = 0, len(common)
+    while g < n_groups:
+        if counts[g] > cap:
+            # giant group: split its rectangle by l-rows; an r-side wider
+            # than the cap further splits each l-row's contiguous r-range,
+            # so the O(cap) bound holds for ANY group shape
+            l0, lzg = int(ls[g]), int(lz[g])
+            r0, rzg = int(rs[g]), int(rz[g])
+            if rzg > cap:
+                for a in range(lzg):
+                    i_row = lrows[l0 + a]
+                    for b0 in range(0, rzg, cap):
+                        q = rrows[r0 + b0 : r0 + min(b0 + cap, rzg)]
+                        yield np.full(len(q), i_row, lrows.dtype), q
+                g += 1
+                continue
+            rows_per = max(cap // rzg, 1)
+            right_span = np.arange(r0, r0 + rzg, dtype=np.int64)
+            for a0 in range(0, lzg, rows_per):
+                a1 = min(a0 + rows_per, lzg)
+                p = np.repeat(
+                    np.arange(a0, a1, dtype=np.int64) + l0, rzg
+                )
+                q = np.tile(right_span, a1 - a0)
+                yield lrows[p], rrows[q]
+            g += 1
+            continue
+        g2, tot = g, 0
+        while g2 < n_groups and tot + counts[g2] <= cap:
+            tot += counts[g2]
+            g2 += 1
+        g2 = max(g2, g + 1)
+        span = slice(g, g2)
+        native_out = native.cross_join_pairs(
+            lrows, ls[span], lz[span], rrows, rs[span], rz[span]
+        )
+        if native_out is not None:
+            yield native_out
+            g = g2
+            continue
+        cnt = counts[span]
+        gi = np.repeat(np.arange(g2 - g, dtype=np.int64), cnt)
+        t = _ranges(cnt)
+        a = t // rz[span][gi] + ls[span][gi]
+        b = t % rz[span][gi] + rs[span][gi]
+        yield lrows[a], rrows[b]
+        g = g2
+
+
+def _cross_join(
+    codes_l: np.ndarray,
+    left_rows: np.ndarray,
+    right_rows: np.ndarray,
+    codes_r: np.ndarray | None = None,
+):
+    """All cross pairs whose key codes match, in one array pair (see
+    :func:`_iter_cross_join_chunks` for the chunked form)."""
+    out = list(
+        _iter_cross_join_chunks(codes_l, left_rows, right_rows, codes_r)
+    )
+    if not out:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    if len(out) == 1:
+        return out[0]
+    return (
+        np.concatenate([c[0] for c in out]),
+        np.concatenate([c[1] for c in out]),
+    )
 
 
 # ----------------------------------------------------------------------
@@ -741,6 +895,21 @@ def block_using_rules(
     prior_rules: list[tuple[np.ndarray | None, str | None]] = []
     sink = _PairSink(settings.get("spill_dir"), idx_dtype)
     try:
+        # Device-native tier first (blocking_device.py): the sort-based
+        # hash join runs as jitted kernels and streams budgeted chunks into
+        # the same sink. Falls through to the host join for unsupported
+        # shapes (cartesian rules, uncompilable residuals, monster groups)
+        # or "auto"-mode jobs too small to pay the jit warmup — the host
+        # path below stays the fallback AND the parity oracle.
+        mode = settings.get("device_blocking", "auto")
+        if mode in ("auto", "on"):
+            from .blocking_device import device_block_rules
+
+            out = device_block_rules(
+                settings, table, n_left, sink, pair_consumer, mode
+            )
+            if out is not None:
+                return out
         return _block_rules_into(
             sink, rules, settings, table, link_type, all_rows, n_left,
             prior_rules, pair_consumer,
@@ -754,6 +923,12 @@ def _block_rules_into(
     sink, rules, settings, table, link_type, all_rows, n_left, prior_rules,
     pair_consumer=None,
 ) -> PairIndex:
+    # Per-rule pairs are generated and CONSUMED in bounded chunks: the
+    # residual/dedup filters are elementwise, so running them chunk-wise is
+    # semantics-preserving and keeps peak host RAM at O(chunk) — the
+    # expansion intermediates (np.repeat / _ranges) no longer materialise
+    # over a rule's full pair count when a budget or spill cap applies.
+    chunk_cap = int(settings.get("blocking_chunk_pairs") or 0) or None
     if link_type == "link_only":
         assert n_left is not None
         left_rows, right_rows = all_rows[:n_left], all_rows[n_left:]
@@ -761,12 +936,15 @@ def _block_rules_into(
         eq_pairs, residual = parse_blocking_rule(rule)
         sym_cols, asym, residual = _split_join_keys(eq_pairs, residual)
 
+        rank_filter = False
         if asym:
             # asymmetric equality keys (l.a = r.b): hash join over the
             # shared-vocabulary code pair
             codes_l, codes_r = _key_codes_asym(table, sym_cols, asym)
             if link_type == "link_only":
-                i, j = _cross_join(codes_l, left_rows, right_rows, codes_r)
+                chunks = _iter_cross_join_chunks(
+                    codes_l, left_rows, right_rows, codes_r, chunk_cap
+                )
             else:
                 # f(l) = g(r) was written with the l side first; the
                 # reference's join enumerates ordered (l, r) pairs and its
@@ -774,50 +952,66 @@ def _block_rules_into(
                 # table against itself and keep that orientation (no swap:
                 # swapping would change which side each expression applies
                 # to)
-                i, j = _cross_join(codes_l, all_rows, all_rows, codes_r)
-                ranks, keys_unique = _uid_ranks(table, link_type)
-                keep = ranks[i] < ranks[j]
-                i, j = i[keep], j[keep]
-                if not keys_unique:
-                    i, j = _drop_equal_key_pairs(table, link_type, i, j)
+                chunks = _iter_cross_join_chunks(
+                    codes_l, all_rows, all_rows, codes_r, chunk_cap
+                )
+                rank_filter = True
         elif sym_cols:
             codes_l = codes_r = _key_codes(table, sym_cols)
             if link_type == "link_only":
                 # oriented by construction: left input on the l side
-                i, j = _cross_join(codes_l, left_rows, right_rows)
+                chunks = _iter_cross_join_chunks(
+                    codes_l, left_rows, right_rows, chunk=chunk_cap
+                )
             else:
                 # group members pre-sorted by uid rank -> pairs come out
                 # already oriented; only duplicate-key inputs need the
                 # drop-equal pass
                 ranks, keys_unique = _uid_ranks(table, link_type)
-                i, j = _self_join(codes_l, order=ranks)
-                if not keys_unique:
-                    i, j = _drop_equal_key_pairs(table, link_type, i, j)
+                chunks = _iter_self_join_chunks(
+                    codes_l, order=ranks, chunk=chunk_cap
+                )
         else:
             codes_l = codes_r = None
             warnings.warn(
                 f"Blocking rule {rule!r} has no equality condition; evaluating "
                 "it against all row pairs (quadratic)."
             )
-            i, j = _all_pairs(table, link_type, n_left)
-            i, j = _orient_pairs(table, link_type, i, j)
-        if residual is not None:
-            i, j = _eval_residual(table, residual, i, j)
-
-        for prev_l, prev_r, prev_residual in prior_rules:
-            holds = _rule_holds(table, prev_l, prev_r, prev_residual, i, j)
-            keep = ~holds
-            i, j = i[keep], j[keep]
+            chunks = (
+                _iter_all_pairs_chunks(
+                    table, link_type, n_left, chunk_cap or _CARTESIAN_CHUNK
+                )
+            )
+        n_new = 0
+        for i, j in chunks:
+            if codes_l is None:
+                i, j = _orient_pairs(table, link_type, i, j)
+            elif rank_filter:
+                ranks, keys_unique = _uid_ranks(table, link_type)
+                keep = ranks[i] < ranks[j]
+                i, j = i[keep], j[keep]
+                if not keys_unique:
+                    i, j = _drop_equal_key_pairs(table, link_type, i, j)
+            elif sym_cols and link_type != "link_only" and not keys_unique:
+                i, j = _drop_equal_key_pairs(table, link_type, i, j)
+            if residual is not None:
+                i, j = _eval_residual(table, residual, i, j)
+            for prev_l, prev_r, prev_residual in prior_rules:
+                holds = _rule_holds(
+                    table, prev_l, prev_r, prev_residual, i, j
+                )
+                keep = ~holds
+                i, j = i[keep], j[keep]
+            n_new += len(i)
+            sink.append(i, j)
+            if pair_consumer is not None:
+                pair_consumer(
+                    i.astype(sink.idx_dtype, copy=False),
+                    j.astype(sink.idx_dtype, copy=False),
+                )
+            del i, j
 
         prior_rules.append((codes_l, codes_r, residual))
-        n_new = len(i)
-        sink.append(i, j)
-        if pair_consumer is not None:
-            pair_consumer(
-                i.astype(sink.idx_dtype, copy=False),
-                j.astype(sink.idx_dtype, copy=False),
-            )
-        del i, j
         logger.debug("blocking rule %r -> %d new pairs", rule, n_new)
 
     return sink.finish()

@@ -1,0 +1,736 @@
+"""Device-native blocking: on-device hash-join candidate generation.
+
+blocking.py's host joins were the last pipeline stage computed entirely on
+the host — np.argsort over every rule's key codes, np.repeat/np.cumsum pair
+expansion, 8.2M pairs/s single-threaded while the chip scores 28M+/s
+(BENCHMARKS.md). This module moves the join itself onto the device as a
+sort-based hash join over the SAME packed key codes blocking.py builds
+(HyperBlocker, arXiv:2410.04349, maps rule-based blocking onto exactly this
+kind of accelerator parallelism):
+
+  1. segmented sort — one ``lax.sort`` of ``(key_code, side, rank)``
+     carrying row ids: equal keys become contiguous segments, group members
+     arrive pre-sorted by uid rank (orientation comes out of the join for
+     free, `_self_join`'s trick), and the two sides of a link / asymmetric
+     join interleave as (code, side) runs;
+  2. run-length segment detection — boundary flags + a pinned int32 cumsum
+     give each position its segment id; per-segment starts and per-side
+     extents compact through scatter-min/scatter-add. Only this compact
+     O(segments) table crosses back to the host;
+  3. pair expansion — the host splits segments into the SAME bounded
+     triangle/rectangle units as the virtual pair index (pairgen's f32-exact
+     decode, reused verbatim via ``pairgen.unit_decode``) and the emission
+     kernel decodes each chunk of global pair positions into (i, j) row
+     pairs ON DEVICE, applies the sequential-rule dedup mask (earlier-rule
+     key equality + compiled residuals — the reference's ``AND NOT
+     ifnull(prev, false)`` — mirroring pairgen's mask semantics), the
+     duplicate-uid mask and the asymmetric-rule rank orientation filter,
+     then compacts survivors with an int32 rank-scatter;
+  4. chunked emission under an explicit pair budget
+     (``blocking_chunk_pairs``) — a huge block streams as fixed-shape
+     chunks instead of OOMing, the Progressive-Blocking shape
+     (arXiv:2005.14326) of emitting candidates under a budget rather than
+     all-at-once. Chunk shapes are power-of-two stable, so steady-state
+     emission never recompiles.
+
+The host path in blocking.py is retained as the fallback (cartesian rules,
+residuals the device compiler rejects, degenerate near-constant keys,
+>=2^31 key codes) and as the parity oracle: the device pair set is
+bit-equal AS A SET to the host pair set on every supported shape
+(tests/test_blocking_device.py; ``make blocking-smoke`` gates it).
+
+serve/index.py reuses the segmented sort through :func:`build_bucket_csr`
+to build its per-rule bucket CSR (rows_sorted/starts/sizes/row_bucket) on
+device instead of the host argsort.
+
+All kernels are registered in the three audit layers: jaxlint (AST),
+trace_audit (``block_segment_sort``, ``block_bucket_csr``,
+``block_pair_emit`` — x64-forced dtype/const/callback/determinism budgets)
+and shard_audit (``block_pair_decode_sharded`` — the decode+mask body is
+embarrassingly parallel over positions and lowers collective-free with
+sharded outputs; the compaction cumsum is single-device by design, the
+host compacts per shard).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .blocking import (
+    _key_codes,
+    _key_codes_asym,
+    _split_join_keys,
+    _uid_ranks,
+    parse_blocking_rule,
+)
+from .data import EncodedTable
+from .pairgen import (
+    CHUNK,
+    _pair_counts,
+    _uid_mask_codes,
+    _unit_batch_meta,
+    _units_for_cross_join,
+    _units_for_self_join,
+    compile_residual_device,
+    unit_decode,
+)
+
+logger = logging.getLogger("splink_tpu")
+
+# Default emission chunk (pairs per device batch) when the settings carry no
+# blocking_chunk_pairs; also the schema default. Bounds the transient device
+# footprint of one chunk (~9 int32 lanes x chunk) and the host RAM of one
+# downloaded chunk.
+DEFAULT_CHUNK_PAIRS = 1 << 22
+
+# "auto" mode engages the device tier only past this estimated pair count:
+# below it the host join finishes in milliseconds and the jit warmup would
+# dominate (the same shape as device_pair_generation's auto gate).
+AUTO_MIN_PAIRS = 1 << 21
+
+# Concurrent chunk downloads in flight (pairgen._D2H_DEPTH rationale: D2H
+# round trips overlap the next chunk's kernel instead of serialising it).
+_D2H_DEPTH = 2
+
+_IMAX = np.iinfo(np.int32).max
+
+
+def _pow2(n: int) -> int:
+    """Smallest power of two >= max(n, 1) — the shape-bucketing that keeps
+    jit specialisations shared across tables of similar size."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+# --------------------------------------------------------------------------
+# Kernels
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def make_segment_sort_fn():
+    """Jitted segmented sort + run-length segment detection.
+
+    fn(codes, side, rank, row) ->
+        (rows_sorted, seg_start, l_cnt, r_cnt, n_seg, n_valid)
+
+    Entries sort by (key, side, rank) with null keys (code < 0 — including
+    the power-of-two padding) remapped to int32 max so they collapse into
+    one trailing segment the host drops (``seg_start >= n_valid``). Segment
+    starts compact via scatter-min over the per-position segment id,
+    per-side extents via scatter-add — all shapes static, all dtypes pinned
+    int32 (the TPU production width; x64 audit tier traces identically).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def fn(codes, side, rank, row):
+        m = codes.shape[0]
+        imax = jnp.int32(_IMAX)
+        key = jnp.where(codes < 0, imax, codes)
+        key_s, side_s, _, row_s = lax.sort(
+            (key, side, rank, row), num_keys=3, is_stable=True
+        )
+        n_valid = jnp.sum((codes >= 0).astype(jnp.int32), dtype=jnp.int32)
+        pos = jnp.arange(m, dtype=jnp.int32)
+        boundary = jnp.concatenate(
+            [jnp.ones((1,), bool), key_s[1:] != key_s[:-1]]
+        )
+        seg_of = jnp.cumsum(boundary.astype(jnp.int32), dtype=jnp.int32) - 1
+        # n_seg as a reduction, NOT seg_of[-1]: a traced negative index
+        # lowers through an int64 dynamic_slice under x64 (TA-DTYPE)
+        n_seg = jnp.sum(boundary.astype(jnp.int32), dtype=jnp.int32)
+        seg_start = jnp.full(m, imax, jnp.int32).at[seg_of].min(pos)
+        l_cnt = (
+            jnp.zeros(m, jnp.int32)
+            .at[seg_of]
+            .add((side_s == 0).astype(jnp.int32))
+        )
+        r_cnt = (
+            jnp.zeros(m, jnp.int32)
+            .at[seg_of]
+            .add((side_s == 1).astype(jnp.int32))
+        )
+        return row_s, seg_start, l_cnt, r_cnt, n_seg, n_valid
+
+    return fn
+
+
+@functools.lru_cache(maxsize=1)
+def make_bucket_csr_fn():
+    """Jitted bucket-CSR build for the serving index: fn(codes) ->
+    (rows_sorted, starts, sizes, row_bucket, n_seg, n_valid), bit-equal to
+    the host ``blocking._sort_groups`` construction (stable sort keeps rows
+    ascending within a bucket; buckets ordered by ascending key code).
+    row_bucket is -1 for null-key rows, exactly the serving contract."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def fn(codes):
+        m = codes.shape[0]
+        imax = jnp.int32(_IMAX)
+        key = jnp.where(codes < 0, imax, codes)
+        rows = jnp.arange(m, dtype=jnp.int32)
+        key_s, row_s = lax.sort((key, rows), num_keys=1, is_stable=True)
+        n_valid = jnp.sum((codes >= 0).astype(jnp.int32), dtype=jnp.int32)
+        boundary = jnp.concatenate(
+            [jnp.ones((1,), bool), key_s[1:] != key_s[:-1]]
+        )
+        seg_of = jnp.cumsum(boundary.astype(jnp.int32), dtype=jnp.int32) - 1
+        # reduction, not seg_of[-1] (int64 dynamic_slice under x64)
+        n_seg = jnp.sum(boundary.astype(jnp.int32), dtype=jnp.int32)
+        starts = jnp.full(m, imax, jnp.int32).at[seg_of].min(rows)
+        sizes = jnp.zeros(m, jnp.int32).at[seg_of].add(jnp.int32(1))
+        valid_entry = rows < n_valid
+        dest = jnp.where(valid_entry, row_s, m)
+        row_bucket = (
+            jnp.full(m, -1, jnp.int32).at[dest].set(seg_of, mode="drop")
+        )
+        return row_s, starts, sizes, row_bucket, n_seg, n_valid
+
+    return fn
+
+
+def make_pair_emit_fn(batch_size: int, n_prev: int, has_uid_mask: bool,
+                      rank_filter: bool, own_res=None, prev_res=(),
+                      mesh=None, compact: bool = True):
+    """Jitted emission kernel: decode one chunk of global pair positions
+    into (i, j) row pairs and compact the survivors.
+
+    Composes pairgen's ``unit_decode`` (the same f32-exact
+    triangle/rectangle math the virtual pattern kernel runs), then masks:
+    tail padding (``pos >= valid``), the asymmetric-rule rank orientation
+    filter (``rank[i] < rank[j]`` — the reference's l.key < r.key on a
+    cross join of the table against itself), the duplicate-uid drop, the
+    rule's own residual and every EARLIER rule's predicate (key equality on
+    that rule's l/r codes AND its residual, UNKNOWN counting as
+    not-produced — blocking._rule_holds semantics). Survivors compact via
+    an int32 rank-scatter (cumsum of the keep mask), so the host downloads
+    ``count`` real pairs in the first ``count`` lanes.
+
+    With ``mesh`` the kernel returns the UNCOMPACTED (i, j, keep) triple
+    sharded along the position axis — compaction is a prefix sum, which
+    would force cross-shard comms; each shard's survivors compact host-side
+    instead. The sharded body is collective-free (shard_audit pins it).
+
+    ``compact=False`` returns the same uncompacted triple on a single
+    device: XLA's CPU scatter lowering is a serial loop (measured ~4x the
+    whole decode for a 4M chunk), so the CPU-backend driver compacts
+    host-side with vectorised numpy instead — on accelerator backends the
+    on-device compaction stands, because there the scarce resource is D2H
+    bytes over the (tunnelled) link, and compaction halves them.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    jit_kwargs = {}
+    if mesh is not None:
+        from .parallel.mesh import pair_sharding
+
+        shard = pair_sharding(mesh)
+        jit_kwargs = {"out_shardings": (shard, shard, shard)}
+
+    # a kernel with NO mask terms needs no keep vector at all: the only
+    # dropped positions are the tail past `valid`, and the DRIVER knows
+    # valid (it built the meta row) — it slices the download instead. This
+    # skips the keep compute, its D2H and the host compress for every
+    # maskless rule (typically the first, largest rule of a run).
+    maskless = (
+        mesh is None
+        and n_prev == 0
+        and not has_uid_mask
+        and not rank_filter
+        and own_res is None
+    )
+
+    @functools.partial(jax.jit, **jit_kwargs)
+    def fn(pos, order, ua, la, ub, lb, ranks, prev_l, prev_r, uid_codes,
+           res_ops, meta):
+        i, j, valid = unit_decode(
+            pos, order, ua, la, ub, lb, meta, mesh_ladder=mesh is not None
+        )
+        if maskless:
+            return i, j, None
+        keep = pos < valid
+        if rank_filter:
+            keep = keep & (ranks[i] < ranks[j])
+        if has_uid_mask:
+            keep = keep & (uid_codes[i] != uid_codes[j])
+        if own_res is not None:
+            v, unk = own_res(i, j, res_ops)
+            keep = keep & v & ~unk
+        for p in range(n_prev):
+            cl = prev_l[p]
+            cr = prev_r[p]
+            holds = (cl[i] == cr[j]) & (cl[i] >= 0)
+            if prev_res and prev_res[p] is not None:
+                v, unk = prev_res[p](i, j, res_ops)
+                holds = holds & v & ~unk
+            keep = keep & ~holds
+        if mesh is not None or not compact:
+            return i, j, keep
+        kcum = jnp.cumsum(keep.astype(jnp.int32), dtype=jnp.int32)
+        dest = jnp.where(keep, kcum - 1, jnp.int32(batch_size))
+        out_i = jnp.zeros(batch_size, jnp.int32).at[dest].set(i, mode="drop")
+        out_j = jnp.zeros(batch_size, jnp.int32).at[dest].set(j, mode="drop")
+        # count rides as the last lane of a (batch_size + 1,) array so one
+        # download carries pairs AND count (the tunnelled-link round trip
+        # costs more than the lane)
+        out_i = jnp.concatenate([out_i, kcum[-1:]])
+        return out_i, out_j, keep
+
+    return fn
+
+
+# --------------------------------------------------------------------------
+# Plan build (host: key codes -> device sort -> bounded units)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class DeviceRule:
+    """One rule's device join structure."""
+
+    rule: str
+    order: np.ndarray  # (M,) int32 pow2-padded sorted entry rows
+    ua: np.ndarray  # (U,) int32 unit a-side start into `order`
+    la: np.ndarray  # (U,) int32 a-side extent (<= chunk)
+    ub: np.ndarray  # (U,) int32 b-side start (== ua for triangles)
+    lb: np.ndarray  # (U,) int32 b-side extent
+    pc: np.ndarray  # (U+1,) int64 cumulative pair counts
+    rank_filter: bool  # asymmetric self-join: keep rank[i] < rank[j]
+    residual: str | None = None
+    residual_fn: object = None
+
+    @property
+    def total(self) -> int:
+        return int(self.pc[-1]) if len(self.pc) else 0
+
+
+@dataclass
+class DeviceBlockPlan:
+    rules: list[DeviceRule]
+    codes_l: np.ndarray  # (R, n) int32 per-rule l-side codes (dedup mask)
+    codes_r: np.ndarray  # (R, n) int32 r-side codes (== l row when symmetric)
+    ranks: np.ndarray  # (n,) int32 uid ranks (zeros for link_only)
+    uid_codes: np.ndarray | None  # (n,) int32 when duplicate uids exist
+    res_ops: list[np.ndarray] = field(default_factory=list)
+    chunk: int = CHUNK  # unit extent bound (int32/f32-exactness margin)
+    # jitted emission kernels keyed by (rule, batch, mesh): reusing the
+    # closure is what makes a warmup emission actually warm the next one
+    kernel_cache: dict = field(default_factory=dict)
+
+    @property
+    def n_candidates(self) -> int:
+        return sum(rp.total for rp in self.rules)
+
+
+def build_device_plan(
+    settings: dict, table: EncodedTable, n_left: int | None = None,
+    chunk: int | None = None,
+) -> DeviceBlockPlan | None:
+    """Build the device join plan, or None when a rule needs the host path
+    (cartesian, an uncompilable residual, >=2^31 key codes, or a
+    near-constant key exceeding the per-group unit cap)."""
+    chunk = chunk or CHUNK
+    link_type = settings["link_type"]
+    rules = settings.get("blocking_rules") or []
+    if not rules or table.n_rows == 0:
+        return None
+    n = table.n_rows
+    if link_type == "link_only":
+        assert n_left is not None
+        ranks = np.zeros(n, np.int32)  # orientation fixed by construction
+        uid_codes = None
+    else:
+        ranks, _ = _uid_ranks(table, link_type)
+        uid_codes = _uid_mask_codes(table, link_type)
+
+    res_ops: list[np.ndarray] = []
+    res_idx: dict = {}
+    res_aux: dict = {}
+    parsed = []
+    for rule in rules:
+        eq_pairs, residual = parse_blocking_rule(rule)
+        sym, asym, residual = _split_join_keys(eq_pairs, residual)
+        if not sym and not asym:
+            return None  # cartesian rule: host path (with its warning)
+        if asym:
+            codes_l, codes_r = _key_codes_asym(table, sym, asym)
+        else:
+            codes_l = codes_r = _key_codes(table, sym)
+        if len(codes_l) and (
+            int(codes_l.max()) >= _IMAX or int(codes_r.max()) >= _IMAX
+        ):
+            return None  # codes must fit the int32 device lanes
+        res_fn = None
+        if residual is not None:
+            res_fn = compile_residual_device(
+                table, residual, res_ops, res_idx, res_aux
+            )
+            if res_fn is None:
+                return None
+        parsed.append((codes_l, codes_r, bool(asym), residual, res_fn))
+    if res_aux.get("numeric_used"):
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            logger.warning(
+                "device blocking: a blocking residual contains numeric "
+                "arithmetic, which evaluates in float32 on TPU (no f64) — "
+                "a pair exactly on a threshold may land differently than "
+                "the float64 host path. Set device_blocking='off' for "
+                "bit-identical host blocking."
+            )
+
+    sort_fn = make_segment_sort_fn()
+    all_rows = np.arange(n, dtype=np.int32)
+    plans: list[DeviceRule] = []
+    codes_l_all = np.empty((len(rules), n), np.int32)
+    codes_r_all = np.empty((len(rules), n), np.int32)
+    for r, (codes_l, codes_r, is_asym, residual, res_fn) in enumerate(parsed):
+        codes_l_all[r] = codes_l.astype(np.int32)
+        codes_r_all[r] = codes_r.astype(np.int32)
+        rank_filter = False
+        if link_type == "link_only":
+            # left input rows read the l-side codes, right rows the r-side
+            # (identical arrays for a symmetric key); rectangles by
+            # construction keep the left input on the l side
+            ent_codes = np.concatenate(
+                [codes_l_all[r][:n_left], codes_r_all[r][n_left:]]
+            )
+            ent_side = np.zeros(n, np.int32)
+            ent_side[n_left:] = 1
+            ent_rank = np.zeros(n, np.int32)
+            ent_rows = all_rows
+            triangle = False
+        elif is_asym:
+            # f(l) = g(r) over one table: every row enters once per side;
+            # the reference's cross join of the table against itself with
+            # the l.key < r.key where-condition — here the rank filter mask
+            ent_codes = np.concatenate([codes_l_all[r], codes_r_all[r]])
+            ent_side = np.concatenate(
+                [np.zeros(n, np.int32), np.ones(n, np.int32)]
+            )
+            ent_rank = np.concatenate([ranks, ranks]).astype(np.int32)
+            ent_rows = np.concatenate([all_rows, all_rows])
+            triangle = False
+            rank_filter = True
+        else:
+            # symmetric self-join: rank is the sort's tertiary key, so the
+            # triangle decode's a < b IS rank_i < rank_j (ranks are a
+            # permutation — duplicates only among uid COLLISIONS, which the
+            # uid mask drops)
+            ent_codes = codes_l_all[r]
+            ent_side = np.zeros(n, np.int32)
+            ent_rank = ranks.astype(np.int32)
+            ent_rows = all_rows
+            triangle = True
+        m0 = len(ent_codes)
+        m = _pow2(m0)
+        if m != m0:  # pad with null keys: they join the dropped segment
+            pad = m - m0
+            ent_codes = np.concatenate(
+                [ent_codes, np.full(pad, -1, np.int32)]
+            )
+            ent_side = np.concatenate([ent_side, np.zeros(pad, np.int32)])
+            ent_rank = np.concatenate([ent_rank, np.zeros(pad, np.int32)])
+            ent_rows = np.concatenate([ent_rows, np.zeros(pad, np.int32)])
+        row_s, seg_start, l_cnt, r_cnt, n_seg, n_valid = sort_fn(
+            ent_codes, ent_side, ent_rank, ent_rows
+        )
+        order = np.asarray(row_s)
+        seg_start = np.asarray(seg_start)
+        l_cnt = np.asarray(l_cnt)
+        r_cnt = np.asarray(r_cnt)
+        n_seg_h = int(np.asarray(n_seg))
+        n_valid_h = int(np.asarray(n_valid))
+        starts = seg_start[:n_seg_h].astype(np.int64)
+        lz = l_cnt[:n_seg_h].astype(np.int64)
+        rz = r_cnt[:n_seg_h].astype(np.int64)
+        live = starts < n_valid_h  # drop the trailing null/pad segment
+        starts, lz, rz = starts[live], lz[live], rz[live]
+        if triangle:
+            units = _units_for_self_join(starts, lz, chunk)
+        else:
+            both = (lz > 0) & (rz > 0)
+            units = _units_for_cross_join(
+                starts[both], lz[both], starts[both] + lz[both], rz[both],
+                chunk,
+            )
+        if units is None:
+            return None  # monster group: host blocking is the right tool
+        ua, la, ub, lb = units
+        plans.append(
+            DeviceRule(
+                rule=rules[r],
+                order=np.ascontiguousarray(order, dtype=np.int32),
+                ua=ua.astype(np.int32),
+                la=la.astype(np.int32),
+                ub=ub.astype(np.int32),
+                lb=lb.astype(np.int32),
+                pc=_pair_counts(ua, la, ub, lb),
+                rank_filter=rank_filter,
+                residual=residual,
+                residual_fn=res_fn,
+            )
+        )
+    return DeviceBlockPlan(
+        rules=plans,
+        codes_l=codes_l_all,
+        codes_r=codes_r_all,
+        ranks=np.ascontiguousarray(ranks, dtype=np.int32),
+        uid_codes=uid_codes,
+        res_ops=res_ops,
+        chunk=chunk,
+    )
+
+
+# --------------------------------------------------------------------------
+# Chunked emission
+# --------------------------------------------------------------------------
+
+
+def iter_device_pairs(plan: DeviceBlockPlan, batch_size: int, mesh=None):
+    """Drive the emission kernels over every rule, yielding
+    ``(rule_index, i, j)`` host int32 chunks of at most ``batch_size``
+    pairs in rule order (the same rule order the host sink sees).
+
+    Chunk downloads run on a small thread pool ``_D2H_DEPTH`` deep (yield
+    order stays submission order) so a chunk's D2H round trip overlaps the
+    next chunk's kernel. Chunk shapes are power-of-two bucketed per rule —
+    a steady-state emission loop compiles nothing after the first chunk of
+    each rule.
+    """
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import jax.numpy as jnp
+
+    if plan.n_candidates == 0:
+        return
+    # int32-safe bound, same margin as pairgen: batch-relative pc entries
+    # can overshoot the batch end by up to one unit's pair count
+    safe = (1 << 31) - 1 - plan.chunk * plan.chunk
+    batch_size = min(max(int(batch_size), 64), safe)
+    if mesh is not None:
+        from .parallel.mesh import (
+            pad_to_multiple,
+            pair_sharding,
+            replicated,
+        )
+
+        msz = mesh.devices.size
+        batch_size = pad_to_multiple(batch_size, msz)
+        if batch_size > safe:
+            batch_size = max(safe // msz, 1) * msz
+        shard = pair_sharding(mesh)
+        repl = replicated(mesh)
+        put = lambda a: jax.device_put(jnp.asarray(a), repl)  # noqa: E731
+    else:
+        put = jnp.asarray
+
+    # on-device compaction only where it pays: it saves D2H bytes on
+    # accelerator links but runs as a serial scatter loop on the XLA CPU
+    # backend (make_pair_emit_fn docstring) — there the host compacts
+    compact_dev = mesh is None and jax.default_backend() != "cpu"
+    ranks_dev = put(plan.ranks)
+    codes_l_dev = put(
+        plan.codes_l if len(plan.codes_l) else np.zeros((1, 1), np.int32)
+    )
+    codes_r_dev = put(
+        plan.codes_r if len(plan.codes_r) else np.zeros((1, 1), np.int32)
+    )
+    uid_dev = put(
+        plan.uid_codes if plan.uid_codes is not None
+        else np.zeros(1, np.int32)
+    )
+    res_ops_dev = tuple(put(a) for a in plan.res_ops)
+    pos_cache: dict = {}
+    pool = ThreadPoolExecutor(max_workers=_D2H_DEPTH)
+    inflight: deque = deque()
+
+    def own(arr, lanes):
+        """Slice views into downloaded chunk buffers are zero-copy; when a
+        slice keeps under half the buffer, copy so the consumer's sink
+        doesn't pin the whole chunk buffer for a sliver of survivors."""
+        return arr.copy() if 2 * len(arr) < lanes else arr
+
+    def fetch(r, out_i, out_j, keep, n_valid):
+        if keep is None:  # maskless kernel: only the tail drops
+            return (
+                r,
+                own(np.asarray(out_i)[:n_valid], out_i.shape[0]),
+                own(np.asarray(out_j)[:n_valid], out_j.shape[0]),
+            )
+        if compact_dev:
+            ih = np.asarray(out_i)
+            jh = np.asarray(out_j)
+            cnt = int(ih[-1])
+            return r, own(ih[:cnt], len(ih)), own(jh[:cnt], len(jh))
+        if mesh is None:
+            # uncompacted CPU backend: compact host-side. Rule overlap is
+            # rare in practice, so most chunks keep everything — detect
+            # the all-keep case and return zero-copy slices instead of
+            # paying the boolean-indexed copy
+            kh = np.asarray(keep)[:n_valid]
+            ih = np.asarray(out_i)[:n_valid]
+            jh = np.asarray(out_j)[:n_valid]
+            if kh.all():
+                return r, own(ih, out_i.shape[0]), own(jh, out_j.shape[0])
+            return r, ih[kh], jh[kh]  # boolean indexing already copies
+        # mesh: padded tail positions carry keep=False, compact directly
+        kh = np.asarray(keep)
+        return r, np.asarray(out_i)[kh], np.asarray(out_j)[kh]
+
+    try:
+        for r, rp in enumerate(plan.rules):
+            if rp.total == 0:
+                continue
+            # clamp to this rule's total (power-of-two bucket): a 38k-pair
+            # rule must not pad to a multi-M batch of dead lanes
+            rule_bs = min(batch_size, _pow2(max(rp.total, 64)))
+            if mesh is not None:
+                rule_bs = pad_to_multiple(rule_bs, mesh.devices.size)
+            pos_rule = pos_cache.get(rule_bs)
+            if pos_rule is None:
+                if mesh is not None:
+                    pos_rule = jax.device_put(
+                        np.arange(rule_bs, dtype=np.int32), shard
+                    )
+                else:
+                    pos_rule = jnp.arange(rule_bs, dtype=jnp.int32)
+                pos_cache[rule_bs] = pos_rule
+            order_dev = put(rp.order)
+            units_dev = tuple(put(a) for a in (rp.ua, rp.la, rp.ub, rp.lb))
+            kkey = (
+                r, rule_bs, None if mesh is None else id(mesh), compact_dev,
+            )
+            fn = plan.kernel_cache.get(kkey)
+            if fn is None:
+                fn = plan.kernel_cache[kkey] = make_pair_emit_fn(
+                    rule_bs,
+                    n_prev=r,
+                    has_uid_mask=plan.uid_codes is not None,
+                    rank_filter=rp.rank_filter,
+                    own_res=rp.residual_fn,
+                    prev_res=tuple(
+                        p.residual_fn for p in plan.rules[:r]
+                    ),
+                    mesh=mesh,
+                    compact=compact_dev,
+                )
+            for p0, p1, meta in _unit_batch_meta(rp.pc, rp.total, rule_bs):
+                meta_dev = put(meta)
+                out_i, out_j, keep = fn(
+                    pos_rule, order_dev, *units_dev, ranks_dev,
+                    codes_l_dev, codes_r_dev, uid_dev, res_ops_dev,
+                    meta_dev,
+                )
+                inflight.append(
+                    pool.submit(fetch, r, out_i, out_j, keep, p1 - p0)
+                )
+                while len(inflight) > _D2H_DEPTH:
+                    yield inflight.popleft().result()
+        while inflight:
+            yield inflight.popleft().result()
+    finally:
+        # the consumer may abandon the generator mid-stream (a sink error):
+        # do not leak pool threads or pinned buffers
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
+def device_block_rules(
+    settings: dict,
+    table: EncodedTable,
+    n_left: int | None,
+    sink,
+    pair_consumer=None,
+    mode: str = "auto",
+):
+    """The device tier of :func:`blocking.block_using_rules`: build the
+    plan, stream chunked emission into the caller's sink, and return the
+    finished PairIndex — or None to fall back to the host join (unsupported
+    shape, or an "auto"-mode job too small to pay the jit warmup). A plan
+    that FAILS to build never aborts the run (the host path is always
+    there); an emission failure propagates — the sink already holds pairs.
+    """
+    if mode == "auto":
+        import jax
+
+        from .blocking import estimate_pair_upper_bound
+
+        if jax.default_backend() == "cpu":
+            # measured (BENCHMARKS.md round 8, 2-core container): the
+            # XLA-CPU tier ties the numpy host join and trails the native
+            # C++ one ~0.75x — on the CPU backend auto keeps the host
+            # path; 'on' still forces the device tier (tests, parity)
+            return None
+        if estimate_pair_upper_bound(settings, table, n_left) < AUTO_MIN_PAIRS:
+            return None
+    try:
+        plan = build_device_plan(settings, table, n_left)
+    except Exception as e:  # noqa: BLE001 - never lose a run to the new tier
+        logger.warning(
+            "device blocking plan build failed (%s: %s); falling back to "
+            "host blocking", type(e).__name__, e,
+        )
+        return None
+    if plan is None:
+        return None
+    batch = int(
+        settings.get("blocking_chunk_pairs") or DEFAULT_CHUNK_PAIRS
+    )
+    logger.info(
+        "device blocking: %d candidate positions, %d rules",
+        plan.n_candidates, len(plan.rules),
+    )
+    for _r, i, j in iter_device_pairs(plan, batch):
+        sink.append(i, j)
+        if pair_consumer is not None:
+            pair_consumer(
+                i.astype(sink.idx_dtype, copy=False),
+                j.astype(sink.idx_dtype, copy=False),
+            )
+    return sink.finish()
+
+
+# --------------------------------------------------------------------------
+# Serving bucket CSR (serve/index.py)
+# --------------------------------------------------------------------------
+
+
+def build_bucket_csr(codes: np.ndarray):
+    """Device bucket-CSR build over one rule's key codes for the serving
+    index: (rows_sorted, starts, sizes, row_bucket) int32 arrays bit-equal
+    to the host ``_sort_groups`` + scatter construction, or None when the
+    codes don't fit the device lanes (the caller falls back to the host
+    build)."""
+    n = len(codes)
+    if n == 0 or int(codes.max(initial=0)) >= _IMAX:
+        return None
+    m = _pow2(n)
+    padded = codes.astype(np.int32)
+    if m != n:
+        padded = np.concatenate([padded, np.full(m - n, -1, np.int32)])
+    fn = make_bucket_csr_fn()
+    row_s, starts, sizes, row_bucket, n_seg, n_valid = fn(padded)
+    n_valid_h = int(np.asarray(n_valid))
+    n_seg_h = int(np.asarray(n_seg))
+    starts = np.asarray(starts)[:n_seg_h]
+    sizes = np.asarray(sizes)[:n_seg_h]
+    live = starts < n_valid_h  # drop the trailing null/pad segment
+    return (
+        np.asarray(row_s)[:n_valid_h],
+        starts[live],
+        sizes[live],
+        np.asarray(row_bucket)[:n],
+    )

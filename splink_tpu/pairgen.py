@@ -729,6 +729,144 @@ def _pair_counts(ua, la, ub, lb) -> np.ndarray:
     return np.concatenate([[0], np.cumsum(cnt)])
 
 
+def _uid_mask_codes(table: EncodedTable, link_type: str) -> np.ndarray | None:
+    """Dense int32 ordering-key codes for the device duplicate-uid mask, or
+    None when the ordering keys are unique (the common case — then the
+    strict rank ordering alone reproduces the reference's l.key < r.key).
+    link_and_dedupe keys are (source, uid), the reference's `_source_table`
+    tie-break (/root/reference/splink/blocking.py:139)."""
+    _, keys_unique = _uid_ranks(table, link_type)
+    if keys_unique:
+        return None
+    uid = np.asarray(table.unique_id)
+    _, uid_codes = np.unique(uid, return_inverse=True)
+    uid_codes = uid_codes.astype(np.int64)
+    if link_type == "link_and_dedupe":
+        uid_codes = uid_codes * 2 + np.asarray(table.source_table, np.int64)
+        _, uid_codes = np.unique(uid_codes, return_inverse=True)
+    return uid_codes.astype(np.int32)
+
+
+def _unit_batch_meta(pc: np.ndarray, total: int, rule_bs: int):
+    """One metadata row [u0, valid, pc_rel...] per batch of ``rule_bs``
+    positions, padded to ONE power-of-two kpad for the whole rule (one
+    kernel specialisation per rule). pc_rel entries past the last unit
+    (and padding) are int32 max and fall out of the unit lookup; the int32
+    clip cannot corrupt in-batch positions because the driver already
+    clamped the batch size below 2^31 - chunk^2."""
+    starts = list(range(0, total, rule_bs))
+    u0s, u1s = [], []
+    for p0 in starts:
+        p1 = min(p0 + rule_bs, total)
+        u0s.append(int(np.searchsorted(pc, p0, side="right")) - 1)
+        u1s.append(int(np.searchsorted(pc, p1 - 1, side="right")) - 1)
+    kmax = max(u1 - u0 + 2 for u0, u1 in zip(u0s, u1s))
+    kpad = 1 << int(max(kmax, 2) - 1).bit_length()
+    imax = np.iinfo(np.int32).max
+    out = []
+    for b, p0 in enumerate(starts):
+        u0, u1 = u0s[b], u1s[b]
+        p1 = min(p0 + rule_bs, total)
+        pc_rel = (pc[u0 : u1 + 2] - p0).astype(np.int64)
+        meta = np.full(kpad + 2, imax, np.int32)
+        meta[0] = u0
+        meta[1] = p1 - p0
+        meta[2 : u1 - u0 + 4] = np.clip(pc_rel, -(1 << 31) + 1, imax)
+        out.append((p0, p1, meta))
+    return out
+
+
+def unit_decode(pos, order, ua, la, ub, lb, meta, *, mesh_ladder: bool):
+    """Shared traced decode: batch-relative int32 positions -> (i, j, valid)
+    row-index pairs, via the unit tables. The ONE implementation of the
+    triangle/rectangle position decode, composed by the virtual pattern
+    kernel here and the device blocking emission kernel
+    (splink_tpu/blocking_device.py) — f32 math is exact because unit
+    extents are bounded by CHUNK (module docstring)."""
+    import jax.numpy as jnp
+
+    u0 = meta[0]
+    valid = meta[1]
+    pc_slice = meta[2:]
+    kpad = pc_slice.shape[0]
+    bs = pos.shape[0]
+    if not mesh_ladder:
+        # positions are consecutive within the batch, so the unit
+        # index is a monotone step function of pos: scatter +1 at
+        # every unit start position and prefix-sum. One small
+        # scatter-add (kpad updates) + one cumsum replaces a
+        # log2(kpad)-step per-position binary search — the search's
+        # ~11 gathers per position were the bulk of the decode cost
+        # on chip (178ms/batch vs 43ms for the whole gamma+score).
+        # pc_slice[1:] are the batch-relative starts of units
+        # u0+1...; entries past the last unit (and padding) are int32
+        # max and fall into the dropped overflow slot.
+        starts = pc_slice[1:]
+        idx = jnp.clip(starts, 0, bs)
+        marks = jnp.zeros(bs + 1, jnp.int32).at[idx].add(
+            jnp.where(starts < bs, 1, 0), mode="drop"
+        )[:bs]
+        ui = jnp.cumsum(marks, dtype=jnp.int32)
+    else:
+        # under a mesh, pos arrives SHARDED along the batch axis; a
+        # cumsum there would need cross-device prefix comms, so keep
+        # the branchless bit ladder: largest ui with
+        # pc_slice[ui] <= pos (pc_slice is replicated, power-of-two
+        # padded with int32 max, and pc_slice[0] <= 0 <= pos). NOT
+        # jnp.searchsorted: its scan lowering wraps a vmapped while
+        # loop XLA refuses to fuse through.
+        ui = jnp.zeros_like(pos)
+        half = kpad >> 1
+        while half:
+            cand = ui + half
+            ui = jnp.where(pc_slice[cand] <= pos, cand, ui)
+            half >>= 1
+    t = pos - pc_slice[ui]
+    u = u0 + ui
+    # four separate 1-word gathers beat a packed (n_units, 4) row
+    # gather here: the 4-wide minor dim pads to the 128 lane width on
+    # TPU and wastes 32x the bandwidth (measured 2.19s vs 1.55s for
+    # the 16M-position pass)
+    A = ua[u]
+    LA = la[u]
+    Bs = ub[u]
+    LB = lb[u]
+    tri = A == Bs
+    # triangle decode: f32 sqrt is exact for LA <= CHUNK (disc < 2^24),
+    # then a +-1 integer correction absorbs the floor rounding
+    lf = LA.astype(jnp.float32)
+    tf = t.astype(jnp.float32)
+    disc = (2.0 * lf - 1.0) ** 2 - 8.0 * tf
+    a_t = jnp.floor(
+        ((2.0 * lf - 1.0) - jnp.sqrt(jnp.maximum(disc, 0.0))) / 2.0
+    ).astype(jnp.int32)
+
+    def off(a):
+        return a * LA - (a * (a + 1)) // 2
+
+    a_t = jnp.where(off(a_t + 1) <= t, a_t + 1, a_t)
+    a_t = jnp.where(off(a_t) > t, a_t - 1, a_t)
+    b_t = t - off(a_t) + a_t + 1
+    lb_safe = jnp.maximum(LB, 1)
+    # rectangle decode without integer division (no VPU int-div; XLA
+    # expands // by a non-constant into a long scalar sequence): f32
+    # reciprocal multiply is within 1 of exact for t < 2^23 (unit
+    # pair counts are < CHUNK^2 = 2^22), then a +-1 correction lands
+    # it
+    q = jnp.floor(
+        t.astype(jnp.float32) * (1.0 / lb_safe.astype(jnp.float32))
+    ).astype(jnp.int32)
+    q = jnp.where((q + 1) * lb_safe <= t, q + 1, q)
+    q = jnp.where(q * lb_safe > t, q - 1, q)
+    a_r = q
+    b_r = t - a_r * lb_safe
+    a = jnp.where(tri, a_t, a_r)
+    b = jnp.where(tri, b_t, b_r)
+    i = order[A + a]
+    j = order[Bs + b]
+    return i, j, valid
+
+
 def build_virtual_plan(
     settings: dict, table: EncodedTable, n_left: int | None = None,
     chunk: int | None = None,
@@ -793,21 +931,11 @@ def build_virtual_plan(
     uid_codes = None
     if link_type in ("dedupe_only", "link_and_dedupe"):
         # link_and_dedupe is a self-join over the concatenated table with
-        # (source, uid) as the ordering key — the reference's
-        # `_source_table` tie-break (/root/reference/splink/blocking.py:139)
-        ranks, keys_unique = _uid_ranks(table, link_type)
-        if not keys_unique:
-            # duplicate ordering keys: the strict l.key < r.key ordering
-            # drops equal-key pairs — dense codes feed the device mask
-            uid = np.asarray(table.unique_id)
-            _, uid_codes = np.unique(uid, return_inverse=True)
-            uid_codes = uid_codes.astype(np.int64)
-            if link_type == "link_and_dedupe":
-                uid_codes = uid_codes * 2 + np.asarray(
-                    table.source_table, np.int64
-                )
-                _, uid_codes = np.unique(uid_codes, return_inverse=True)
-            uid_codes = uid_codes.astype(np.int32)
+        # (source, uid) as the ordering key; duplicate ordering keys mean
+        # the strict l.key < r.key ordering drops equal-key pairs — dense
+        # codes feed the device mask (None when keys are unique)
+        ranks, _ = _uid_ranks(table, link_type)
+        uid_codes = _uid_mask_codes(table, link_type)
 
     plans: list[RulePlan] = []
     codes_all = np.empty((len(rules), n), np.int32)
@@ -999,85 +1127,9 @@ def make_virtual_pattern_fn(program, batch_size: int, n_prev: int,
         # driver with device_put (async on every backend measured; see
         # the driver-loop comment for why it must never be an eager
         # device-side slice of a preuploaded table instead).
-        u0 = meta[0]
-        valid = meta[1]
-        pc_slice = meta[2:]
-        kpad = pc_slice.shape[0]
-        bs = pos.shape[0]
-        if mesh is None:
-            # positions are consecutive within the batch, so the unit
-            # index is a monotone step function of pos: scatter +1 at
-            # every unit start position and prefix-sum. One small
-            # scatter-add (kpad updates) + one cumsum replaces a
-            # log2(kpad)-step per-position binary search — the search's
-            # ~11 gathers per position were the bulk of the decode cost
-            # on chip (178ms/batch vs 43ms for the whole gamma+score).
-            # pc_slice[1:] are the batch-relative starts of units
-            # u0+1...; entries past the last unit (and padding) are int32
-            # max and fall into the dropped overflow slot.
-            starts = pc_slice[1:]
-            idx = jnp.clip(starts, 0, bs)
-            marks = jnp.zeros(bs + 1, jnp.int32).at[idx].add(
-                jnp.where(starts < bs, 1, 0), mode="drop"
-            )[:bs]
-            ui = jnp.cumsum(marks, dtype=jnp.int32)
-        else:
-            # under a mesh, pos arrives SHARDED along the batch axis; a
-            # cumsum there would need cross-device prefix comms, so keep
-            # the branchless bit ladder: largest ui with
-            # pc_slice[ui] <= pos (pc_slice is replicated, power-of-two
-            # padded with int32 max, and pc_slice[0] <= 0 <= pos). NOT
-            # jnp.searchsorted: its scan lowering wraps a vmapped while
-            # loop XLA refuses to fuse through.
-            ui = jnp.zeros_like(pos)
-            half = kpad >> 1
-            while half:
-                cand = ui + half
-                ui = jnp.where(pc_slice[cand] <= pos, cand, ui)
-                half >>= 1
-        t = pos - pc_slice[ui]
-        u = u0 + ui
-        # four separate 1-word gathers beat a packed (n_units, 4) row
-        # gather here: the 4-wide minor dim pads to the 128 lane width on
-        # TPU and wastes 32x the bandwidth (measured 2.19s vs 1.55s for
-        # the 16M-position pass)
-        A = ua[u]
-        LA = la[u]
-        Bs = ub[u]
-        LB = lb[u]
-        tri = A == Bs
-        # triangle decode: f32 sqrt is exact for LA <= CHUNK (disc < 2^24),
-        # then a +-1 integer correction absorbs the floor rounding
-        lf = LA.astype(jnp.float32)
-        tf = t.astype(jnp.float32)
-        disc = (2.0 * lf - 1.0) ** 2 - 8.0 * tf
-        a_t = jnp.floor(
-            ((2.0 * lf - 1.0) - jnp.sqrt(jnp.maximum(disc, 0.0))) / 2.0
-        ).astype(jnp.int32)
-
-        def off(a):
-            return a * LA - (a * (a + 1)) // 2
-
-        a_t = jnp.where(off(a_t + 1) <= t, a_t + 1, a_t)
-        a_t = jnp.where(off(a_t) > t, a_t - 1, a_t)
-        b_t = t - off(a_t) + a_t + 1
-        lb_safe = jnp.maximum(LB, 1)
-        # rectangle decode without integer division (no VPU int-div; XLA
-        # expands // by a non-constant into a long scalar sequence): f32
-        # reciprocal multiply is within 1 of exact for t < 2^23 (unit
-        # pair counts are < CHUNK^2 = 2^22), then a +-1 correction lands
-        # it
-        q = jnp.floor(
-            t.astype(jnp.float32) * (1.0 / lb_safe.astype(jnp.float32))
-        ).astype(jnp.int32)
-        q = jnp.where((q + 1) * lb_safe <= t, q + 1, q)
-        q = jnp.where(q * lb_safe > t, q - 1, q)
-        a_r = q
-        b_r = t - a_r * lb_safe
-        a = jnp.where(tri, a_t, a_r)
-        b = jnp.where(tri, b_t, b_r)
-        i = order[A + a]
-        j = order[Bs + b]
+        i, j, valid = unit_decode(
+            pos, order, ua, la, ub, lb, meta, mesh_ladder=mesh is not None
+        )
 
         masked = pos >= valid
         if has_uid_mask:
@@ -1267,30 +1319,12 @@ def _virtual_pass_iter(program, plan: VirtualPlan, batch_size: int,
                         mesh=None, two_phase=False,
                     )
                 return efn
-            # One metadata row [u0, valid, pc_rel...] per batch, padded to ONE
-            # power-of-two kpad for the whole rule (one kernel specialisation
-            # per rule). Uploaded per batch with device_put — uploads are
-            # ASYNC on every backend measured (including the tunnelled axon
-            # platform, where they cost ~0.2ms dispatched vs 67ms for an
-            # EAGER device-side op like meta_dev[b]; never slice eagerly in
-            # this loop).
-            starts = list(range(0, rp.total, rule_bs))
-            u0s, u1s = [], []
-            for p0 in starts:
-                p1 = min(p0 + rule_bs, rp.total)
-                u0s.append(int(np.searchsorted(rp.pc, p0, side="right")) - 1)
-                u1s.append(int(np.searchsorted(rp.pc, p1 - 1, side="right")) - 1)
-            kmax = max(u1 - u0 + 2 for u0, u1 in zip(u0s, u1s))
-            kpad = 1 << int(max(kmax, 2) - 1).bit_length()
-            imax = np.iinfo(np.int32).max
-            for b, p0 in enumerate(starts):
-                u0, u1 = u0s[b], u1s[b]
-                p1 = min(p0 + rule_bs, rp.total)
-                pc_rel = (rp.pc[u0 : u1 + 2] - p0).astype(np.int64)
-                meta = np.full(kpad + 2, imax, np.int32)
-                meta[0] = u0
-                meta[1] = p1 - p0
-                meta[2 : u1 - u0 + 4] = np.clip(pc_rel, -(1 << 31) + 1, imax)
+            # One metadata row per batch (_unit_batch_meta), uploaded per
+            # batch with device_put — uploads are ASYNC on every backend
+            # measured (including the tunnelled axon platform, where they
+            # cost ~0.2ms dispatched vs 67ms for an EAGER device-side op
+            # like meta_dev[b]; never slice eagerly in this loop).
+            for p0, p1, meta in _unit_batch_meta(rp.pc, rp.total, rule_bs):
                 meta_dev = put(meta)
                 pid, acc = fn(
                     pos_rule, packed, order_dev, *units_dev, codes_dev,

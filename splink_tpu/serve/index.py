@@ -673,8 +673,18 @@ def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
                 "vocab": _string_vocab(sc),
             }
 
+        # same backend policy as device_block_rules: 'auto' keeps the host
+        # argsort on the CPU backend (the XLA-CPU sort measured slower —
+        # BENCHMARKS.md round 8); 'on' forces the device CSR anywhere
+        import jax
+
+        blk_mode = settings.get("device_blocking", "auto")
+        device_csr = blk_mode == "on" or (
+            blk_mode != "off" and jax.default_backend() != "cpu"
+        )
         rules = [
-            _build_serve_rule(table, rule) for rule in rules_text
+            _build_serve_rule(table, rule, device=device_csr)
+            for rule in rules_text
         ]
 
         from ..term_frequencies import term_frequency_columns
@@ -726,15 +736,32 @@ def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
             clear_key_code_cache(table)
 
 
-def _build_serve_rule(table: EncodedTable, rule: str) -> ServeRule:
+def _build_serve_rule(
+    table: EncodedTable, rule: str, device: bool = True
+) -> ServeRule:
     """One rule's frozen bucket index from the same key codes blocking
-    joins on."""
+    joins on. The device-resident part of the build — the bucket CSR
+    (rows_sorted/starts/sizes/row_bucket) — runs through the device
+    segmented-sort kernel (blocking_device.build_bucket_csr, bit-equal to
+    the host construction); the host keeps only the O(buckets)
+    representative-token dict loop. ``device=False`` (or an unsupported
+    code range) takes the host argsort path."""
     key_cols = _rule_key_cols(rule)
     codes = _key_codes(table, key_cols)
     n = table.n_rows
-    rows = np.flatnonzero(codes >= 0).astype(np.int32)
-    rows_sorted, uniq_codes, starts, sizes = _sort_groups(codes, rows)
-    n_buckets = len(uniq_codes)
+    csr = None
+    if device and n:
+        from ..blocking_device import build_bucket_csr
+
+        csr = build_bucket_csr(codes)
+    if csr is not None:
+        rows_sorted, starts, sizes, row_bucket_dev = csr
+        n_buckets = len(starts)
+    else:
+        row_bucket_dev = None
+        rows = np.flatnonzero(codes >= 0).astype(np.int32)
+        rows_sorted, uniq_codes, starts, sizes = _sort_groups(codes, rows)
+        n_buckets = len(uniq_codes)
     if n_buckets == 0:
         # every key null: empty dict, 1-element dummy CSR so device
         # gathers stay in bounds (qbucket is always -1)
@@ -746,10 +773,13 @@ def _build_serve_rule(table: EncodedTable, rule: str) -> ServeRule:
             sizes=np.zeros(1, np.int32),
             row_bucket=np.full(n, -1, np.int32),
         )
-    row_bucket = np.full(n, -1, np.int32)
-    row_bucket[rows_sorted] = np.repeat(
-        np.arange(n_buckets, dtype=np.int32), sizes
-    )
+    if row_bucket_dev is not None:
+        row_bucket = row_bucket_dev
+    else:
+        row_bucket = np.full(n, -1, np.int32)
+        row_bucket[rows_sorted] = np.repeat(
+            np.arange(n_buckets, dtype=np.int32), sizes
+        )
     # host-side key -> bucket dictionary from one representative row per
     # bucket, via the same canonicalisation queries resolve through
     reps = rows_sorted[starts]

@@ -53,6 +53,8 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "mesh",
     "pair_batch_size",
     "max_resident_pairs",
+    "device_blocking",
+    "blocking_chunk_pairs",
     "spill_dir",
     "profile_dir",
     "telemetry_dir",
