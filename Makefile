@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke trace-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -58,6 +58,17 @@ chaos-smoke:
 blocking-smoke:
 	python scripts/blocking_smoke.py
 
+# Request-tracing smoke: the serving tier under an injected slow batch +
+# breaker storm with tracing at full sample rate, asserting the
+# attribution contract — per-request phase durations sum to the measured
+# wall latency within 5%, every request closes exactly one span tree with
+# a machine-readable outcome, the breaker storm dumps the flight recorder
+# to a JSONL that round-trips through `obs summarize`, and steady-state
+# recompiles stay at ZERO with tracing enabled
+# (docs/observability.md#serve-tracing).
+trace-smoke:
+	python scripts/trace_smoke.py
+
 bench:
 	python bench.py
 
@@ -65,4 +76,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke serve-smoke chaos-smoke bench
+all: lint test tpu-smoke blocking-smoke serve-smoke chaos-smoke trace-smoke bench

@@ -1,0 +1,273 @@
+"""Trace smoke (`make trace-smoke`): the attribution contract under fault.
+
+Runs the serving tier with request tracing at full sample rate, injects a
+slow batch and a breaker storm (resilience/faults.py serve sites), and
+asserts the observability contract end to end:
+
+  1. ATTRIBUTION — for every delivered request trace, the phase durations
+     (admission / queue_wait / coalesce / dispatch / compile / execute /
+     transfer / deliver) sum to the measured wall latency within 5%;
+  2. COMPLETENESS — every submitted request closes exactly one span tree
+     (delivered count == non-shed results; shed trees carry the
+     machine-readable reason, the slow-batch timeout included);
+  3. ZERO RECOMPILES — steady-state traffic with tracing enabled performs
+     zero jit compiles, and the delivered traces attribute ~zero compile
+     time (tracing must not perturb the bucket contract);
+  4. FLIGHT RECORDER — the breaker storm dumps the ring to JSONL; the dump
+     round-trips through `read_events` + the summarize CLI and contains
+     both the degradation timeline and recent span trees;
+  5. TOOLING — `obs attribute` renders a tail decomposition over the run's
+     record, and the Prometheus exposition endpoint serves the
+     splink_serve_* series the dashboard reads.
+
+Exits nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WAVE_TIMEOUT_S = 60
+PHASE_SUM_TOLERANCE = 0.05
+
+
+def _settings():
+    return {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.surname = r.surname"],
+        "max_iterations": 4,
+        "serve_top_k": 16,
+        "serve_query_buckets": [16, 128],
+        "serve_candidate_buckets": [64, 256],
+        "serve_deadline_ms": 2,
+        "serve_breaker_threshold": 2,
+        "serve_probe_queries": 0,
+        "serve_trace_sample_rate": 1.0,
+    }
+
+
+def _corpus(n=200, seed=7):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    return pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+
+
+def _set_plan(spec):
+    from splink_tpu.resilience import faults
+
+    faults.reset_plans()
+    if spec:
+        os.environ[faults.ENV_VAR] = spec
+    else:
+        os.environ.pop(faults.ENV_VAR, None)
+
+
+def _drive(svc, records):
+    futures = [svc.submit(dict(r)) for r in records]
+    return [f.result(timeout=WAVE_TIMEOUT_S) for f in futures]
+
+
+def _assert_attribution(traces, what):
+    """Every delivered tree's phases must sum to its wall within 5%."""
+    delivered = [e for e in traces if e.get("outcome") == "delivered"]
+    assert delivered, f"{what}: no delivered traces"
+    worst = 0.0
+    for ev in delivered:
+        wall = float(ev["wall_ms"])
+        total = sum(ev["phases_ms"].values())
+        err = abs(total - wall) / max(wall, 1e-6)
+        worst = max(worst, err)
+        assert err <= PHASE_SUM_TOLERANCE or abs(total - wall) < 0.05, (
+            f"{what}: phases sum {total:.3f}ms != wall {wall:.3f}ms "
+            f"({err:.1%} off): {ev}"
+        )
+    return delivered, worst
+
+
+def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
+    import warnings
+
+    from splink_tpu import Splink
+    from splink_tpu.obs.cli import attribute_events, summarize_events
+    from splink_tpu.obs.events import (
+        EventSink,
+        read_events,
+        register_ambient,
+    )
+    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.obs.reqtrace import PHASES
+    from splink_tpu.serve import LinkageService, QueryEngine, build_index
+
+    install_compile_monitor()
+    warnings.simplefilter("ignore")  # degradations are asserted via events
+    tmp = tempfile.mkdtemp(prefix="splink_trace_")
+    events_path = os.path.join(tmp, "trace_events.jsonl")
+    sink = EventSink(events_path, run_id="trace-smoke")
+    register_ambient(sink)
+
+    df = _corpus()
+    linker = Splink(_settings(), df=df)
+    linker.estimate_parameters()
+    engine = QueryEngine(build_index(linker))
+    warm = engine.warmup()
+    records = df.head(100).to_dict(orient="records")
+    wave = records[:20]
+
+    def traces():
+        sink_events = read_events(events_path)
+        return [e for e in sink_events if e.get("type") == "request_trace"]
+
+    # ---- 1+2+3: steady-state attribution, completeness, zero recompiles -
+    _set_plan("")
+    svc = LinkageService(
+        engine, deadline_ms=2.0, watchdog_interval_s=0.05,
+        breaker_cooldown_s=0.3,
+    )
+    svc._flight.dump_dir = os.path.join(tmp, "flight")
+    c0, _ = compile_totals()
+    results = _drive(svc, records)
+    c1, _ = compile_totals()
+    assert not any(r.shed for r in results), "steady state must not shed"
+    assert c1 - c0 == 0, (
+        f"tracing added {c1 - c0} steady-state recompile(s)"
+    )
+    delivered, worst = _assert_attribution(traces(), "steady state")
+    assert len(delivered) == len(records), (
+        f"{len(delivered)} trees for {len(records)} requests"
+    )
+    for ev in delivered:
+        assert set(ev["phases_ms"]) == set(PHASES)
+        assert ev["phases_ms"]["compile"] < 1.0, (
+            f"steady-state compile attribution: {ev['phases_ms']}"
+        )
+    print(f"trace 1 ok: {len(delivered)} delivered trees, phases sum to "
+          f"wall (worst error {worst:.2%}), 0 recompiles, "
+          f"warmup={warm['combinations']} combos")
+
+    # ---- slow batch: attribution under stall + timeout shed reason ------
+    _set_plan("serve_batch@times=1:kind=slow:delay_ms=500")
+    stalled = [svc.submit(dict(r)) for r in wave]  # the stalled batch
+    res = svc.query(dict(wave[0]), timeout=0.15)  # queued behind the stall
+    assert res.shed and res.reason == "timeout", res
+    stalled_res = [f.result(timeout=WAVE_TIMEOUT_S) for f in stalled]
+    assert not any(r.shed for r in stalled_res), "the slow batch serves"
+    time.sleep(0.1)
+    tr = traces()
+    slow = [
+        e for e in tr if e.get("outcome") == "delivered"
+        and e["wall_ms"] > 400
+    ]
+    assert slow, "the stalled batch's traces must show the 500ms stall"
+    _assert_attribution(slow, "slow batch")
+    timeout_trees = [e for e in tr if e.get("reason") == "timeout"]
+    assert len(timeout_trees) == 1 and timeout_trees[0]["outcome"] == "shed"
+    print(f"trace 2 ok: stall attributed ({len(slow)} slow trees), "
+          "timeout cancellation closed its tree with reason=timeout")
+
+    # ---- breaker storm: shed reasons + flight-recorder dump -------------
+    _set_plan("serve_batch@times=2")
+    storm1 = _drive(svc, wave)  # failed batch 1
+    storm2 = _drive(svc, wave)  # failed batch 2: the breaker opens
+    # wave 3 hits the OPEN breaker inside its cooldown: fail-fast sheds
+    # with the machine-readable breaker_open reason
+    storm3 = _drive(svc, wave)
+    assert all(r.shed for r in storm1 + storm2 + storm3), (
+        "storm batches must shed"
+    )
+    deadline = time.monotonic() + 10
+    while not svc._flight.dumps and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert svc._flight.dumps, "breaker-open must dump the flight recorder"
+    dump_path = svc._flight.dumps[0]
+    dump = read_events(dump_path)
+    header = dump[0]
+    assert header["type"] == "flight_header", header
+    assert header["trigger"] == "breaker_open", header
+    types = {e["type"] for e in dump}
+    assert "degradation" in types, types
+    assert "request_trace" in types, types
+    rendered = summarize_events(dump)
+    assert "flight dump" in rendered and "request traces" in rendered
+    tr = traces()
+    reasons = {e.get("reason") for e in tr if e.get("outcome") == "shed"}
+    assert {"timeout", "batch_error", "breaker_open"} <= reasons, reasons
+    # recovery: the watchdog probe closes the breaker, traffic resumes
+    deadline = time.monotonic() + 10
+    while svc.breaker.state != "closed" and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert svc.breaker.state == "closed", "watchdog probe never recovered"
+    results = _drive(svc, wave)
+    assert not any(r.shed for r in results), "post-storm traffic must serve"
+    print(f"trace 3 ok: breaker storm shed with machine-readable reasons, "
+          f"flight dump at {os.path.basename(dump_path)} "
+          f"({header['records']} records) round-trips through summarize")
+
+    # ---- 5: attribute CLI + exposition endpoint -------------------------
+    report = attribute_events(read_events(events_path))
+    assert "tail-latency attribution" in report
+    for phase in PHASES:
+        assert phase in report, f"attribute report missing {phase}"
+    from splink_tpu.obs.exposition import ExpositionServer
+
+    server = ExpositionServer(0)
+    server.add_source("serve", svc.prometheus_samples)
+    port = server.start()
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ) as resp:
+        body = resp.read().decode()
+    assert "splink_serve_served_total" in body
+    assert "splink_serve_phase_ms" in body
+    assert "splink_serve_slo_burn_rate" in body
+    server.close()
+    slo = svc.slo_snapshot()
+    assert slo["total_good"] > 0 and slo["total_bad"] > 0
+    svc.close()
+    summary = svc.latency_summary()
+    print("trace 4 ok: attribute CLI + exposition endpoint serve the "
+          f"record ({summary['traces']['sampled']} sampled, "
+          f"slo burn windows {sorted(slo['windows'])})")
+
+    sink.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({
+        "metric": "trace_smoke",
+        "delivered_trees": summary["traces"]["outcomes"].get("delivered"),
+        "shed_trees": summary["traces"]["outcomes"].get("shed"),
+        "worst_phase_sum_error": round(worst, 5),
+        "steady_state_recompiles": c1 - c0,
+    }))
+    print("trace-smoke OK: attribution sums within 5%, flight dump "
+          "landed, zero steady-state recompiles with tracing on")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

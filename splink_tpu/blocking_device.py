@@ -508,12 +508,22 @@ def iter_device_pairs(plan: DeviceBlockPlan, batch_size: int, mesh=None):
     next chunk's kernel. Chunk shapes are power-of-two bucketed per rule —
     a steady-state emission loop compiles nothing after the first chunk of
     each rule.
+
+    Telemetry: the driver accumulates host-side emission stats — chunks,
+    pairs, pairs/sec, per-chunk budget fill and D2H thread-pool occupancy —
+    and publishes ONE ambient ``blocking_device`` event when the stream
+    ends (``python -m splink_tpu.obs summarize`` renders it). Pure host
+    counters on the driver loop: the kernels and their jaxprs are
+    untouched, and with no sink registered the publish is one falsy check.
     """
+    import time as _time
     from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
     import jax
     import jax.numpy as jnp
+
+    from .obs.events import publish
 
     if plan.n_candidates == 0:
         return
@@ -557,6 +567,26 @@ def iter_device_pairs(plan: DeviceBlockPlan, batch_size: int, mesh=None):
     pos_cache: dict = {}
     pool = ThreadPoolExecutor(max_workers=_D2H_DEPTH)
     inflight: deque = deque()
+    # emission telemetry (host counters; published once in the finally).
+    # fill/occupancy accumulate at SUBMIT time, so their means divide by
+    # the submitted count — on an abandoned stream (the [abandoned] case
+    # summarize flags) up to _D2H_DEPTH chunks are submitted but never
+    # yielded, and dividing by the yield-time chunk count would inflate
+    # exactly the diagnostics the event exists for
+    stats = {"chunks": 0, "submitted": 0, "pairs": 0, "candidates": 0,
+             "fill_sum": 0.0, "occ_sum": 0, "occ_max": 0,
+             "completed": False}
+    per_rule: dict[int, list] = {}
+    t_start = _time.perf_counter()
+
+    def account(res):
+        r_idx, i, _j = res
+        stats["chunks"] += 1
+        stats["pairs"] += len(i)
+        rr = per_rule.setdefault(r_idx, [0, 0])
+        rr[0] += 1
+        rr[1] += len(i)
+        return res
 
     def own(arr, lanes):
         """Slice views into downloaded chunk buffers are zero-copy; when a
@@ -635,17 +665,53 @@ def iter_device_pairs(plan: DeviceBlockPlan, batch_size: int, mesh=None):
                     codes_l_dev, codes_r_dev, uid_dev, res_ops_dev,
                     meta_dev,
                 )
+                stats["submitted"] += 1
+                stats["candidates"] += p1 - p0
+                stats["fill_sum"] += (p1 - p0) / rule_bs
                 inflight.append(
                     pool.submit(fetch, r, out_i, out_j, keep, p1 - p0)
                 )
+                occ = len(inflight)
+                stats["occ_sum"] += occ
+                if occ > stats["occ_max"]:
+                    stats["occ_max"] = occ
                 while len(inflight) > _D2H_DEPTH:
-                    yield inflight.popleft().result()
+                    yield account(inflight.popleft().result())
         while inflight:
-            yield inflight.popleft().result()
+            yield account(inflight.popleft().result())
+        stats["completed"] = True
     finally:
         # the consumer may abandon the generator mid-stream (a sink error):
         # do not leak pool threads or pinned buffers
         pool.shutdown(wait=False, cancel_futures=True)
+        try:
+            elapsed = max(_time.perf_counter() - t_start, 1e-9)
+            n_sub = stats["submitted"] or 1
+            publish(
+                "blocking_device",
+                rules=len(plan.rules),
+                chunks=stats["chunks"],
+                pairs=stats["pairs"],
+                candidates=stats["candidates"],
+                elapsed_s=round(elapsed, 4),
+                pairs_per_sec=round(stats["pairs"] / elapsed),
+                chunk_budget=batch_size,
+                mean_chunk_fill=round(stats["fill_sum"] / n_sub, 4),
+                d2h_occupancy_mean=round(stats["occ_sum"] / n_sub, 3),
+                d2h_occupancy_max=stats["occ_max"],
+                d2h_depth=_D2H_DEPTH,
+                completed=stats["completed"],
+                per_rule=[
+                    {
+                        "rule": plan.rules[r_idx].rule,
+                        "chunks": c,
+                        "pairs": p,
+                    }
+                    for r_idx, (c, p) in sorted(per_rule.items())
+                ],
+            )
+        except Exception as e:  # noqa: BLE001 - telemetry must never break emission
+            logger.debug("blocking_device telemetry publish failed: %s", e)
 
 
 def device_block_rules(

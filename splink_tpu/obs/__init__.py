@@ -18,7 +18,18 @@ Layers (each importable on its own, none imports jax at module scope):
     memory snapshots.
   * :mod:`.runtime` — :class:`RunContext`, the per-linker object wiring the
     three together; created from the ``telemetry_dir`` settings key.
-  * :mod:`.cli`     — ``python -m splink_tpu.obs summarize|export-trace``.
+  * :mod:`.reqtrace` — request-level serve tracing (obs v2): per-request
+    span trees whose phase durations sum to the wall latency, sampled via
+    ``serve_trace_sample_rate``.
+  * :mod:`.slo`     — rolling deadline-hit-rate objectives + multi-window
+    error-budget burn rates.
+  * :mod:`.exposition` — stdlib Prometheus text endpoint
+    (``obs_exposition_port``).
+  * :mod:`.flight`  — bounded crash flight recorder, dumped to JSONL on
+    breaker-open / worker restart / swap rollback / SIGUSR2
+    (``obs_flight_records``).
+  * :mod:`.cli`     — ``python -m splink_tpu.obs
+    summarize|export-trace|attribute|serve-dash``.
 
 Zero-cost contract: with no sink configured (``telemetry_dir`` empty) the
 linker adds NO host callbacks and compiled programs are unchanged — the
@@ -30,8 +41,12 @@ See docs/observability.md for the event schema and CLI usage.
 """
 
 from .events import EventSink, publish, read_events
+from .exposition import ExpositionServer, Sample
+from .flight import FlightRecorder
 from .metrics import MetricsRegistry, compile_totals, install_compile_monitor
+from .reqtrace import PHASES, PhaseProfile, RequestTrace, ServeTracer
 from .runtime import RunContext
+from .slo import SLOTracker
 from .tracer import Tracer, chrome_trace_from_events
 
 __all__ = [
@@ -44,4 +59,12 @@ __all__ = [
     "RunContext",
     "Tracer",
     "chrome_trace_from_events",
+    "PHASES",
+    "PhaseProfile",
+    "RequestTrace",
+    "ServeTracer",
+    "SLOTracker",
+    "ExpositionServer",
+    "Sample",
+    "FlightRecorder",
 ]

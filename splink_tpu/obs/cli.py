@@ -1,9 +1,13 @@
-"""CLI: ``python -m splink_tpu.obs summarize|export-trace <run.jsonl>``.
+"""CLI: ``python -m splink_tpu.obs
+summarize|export-trace|attribute|serve-dash``.
 
 ``summarize`` renders a per-stage / per-iteration report of one run's
 telemetry record; ``export-trace`` converts it to Chrome trace-event JSON
-(load at ui.perfetto.dev). This module's logic is pure stdlib and never
-initialises a jax backend or touches a device — but invoking it as
+(load at ui.perfetto.dev); ``attribute`` decomposes serve tail latency
+into the request-trace phases (obs v2 — which phase ate the p99);
+``serve-dash`` renders a live terminal dashboard by polling a service's
+Prometheus exposition endpoint. This module's logic is pure stdlib and
+never initialises a jax backend or touches a device — but invoking it as
 ``python -m splink_tpu.obs`` imports the ``splink_tpu`` package, whose
 top-level ``__init__`` imports jax, so the package's dependencies must be
 installed (a record copied to a dependency-free machine can still be read
@@ -15,8 +19,10 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from .events import read_events
+from .reqtrace import PHASES, _quantile
 from .tracer import chrome_trace_from_events
 
 
@@ -35,6 +41,14 @@ def summarize_events(events: list[dict]) -> str:
     hosts = sorted({e.get("process_index", 0) for e in events})
     lines.append(f"run {run_id}  ({len(events)} events, {wall:.3f}s, "
                  f"host(s) {', '.join(str(h) for h in hosts)})")
+
+    # a flight-recorder dump (obs/flight.py) opens with its header line
+    flight = [e for e in events if e.get("type") == "flight_header"]
+    for ev in flight:
+        lines.append(
+            f"flight dump: trigger={ev.get('trigger')} "
+            f"service={ev.get('service')} records={ev.get('records')}"
+        )
 
     # ---- stages ----------------------------------------------------------
     stages: dict[str, dict] = {}
@@ -89,6 +103,71 @@ def summarize_events(events: list[dict]) -> str:
                 f"{('yes' if ev.get('converged') else ''):>6}"
             )
 
+    # ---- request traces (serve tier, obs v2) -----------------------------
+    traces = [e for e in events if e.get("type") == "request_trace"]
+    if traces:
+        by_outcome: dict[str, int] = {}
+        reasons: dict[str, int] = {}
+        for ev in traces:
+            oc = ev.get("outcome") or "?"
+            by_outcome[oc] = by_outcome.get(oc, 0) + 1
+            if oc == "shed":
+                rs = ev.get("reason") or "?"
+                reasons[rs] = reasons.get(rs, 0) + 1
+        lines.append("")
+        lines.append(
+            f"request traces: {len(traces)} ("
+            + ", ".join(f"{k} {v}" for k, v in sorted(by_outcome.items()))
+            + ")"
+        )
+        if reasons:
+            lines.append(
+                "  shed reasons: "
+                + ", ".join(f"{k}={v}"
+                            for k, v in sorted(reasons.items()))
+            )
+        delivered = [e for e in traces if e.get("outcome") == "delivered"]
+        if delivered:
+            walls = sorted(
+                float(e.get("wall_ms") or 0.0) for e in delivered
+            )
+            lines.append(
+                f"  delivered wall ms: p50={_quantile(walls, 0.5):.2f} "
+                f"p95={_quantile(walls, 0.95):.2f} "
+                f"p99={_quantile(walls, 0.99):.2f}"
+            )
+            lines.append(f"  {'phase':<12}{'p50 ms':>10}{'p99 ms':>10}")
+            for phase in PHASES:
+                vals = sorted(
+                    float((e.get("phases_ms") or {}).get(phase) or 0.0)
+                    for e in delivered
+                )
+                lines.append(
+                    f"  {phase:<12}{_quantile(vals, 0.5):>10.3f}"
+                    f"{_quantile(vals, 0.99):>10.3f}"
+                )
+
+    # ---- device-blocking emission telemetry ------------------------------
+    blocking = [e for e in events if e.get("type") == "blocking_device"]
+    if blocking:
+        lines.append("")
+        lines.append(f"device blocking: {len(blocking)} emission run(s)")
+        for ev in blocking:
+            lines.append(
+                f"  pairs={ev.get('pairs'):,} chunks={ev.get('chunks')} "
+                f"pairs/s={ev.get('pairs_per_sec'):,} "
+                f"budget={ev.get('chunk_budget'):,} "
+                f"fill={ev.get('mean_chunk_fill')} "
+                f"d2h_occupancy={ev.get('d2h_occupancy_mean')}"
+                f"/{ev.get('d2h_occupancy_max')}"
+                + ("" if ev.get("completed") else "  [abandoned]")
+            )
+            for rr in ev.get("per_rule") or []:
+                lines.append(
+                    f"    rule {rr.get('rule')!r}: {rr.get('pairs'):,} "
+                    f"pairs in {rr.get('chunks')} chunk(s)"
+                )
+
     # ---- resilience events ----------------------------------------------
     # serve-tier events (health transitions, breaker state changes, index
     # hot-swaps, worker restarts, brown-out boundaries) belong in the same
@@ -141,6 +220,196 @@ def summarize_events(events: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def attribute_events(events: list[dict]) -> str:
+    """Tail-latency attribution over a record's ``request_trace`` events:
+    decompose the delivered p99 into the phase partition — for the
+    requests at and above the p99 wall, where did the time actually go.
+
+    The report's invariant (gated by ``make trace-smoke``): per request,
+    the phases sum to the measured wall latency within 5%."""
+    delivered = [
+        e for e in events
+        if e.get("type") == "request_trace"
+        and e.get("outcome") == "delivered"
+    ]
+    if not delivered:
+        return "(no delivered request traces in this record)"
+    walls = sorted(float(e.get("wall_ms") or 0.0) for e in delivered)
+    p50 = _quantile(walls, 0.50)
+    p95 = _quantile(walls, 0.95)
+    p99 = _quantile(walls, 0.99)
+    tail = [
+        e for e in delivered if float(e.get("wall_ms") or 0.0) >= p99
+    ] or delivered
+    lines = [
+        f"tail-latency attribution over {len(delivered)} delivered "
+        f"request trace(s)",
+        f"wall ms: p50={p50:.2f}  p95={p95:.2f}  p99={p99:.2f}  "
+        f"(tail set: {len(tail)} request(s) at/above p99)",
+        "",
+        f"{'phase':<12}{'p50 ms':>10}{'p99 ms':>10}{'tail mean':>12}"
+        f"{'tail share':>12}",
+    ]
+    tail_wall = sum(float(e.get("wall_ms") or 0.0) for e in tail) / len(tail)
+    covered = 0.0
+    for phase in PHASES:
+        vals = sorted(
+            float((e.get("phases_ms") or {}).get(phase) or 0.0)
+            for e in delivered
+        )
+        tail_mean = sum(
+            float((e.get("phases_ms") or {}).get(phase) or 0.0)
+            for e in tail
+        ) / len(tail)
+        share = (tail_mean / tail_wall) if tail_wall else 0.0
+        covered += share
+        lines.append(
+            f"{phase:<12}{_quantile(vals, 0.5):>10.3f}"
+            f"{_quantile(vals, 0.99):>10.3f}{tail_mean:>12.3f}"
+            f"{share:>11.1%}"
+        )
+    lines.append(
+        f"{'(sum)':<12}{'':>10}{'':>10}{'':>12}{covered:>11.1%}"
+    )
+    shed = [
+        e for e in events
+        if e.get("type") == "request_trace" and e.get("outcome") == "shed"
+    ]
+    if shed:
+        reasons: dict[str, int] = {}
+        for e in shed:
+            rs = e.get("reason") or "?"
+            reasons[rs] = reasons.get(rs, 0) + 1
+        lines.append("")
+        lines.append(
+            "shed (excluded from attribution): "
+            + ", ".join(f"{k}={v}" for k, v in sorted(reasons.items()))
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# serve-dash: poll the Prometheus exposition endpoint, render a terminal view
+# ---------------------------------------------------------------------------
+
+
+def parse_prometheus_text(text: str) -> list[tuple[str, dict, float]]:
+    """Parse Prometheus text exposition into (name, labels, value) rows
+    (enough for the dashboard; not a full openmetrics parser)."""
+    rows = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            series, value = line.rsplit(" ", 1)
+            labels: dict = {}
+            name = series
+            if "{" in series:
+                name, rest = series.split("{", 1)
+                for part in rest.rstrip("}").split(","):
+                    if not part:
+                        continue
+                    k, v = part.split("=", 1)
+                    labels[k] = v.strip('"')
+            rows.append((name, labels, float(value)))
+        except ValueError:
+            continue
+    return rows
+
+
+def render_dash(rows: list[tuple[str, dict, float]]) -> str:
+    """One terminal frame of the serve dashboard from parsed samples."""
+
+    def get(name, **labels):
+        for n, ls, v in rows:
+            if n == name and all(ls.get(k) == str(v2)
+                                 for k, v2 in labels.items()):
+                return v
+        return None
+
+    def fmt(v, spec="{:.0f}", missing="-"):
+        return spec.format(v) if v is not None else missing
+
+    replicas = sorted(
+        {ls.get("replica") for n, ls, _ in rows
+         if n == "splink_serve_served_total" and ls.get("replica")}
+    )
+    lines = [f"splink_tpu serve dashboard  ({time.strftime('%H:%M:%S')})"]
+    for rep in replicas:
+        health = get("splink_serve_health_rank", replica=rep)
+        state = {0: "healthy", 1: "degraded", 2: "broken"}.get(
+            int(health) if health is not None else -1, "?"
+        )
+        breaker = get("splink_serve_breaker_open", replica=rep)
+        lines.append("")
+        lines.append(
+            f"replica {rep}: {state}"
+            + ("  [BREAKER OPEN]" if breaker else "")
+        )
+        lines.append(
+            f"  served={fmt(get('splink_serve_served_total', replica=rep))}"
+            f"  shed={fmt(get('splink_serve_shed_total', replica=rep))}"
+            f"  q/s={fmt(get('splink_serve_queries_per_sec', replica=rep), '{:.1f}')}"
+            f"  queue={fmt(get('splink_serve_queue_fill', replica=rep), '{:.0%}')}"
+            f"  gen={fmt(get('splink_serve_index_generation', replica=rep))}"
+        )
+        lines.append(
+            "  latency ms: "
+            + "  ".join(
+                f"p{q}={fmt(get('splink_serve_latency_ms', replica=rep, quantile=f'p{q}'), '{:.2f}')}"
+                for q in (50, 95, 99)
+            )
+        )
+        phases = sorted({
+            ls.get("phase") for n, ls, _ in rows
+            if n == "splink_serve_phase_ms" and ls.get("replica") == rep
+        })
+        if phases:
+            lines.append("  phase p99 ms: " + "  ".join(
+                f"{p}={fmt(get('splink_serve_phase_ms', replica=rep, phase=p, quantile='p99'), '{:.2f}')}"
+                for p in PHASES if p in phases
+            ))
+        windows = sorted(
+            {ls.get("window_s") for n, ls, _ in rows
+             if n == "splink_serve_slo_burn_rate"
+             and ls.get("replica") == rep},
+            key=lambda w: int(w) if w and w.isdigit() else 0,
+        )
+        if windows:
+            lines.append("  slo burn: " + "  ".join(
+                f"{w}s={fmt(get('splink_serve_slo_burn_rate', replica=rep, window_s=w), '{:.2f}')}"
+                for w in windows
+            ))
+    if not replicas:
+        lines.append("(no splink_serve_* series at this endpoint)")
+    return "\n".join(lines)
+
+
+def serve_dash(url: str, interval: float, count: int | None) -> int:
+    """Poll ``url`` and render frames until interrupted (or ``count``
+    frames, for scripting/tests)."""
+    import urllib.request
+
+    frames = 0
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                text = resp.read().decode("utf-8", "replace")
+            frame = render_dash(parse_prometheus_text(text))
+        except Exception as e:  # noqa: BLE001 - a dead endpoint is a frame, not a crash
+            frame = f"splink_tpu serve dashboard\n\n(endpoint {url}: {e})"
+        print("\x1b[2J\x1b[H" + frame if count is None else frame,
+              flush=True)
+        frames += 1
+        if count is not None and frames >= count:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:  # pragma: no cover - interactive exit
+            return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m splink_tpu.obs",
@@ -158,7 +427,28 @@ def main(argv=None) -> int:
         "-o", "--output", default=None,
         help="output path (default: <path>.trace.json; '-' for stdout)",
     )
+    p_att = sub.add_parser(
+        "attribute",
+        help="decompose serve tail latency into request-trace phases",
+    )
+    p_att.add_argument("path", help="telemetry JSONL file")
+    p_dash = sub.add_parser(
+        "serve-dash",
+        help="live terminal dashboard over a service's Prometheus endpoint",
+    )
+    p_dash.add_argument(
+        "--url", default="http://127.0.0.1:9464/metrics",
+        help="exposition endpoint (obs_exposition_port setting)",
+    )
+    p_dash.add_argument("--interval", type=float, default=1.0)
+    p_dash.add_argument(
+        "--count", type=int, default=None,
+        help="render N frames then exit (default: until interrupted)",
+    )
     args = parser.parse_args(argv)
+
+    if args.command == "serve-dash":
+        return serve_dash(args.url, args.interval, args.count)
 
     try:
         events = read_events(args.path)
@@ -168,6 +458,9 @@ def main(argv=None) -> int:
 
     if args.command == "summarize":
         print(summarize_events(events))
+        return 0
+    if args.command == "attribute":
+        print(attribute_events(events))
         return 0
 
     trace = chrome_trace_from_events(events)

@@ -1,0 +1,196 @@
+"""Prometheus text-exposition endpoint, pure stdlib (``http.server``).
+
+The serving tier's metrics live in process memory (latency reservoirs,
+phase summaries, SLO windows, health snapshots). Production monitoring
+wants them scrapeable; this module serves them in the Prometheus text
+format (version 0.0.4) without adding a dependency: a
+:class:`ThreadingHTTPServer` on the opt-in ``obs_exposition_port`` settings
+key (0 — the default — disables; the server binds 127.0.0.1, a deliberate
+scrape-via-sidecar / port-forward posture rather than an open listener).
+
+Sources are pull-based: a component registers a zero-argument callable
+returning :class:`Sample` rows, and the handler renders them at scrape
+time — no background collection thread, no staleness, and a source that
+raises is skipped with a warning rather than failing the scrape.
+
+``GET /metrics`` returns the exposition; ``GET /healthz`` returns 200 with
+a one-line JSON of each source's name (a liveness probe that does not pay
+for a full render). ``python -m splink_tpu.obs serve-dash`` renders a
+terminal dashboard by polling this endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger("splink_tpu")
+
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+@dataclass
+class Sample:
+    """One exposition row: ``name{labels} value``."""
+
+    name: str
+    value: float
+    labels: dict = field(default_factory=dict)
+    type: str = "gauge"
+    help: str = ""
+
+
+def _escape_label(value) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def render_samples(samples: list[Sample]) -> str:
+    """Render samples as Prometheus text format, grouping rows into
+    families (one ``# HELP`` / ``# TYPE`` header per metric name, first
+    sample's metadata wins)."""
+    families: dict[str, list[Sample]] = {}
+    for s in samples:
+        families.setdefault(s.name, []).append(s)
+    lines: list[str] = []
+    for name, rows in families.items():
+        head = rows[0]
+        if head.help:
+            lines.append(f"# HELP {name} {head.help}")
+        mtype = head.type if head.type in _TYPES else "untyped"
+        lines.append(f"# TYPE {name} {mtype}")
+        for s in rows:
+            if s.value is None:
+                continue
+            label_str = ""
+            if s.labels:
+                inner = ",".join(
+                    f'{k}="{_escape_label(v)}"'
+                    for k, v in sorted(s.labels.items())
+                )
+                label_str = "{" + inner + "}"
+            value = float(s.value)
+            if value == int(value) and abs(value) < 1e15:
+                rendered = str(int(value))
+            else:
+                rendered = repr(value)
+            lines.append(f"{name}{label_str} {rendered}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "splink-tpu-obs"
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/metrics"
+        if path == "/metrics":
+            body = self.server.exposition.render().encode("utf-8")
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/healthz":
+            body = (
+                json.dumps({"sources": self.server.exposition.source_names()})
+                + "\n"
+            ).encode("utf-8")
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # noqa: D102 - scrapes must not spam stderr
+        pass
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ExpositionServer:
+    """The opt-in metrics endpoint (module docstring). ``port=0`` binds an
+    ephemeral port (tests); read the bound port back from :attr:`port`
+    after :meth:`start`."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._host = host
+        self._port = int(port)
+        self._sources: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._server: _Server | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- sources --------------------------------------------------------
+
+    def add_source(self, name: str, fn) -> None:
+        """Register ``fn() -> list[Sample]`` under ``name`` (replacing any
+        previous source of that name)."""
+        with self._lock:
+            self._sources[name] = fn
+
+    def remove_source(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def source_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._sources)
+
+    def render(self) -> str:
+        samples: list[Sample] = []
+        with self._lock:
+            sources = list(self._sources.items())
+        for name, fn in sources:
+            try:
+                samples.extend(fn())
+            except Exception as e:  # noqa: BLE001 - one bad source must not 500 the scrape
+                logger.warning("exposition source %s failed: %s", name, e)
+        return render_samples(samples)
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def port(self) -> int | None:
+        return self._server.server_address[1] if self._server else None
+
+    @property
+    def url(self) -> str | None:
+        return (
+            f"http://{self._host}:{self.port}/metrics"
+            if self._server
+            else None
+        )
+
+    def start(self) -> int:
+        """Bind and serve on a daemon thread; returns the bound port."""
+        if self._server is not None:
+            return self.port
+        server = _Server((self._host, self._port), _Handler)
+        server.exposition = self
+        self._server = server
+        self._thread = threading.Thread(
+            target=server.serve_forever,
+            name="splink-obs-exposition",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.port
+
+    def close(self) -> None:
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -88,7 +88,7 @@ class Tracer:
 
 
 # Track rows in the chrome trace, one per span kind.
-_KIND_TID = {"run": 0, "stage": 1, "em_iteration": 2}
+_KIND_TID = {"run": 0, "stage": 1, "em_iteration": 2, "request": 3}
 
 
 def chrome_trace_from_events(events: list[dict]) -> dict:
@@ -97,6 +97,10 @@ def chrome_trace_from_events(events: list[dict]) -> dict:
     * ``span`` events -> complete ("X") slices, microsecond timestamps on
       the run's monotonic timebase, one pid per controller process and one
       tid row per span kind;
+    * ``request_trace`` events (serve tier, obs v2) -> one slice per
+      phase, laid out back-to-back from the request's submit time on the
+      "requests" row, with the request envelope in the args — the per-
+      request waterfall Perfetto renders directly;
     * ``em_iteration``/resilience/``memory`` events -> instant ("i")
       markers, so retries/faults/checkpoints show up on the timeline.
 
@@ -108,6 +112,29 @@ def chrome_trace_from_events(events: list[dict]) -> dict:
         pid = int(ev.get("process_index", 0) or 0)
         pids.add(pid)
         etype = ev.get("type")
+        if etype == "request_trace":
+            t = float(ev.get("t0", 0.0)) * 1e6
+            envelope = {
+                k: ev.get(k)
+                for k in ("trace_id", "request_id", "attempt", "hedge",
+                          "service", "outcome", "reason", "wall_ms")
+            }
+            for phase, dur_ms in (ev.get("phases_ms") or {}).items():
+                dur = max(float(dur_ms or 0.0), 0.0) * 1e3
+                trace_events.append(
+                    {
+                        "name": f"{phase} [{ev.get('request_id', '?')}]",
+                        "cat": "request",
+                        "ph": "X",
+                        "ts": t,
+                        "dur": dur,
+                        "pid": pid,
+                        "tid": _KIND_TID["request"],
+                        "args": dict(envelope, phase=phase),
+                    }
+                )
+                t += dur
+            continue
         if etype == "span":
             tid = _KIND_TID.get(ev.get("kind", "stage"), 1)
             trace_events.append(
@@ -148,6 +175,7 @@ def chrome_trace_from_events(events: list[dict]) -> dict:
         {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
          "args": {"name": row}}
         for pid in sorted(pids)
-        for row, tid in (("run", 0), ("stages", 1), ("em / events", 2))
+        for row, tid in (("run", 0), ("stages", 1), ("em / events", 2),
+                         ("requests", 3))
     ]
     return {"traceEvents": meta + trace_events, "displayTimeUnit": "ms"}

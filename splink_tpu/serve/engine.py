@@ -341,7 +341,7 @@ class QueryEngine:
         """Host-side query encode (see LinkageIndex.encode_queries)."""
         return self.index.encode_queries(df)
 
-    def query_arrays(self, df, *, degraded: bool = False):
+    def query_arrays(self, df, *, degraded: bool = False, profile=None):
         """Score a query DataFrame; returns
         ``(top_p, top_rows, top_valid, n_candidates)`` numpy arrays of
         shape (n, k) / (n,). ``top_rows`` are reference ROW indices; map
@@ -350,7 +350,13 @@ class QueryEngine:
         ``degraded=True`` runs the brown-out program: top-k
         ``brownout_top_k`` over candidates truncated to the cheapest
         bucket (``brownout_capacity``) — the budgeted answer the service
-        serves under pressure instead of shedding."""
+        serves under pressure instead of shedding.
+
+        ``profile`` (an :class:`~..obs.reqtrace.PhaseProfile`) accumulates
+        the batch's compile/execute/transfer split for request tracing.
+        Profiling splits the EXISTING single result rendezvous into a
+        compute wait plus the D2H fetch — it adds no new host sync and
+        leaves the compiled programs untouched."""
         with self._swap_lock:
             k = self.brownout_top_k if degraded else self.top_k
             if degraded and not k:
@@ -365,7 +371,8 @@ class QueryEngine:
             pos = 0
             for q_pad, start, stop in self.policy.iter_query_chunks(batch.n):
                 p, r, v, nc = self._run_chunk(
-                    batch, start, stop, q_pad, degraded=degraded
+                    batch, start, stop, q_pad, degraded=degraded,
+                    profile=profile,
                 )
                 out_p[start:stop] = p[: stop - start]
                 out_rows[start:stop] = r[: stop - start]
@@ -376,7 +383,7 @@ class QueryEngine:
             return out_p, out_rows, out_valid, out_ncand
 
     def _run_chunk(self, batch, start: int, stop: int, q_pad: int, *,
-                   degraded: bool = False):
+                   degraded: bool = False, profile=None):
         """One bucketed device dispatch: pad the chunk to ``q_pad`` queries
         and its candidate axis to a policy bucket, run the fused kernel,
         fetch once."""
@@ -414,6 +421,10 @@ class QueryEngine:
         qb_pad = np.empty((len(index.rules), q_pad), np.int32)
         qb_pad[:, :n] = qb
         dev = index.device_state()
+        if profile is not None:
+            from ..obs.metrics import compile_totals
+
+            c0 = compile_totals()[1]
         top_p, top_rows, top_valid, n_cand = kernel(
             capacity,
             jnp.asarray(packed_pad),
@@ -429,13 +440,34 @@ class QueryEngine:
         (self._warmed_brownout if degraded else self._warmed).add(
             (q_pad, capacity)
         )
-        # the single host fetch for this batch
-        return (
+        if profile is None:
+            # the single host fetch for this batch
+            return (
+                np.asarray(top_p),
+                np.asarray(top_rows),
+                np.asarray(top_valid),
+                np.asarray(n_cand),
+            )
+        # traced batch: split the SAME single rendezvous into its parts —
+        # compile (monitor delta; zero in steady state), device compute
+        # (block_until_ready on the already-dispatched outputs) and the
+        # D2H fetch. No additional sync point: the untraced path blocks at
+        # exactly this line inside np.asarray instead.
+        import jax
+
+        profile.compile_s += max(compile_totals()[1] - c0, 0.0)
+        t0 = time.perf_counter()
+        jax.block_until_ready((top_p, top_rows, top_valid, n_cand))
+        t1 = time.perf_counter()
+        profile.execute_s += t1 - t0
+        out = (
             np.asarray(top_p),
             np.asarray(top_rows),
             np.asarray(top_valid),
             np.asarray(n_cand),
         )
+        profile.transfer_s += time.perf_counter() - t1
+        return out
 
     def query(self, df):
         """Score a query DataFrame; returns a tidy DataFrame with one row

@@ -48,19 +48,32 @@ class ReplicaRouter:
     (floor ``_DEFAULT_HEDGE_FLOOR_MS`` while the reservoir is cold).
     """
 
-    def __init__(self, replicas, *, hedge_ms=None, telemetry=None):
+    def __init__(self, replicas, *, hedge_ms=None, telemetry=None,
+                 trace_sample_rate=None):
+        from ..obs.reqtrace import ServeTracer
+
         self._replicas = list(replicas)
         if not self._replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
+        first = self._replicas[0]
+        settings = getattr(
+            getattr(getattr(first, "engine", None), "index", None),
+            "settings",
+            {},
+        ) or {}
         if hedge_ms is None:
-            first = self._replicas[0]
-            settings = getattr(
-                getattr(getattr(first, "engine", None), "index", None),
-                "settings",
-                {},
-            ) or {}
             hedge_ms = settings.get("serve_hedge_ms", 0) or 0
         self.hedge_ms = hedge_ms
+        # Request tracing (obs v2): the router MINTS the trace context —
+        # one trace_id per logical request, one attempt per replica
+        # dispatch (primary / failover / hedge) — and each replica closes
+        # the attempts it resolves through its own tracer, so phase
+        # attribution lands on the replica that did the work. The shared
+        # TraceRoot guarantees exactly one `delivered` span tree per
+        # request even when a hedge race serves it twice.
+        if trace_sample_rate is None:
+            trace_sample_rate = settings.get("serve_trace_sample_rate", 0.0)
+        self._tracer = ServeTracer(trace_sample_rate or 0.0, service="router")
         self._obs = telemetry
         self._lock = threading.Lock()
         self._rr = 0
@@ -110,7 +123,8 @@ class ReplicaRouter:
         over, the hedge timer (when enabled) races a second replica."""
         order = self._ordered()
         call = _HedgedCall(
-            self, order, record, deadline_ms, self._hedge_delay_ms(order[0])
+            self, order, record, deadline_ms, self._hedge_delay_ms(order[0]),
+            trace=self._tracer.maybe_start(),
         )
         call.start()
         return call.out
@@ -163,7 +177,8 @@ class _HedgedCall:
     plus at most one time-triggered hedge dispatch. Thread-safe; the
     ``out`` future resolves exactly once."""
 
-    def __init__(self, router, order, record, deadline_ms, hedge_delay_ms):
+    def __init__(self, router, order, record, deadline_ms, hedge_delay_ms,
+                 trace=None):
         from concurrent.futures import Future
 
         self.router = router
@@ -171,6 +186,7 @@ class _HedgedCall:
         self.record = record
         self.deadline_ms = deadline_ms
         self.hedge_delay_ms = hedge_delay_ms
+        self.trace = trace  # shared-root context; one child per attempt
         self.out: Future = Future()
         self._lock = threading.Lock()
         self._next = 0
@@ -205,10 +221,23 @@ class _HedgedCall:
                 self._hedge_idx = idx
             svc = self.order[idx]
         self.router._bump("dispatched")
+        # trace propagation is duck-typed like the replicas themselves:
+        # only a replica that declares `accepts_trace` (LinkageService, or
+        # a future RPC stub that forwards the context) receives the
+        # attempt; fakes and plain replicas keep the PR 6 signature
+        att = None
+        if self.trace is not None and getattr(svc, "accepts_trace", False):
+            att = self.trace.child(attempt=idx, hedge=hedge)
         try:
-            fut = svc.submit(self.record, deadline_ms=self.deadline_ms)
+            if att is not None:
+                fut = svc.submit(
+                    self.record, deadline_ms=self.deadline_ms, trace=att
+                )
+            else:
+                fut = svc.submit(self.record, deadline_ms=self.deadline_ms)
         except Exception as e:  # noqa: BLE001 - a throwing replica is a shed
             logger.warning("replica submit failed, failing over: %s", e)
+            self.router._tracer.close(att, "shed", reason="submit_error")
             self._finish_attempt(idx, None)
             return idx
         fut.add_done_callback(lambda f, i=idx: self._on_done(i, f))

@@ -43,6 +43,30 @@ Per-request latency (enqueue -> result set) feeds a bounded reservoir;
 :meth:`latency_summary` reports p50/p95/p99 and throughput, and with a
 telemetry ``RunContext`` the summary lands in the run record (``python -m
 splink_tpu.obs summarize``) alongside per-batch ``serve_batch`` spans.
+
+Request-level observability (obs v2, docs/observability.md#serve-tracing):
+
+* **Tracing** — with ``serve_trace_sample_rate`` > 0, sampled requests
+  carry a trace context (:mod:`..obs.reqtrace`) through the queue,
+  coalescer and engine dispatch; the span tree closes exactly once at
+  delivery/shed/cancel with phase durations (admission / queue_wait /
+  coalesce / dispatch / compile / execute / transfer / deliver) that sum
+  to the measured wall latency. ``python -m splink_tpu.obs attribute``
+  decomposes the tail; ``make trace-smoke`` gates the invariant.
+* **SLO** — every request (sampled or not) feeds an
+  :class:`~..obs.slo.SLOTracker`: delivered = good, shed = bad, rolling
+  hit rate + multi-window burn rate via :meth:`slo_snapshot`.
+* **Flight recorder** — a bounded ring (``obs_flight_records``) of recent
+  span trees and health/breaker/swap transitions, dumped atomically to
+  JSONL on breaker-open, worker restart, swap rollback or SIGUSR2
+  (:mod:`..obs.flight`).
+* **Exposition** — ``obs_exposition_port`` serves all of the above in
+  Prometheus text format (:mod:`..obs.exposition`); ``obs serve-dash``
+  renders it live.
+
+All of it is host-side bookkeeping: compiled programs are untouched, the
+hot path gains no host sync, and sampling keeps obs-on overhead within the
+bench-measured budget (BENCHMARKS.md round 9).
 """
 
 from __future__ import annotations
@@ -89,6 +113,10 @@ class LinkageService:
     """Micro-batching query front-end over a :class:`~.engine.QueryEngine`
     (module docstring)."""
 
+    #: routers check this before forwarding a trace context (duck-typed
+    #: replicas without it keep the PR 6 submit signature)
+    accepts_trace = True
+
     def __init__(
         self,
         engine,
@@ -105,6 +133,10 @@ class LinkageService:
         compile_stall_s: float = 0.25,
         probe_queries: int | None = None,
         health_monitor: HealthMonitor | None = None,
+        trace_sample_rate: float | None = None,
+        slo_objective: float = 0.999,
+        flight_records: int | None = None,
+        exposition_port: int | None = None,
     ):
         settings = engine.index.settings
         self.engine = engine
@@ -140,7 +172,9 @@ class LinkageService:
         self._obs = telemetry
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
-        self._queue: deque = deque()  # (record, future, t_enqueue, deadline)
+        # (record, future, t_enqueue, deadline, trace) — trace is None for
+        # unsampled requests, so the tracing-off path costs one tuple slot
+        self._queue: deque = deque()
         self._inflight: list = []  # entries popped by the worker, unresolved
         self._probe_buffer: list = []  # records accumulating toward capture
         self._latencies: deque = deque(maxlen=_LATENCY_RESERVOIR)
@@ -174,6 +208,54 @@ class LinkageService:
         from ..obs.metrics import compile_totals
 
         self._last_compile_s = compile_totals()[1]
+        # -- obs v2: request tracing, SLO, flight recorder, exposition ---
+        from ..obs.events import register_ambient
+        from ..obs.flight import FlightRecorder
+        from ..obs.reqtrace import ServeTracer
+        from ..obs.slo import SLOTracker
+
+        rate = float(
+            trace_sample_rate
+            if trace_sample_rate is not None
+            else settings.get("serve_trace_sample_rate", 0.0) or 0.0
+        )
+        n_flight = int(
+            flight_records
+            if flight_records is not None
+            else settings.get("obs_flight_records", 256) or 0
+        )
+        self._flight = FlightRecorder(
+            n_flight,
+            dump_dir=(settings.get("telemetry_dir") or None),
+            name=name,
+        )
+        if self._flight.enabled:
+            register_ambient(self._flight)
+        self._tracer = ServeTracer(rate, service=name, flight=self._flight)
+        if self._tracer.enabled:
+            from ..obs.metrics import install_compile_monitor
+
+            install_compile_monitor()  # the per-batch compile split
+        self._slo = SLOTracker(objective=slo_objective)
+        self._exposition = None
+        port = int(
+            exposition_port
+            if exposition_port is not None
+            else settings.get("obs_exposition_port", 0) or 0
+        )
+        if port:
+            try:
+                from ..obs.exposition import ExpositionServer
+
+                self._exposition = ExpositionServer(port)
+                self._exposition.add_source(name, self.prometheus_samples)
+                self._exposition.start()
+                logger.info(
+                    "serve metrics exposition on %s", self._exposition.url
+                )
+            except Exception as e:  # noqa: BLE001 - obs must not block serving
+                logger.warning("metrics exposition failed to start: %s", e)
+                self._exposition = None
         if autostart:
             self.start()
 
@@ -216,7 +298,7 @@ class LinkageService:
                     to_shed.append(self._queue.popleft())
             self._nonempty.notify_all()
         for entry in to_shed:
-            self._resolve_shed(entry[1], "closed")
+            self._resolve_shed(entry[1], "closed", entry[4])
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
@@ -228,7 +310,11 @@ class LinkageService:
             self._queue.clear()
             self._inflight = []
         for entry in stragglers:
-            self._resolve_shed(entry[1], "closed")
+            self._resolve_shed(entry[1], "closed", entry[4])
+        if self._exposition is not None:
+            self._exposition.close()
+            self._exposition = None
+        self._flight.close()  # unregister; the ring stays dump-able
         if self._obs is not None and not self._summary_recorded:
             # once per lifetime: close() is idempotent and must not emit
             # duplicate serve_latency records on repeated calls
@@ -243,15 +329,27 @@ class LinkageService:
 
     # -- submission -----------------------------------------------------
 
-    def submit(self, record: dict, deadline_ms: float | None = None) -> Future:
+    def submit(
+        self,
+        record: dict,
+        deadline_ms: float | None = None,
+        trace=None,
+    ) -> Future:
         """Enqueue one query record; never raises. Sheds immediately
         (future resolves ``shed=True`` + degradation event) when the
         service is closed, the bounded queue is full, or ``deadline_ms``
         is given and the estimated queue wait already exceeds it
         (reject-early admission, module docstring). A queued request's
         ``deadline_ms`` also rides into the batcher: lapsed requests are
-        shed at dispatch, never scored late."""
+        shed at dispatch, never scored late.
+
+        ``trace`` is an inbound :class:`~..obs.reqtrace.RequestTrace`
+        (router-minted attempt context); without one, the service's own
+        sampler decides. The trace closes exactly once, wherever this
+        request's future resolves."""
         fut: Future = Future()
+        if trace is None:
+            trace = self._tracer.maybe_start()
         reason = None
         with self._nonempty:
             closed = self._stop and self._thread is None
@@ -288,11 +386,17 @@ class LinkageService:
                     if deadline_ms is None
                     else time.monotonic() + deadline_ms / 1000.0
                 )
-                self._queue.append((record, fut, time.monotonic(), deadline))
+                if trace is not None:
+                    trace.mark("admit")
+                self._queue.append(
+                    (record, fut, time.monotonic(), deadline, trace)
+                )
                 self._nonempty.notify()
                 return fut
         # outside the lock: warn_degraded publishes + warns, both of which
         # may run user hooks
+        self._slo.observe(False)
+        self._tracer.close(trace, "shed", reason=reason)
         warn_degraded(
             "serve_admission" if reason == "deadline" else "serve_queue",
             "shed",
@@ -319,11 +423,20 @@ class LinkageService:
             return self._cancel_timed_out(fut, timeout)
 
     def _cancel_timed_out(self, fut: Future, timeout) -> QueryResult:
+        trace = None
         with self._nonempty:
             for i, entry in enumerate(self._queue):
                 if entry[1] is fut:
+                    trace = entry[4]
                     del self._queue[i]
                     break
+            else:
+                # mid-score: still in flight — find the trace so a won
+                # cancellation closes its span tree with the shed reason
+                for entry in self._inflight:
+                    if entry[1] is fut:
+                        trace = entry[4]
+                        break
         res = QueryResult(shed=True, reason="timeout")
         won = False
         if not fut.done():
@@ -337,6 +450,8 @@ class LinkageService:
         with self._lock:
             self._shed_count += 1
             self._timeouts += 1
+        self._slo.observe(False)
+        self._tracer.close(trace, "shed", reason="timeout")
         warn_degraded(
             "serve_timeout",
             "shed",
@@ -378,6 +493,10 @@ class LinkageService:
                 if self._stop:
                     return None
                 self._nonempty.wait(timeout=0.1)
+            # trace boundary: batch formation starts here — for a request
+            # already waiting, [enqueue, t_form) was queue_wait (time the
+            # worker spent on earlier batches); [t_form, pop) is coalesce
+            t_form = time.monotonic()
             deadline = self._queue[0][2] + self.deadline_ms / 1000.0
             while len(self._queue) < max_batch and not self._stop:
                 remaining = deadline - time.monotonic()
@@ -391,14 +510,24 @@ class LinkageService:
             take = min(len(self._queue), max_batch)
             batch = [self._queue.popleft() for _ in range(take)]
             self._inflight = batch
+            t_pop = time.monotonic()
+            for entry in batch:
+                tr = entry[4]
+                if tr is not None:
+                    # clamping in phase_durations handles entries that
+                    # enqueued after t_form (their queue_wait is zero)
+                    tr.marks["form"] = t_form
+                    tr.marks["pop"] = t_pop
             return batch
 
     def _clear_inflight(self) -> None:
         with self._lock:
             self._inflight = []
 
-    def _resolve_shed(self, fut: Future, reason: str) -> bool:
-        """Resolve one future shed (if still unresolved) and count it."""
+    def _resolve_shed(self, fut: Future, reason: str, trace=None) -> bool:
+        """Resolve one future shed (if still unresolved), count it, feed
+        the SLO tracker and close the request's span tree with the
+        machine-readable reason."""
         if fut.done():
             return False
         try:
@@ -407,6 +536,8 @@ class LinkageService:
             return False
         with self._lock:
             self._shed_count += 1
+        self._slo.observe(False)
+        self._tracer.close(trace, "shed", reason=reason)
         return True
 
     def _serve_batch(self, batch) -> None:
@@ -420,7 +551,7 @@ class LinkageService:
                 continue
             dl = entry[3]
             if dl is not None and now > dl:
-                self._resolve_shed(fut, "deadline")
+                self._resolve_shed(fut, "deadline", entry[4])
                 expired += 1
                 continue
             live.append(entry)
@@ -437,7 +568,7 @@ class LinkageService:
             return
         if self.breaker.should_fail_fast():
             for entry in live:
-                self._resolve_shed(entry[1], "breaker_open")
+                self._resolve_shed(entry[1], "breaker_open", entry[4])
             warn_degraded(
                 "serve_breaker",
                 "shed",
@@ -459,6 +590,16 @@ class LinkageService:
         records = [e[0] for e in live]
         futures = [e[1] for e in live]
         t_enq = [e[2] for e in live]
+        traces = [e[4] for e in live]
+        # one batch-level phase profile when any request is traced: every
+        # request in the batch waited through the same engine window, so
+        # the batch splits ARE each request's attribution
+        profile = None
+        if any(tr is not None for tr in traces):
+            from ..obs.reqtrace import PhaseProfile
+
+            profile = PhaseProfile()
+        swap_overlapped = self._swap_in_progress
         t0 = time.perf_counter()
         try:
             active_plan(self._settings).fire(
@@ -469,15 +610,15 @@ class LinkageService:
                 with self._obs.span(
                     "serve_batch", batch=len(live), degraded=degraded
                 ):
-                    results = self._score(df, degraded)
+                    results = self._score(df, degraded, profile)
             else:
-                results = self._score(df, degraded)
+                results = self._score(df, degraded, profile)
         except Exception as e:  # noqa: BLE001 - one bad batch must not kill the loop
             logger.exception("serve batch failed; shedding %d request(s)",
                              len(live))
             opened = self.breaker.on_failure()
-            for fut in futures:
-                self._resolve_shed(fut, "batch_error")
+            for entry in live:
+                self._resolve_shed(entry[1], "batch_error", entry[4])
             warn_degraded(
                 "serve_batch",
                 "shed",
@@ -493,10 +634,19 @@ class LinkageService:
                     f"{self.breaker.threshold} consecutive batch failures; "
                     "failing fast while probes test recovery",
                     cooldown_s=self.breaker.cooldown_s,
+                    replica=self.name,
                 )
             self._clear_inflight()
             return
         batch_ms = (time.perf_counter() - t0) * 1000.0
+        if profile is not None and (swap_overlapped or self._swap_in_progress):
+            # the compile split reads the PROCESS-global compile counter: a
+            # concurrent swap_index pre-warm (which deliberately compiles
+            # outside the dispatch lock while the old index keeps serving)
+            # would be mis-attributed as this batch's phantom steady-state
+            # compile — fold it into the dispatch residual instead, the
+            # same exclusion the health monitor's stall signal applies
+            profile.compile_s = 0.0
         if self.breaker.on_success():
             from ..obs.events import publish
 
@@ -504,6 +654,10 @@ class LinkageService:
             logger.info("serve circuit breaker closed: probe batch succeeded")
         self._admission.observe(batch_ms)
         now = time.monotonic()
+        generation = self.engine.generation
+        for tr in traces:
+            if tr is not None:
+                tr.marks["engine_out"] = now
         # deliver first, count after: a request cancelled by
         # query(timeout=) mid-score was already counted shed there —
         # counting it served too would make served+shed exceed
@@ -520,6 +674,18 @@ class LinkageService:
             except InvalidStateError:  # timed out in the same instant
                 continue
             delivered.append(res)
+            self._slo.observe(True)
+            # close the span tree AT resolution: the shared-root claim
+            # makes a hedge race yield exactly one delivered tree (the
+            # later delivery closes as `discarded`)
+            self._tracer.close(
+                traces[i],
+                "delivered",
+                profile=profile,
+                batch=len(live),
+                degraded=degraded,
+                generation=generation,
+            )
             if self._obs is not None:
                 self._obs.observe("serve_latency_ms", res.latency_ms)
         # counters AND latency deques under the lock: _health_signals
@@ -593,9 +759,10 @@ class LinkageService:
             logger.info("serve brown-out ended (queue %.0f%% full)",
                         q_fill * 100)
 
-    def _score(self, df, degraded: bool = False) -> list[QueryResult]:
+    def _score(self, df, degraded: bool = False,
+               profile=None) -> list[QueryResult]:
         top_p, top_rows, top_valid, n_cand = self.engine.query_arrays(
-            df, degraded=degraded
+            df, degraded=degraded, profile=profile
         )
         uids = self.engine.index.unique_id
         out = []
@@ -638,10 +805,11 @@ class LinkageService:
                 self._thread.start()
         if orphans is not None:
             n = sum(
-                self._resolve_shed(entry[1], "worker_restart")
+                self._resolve_shed(entry[1], "worker_restart", entry[4])
                 for entry in orphans
             )
-            publish("serve_worker_restart", orphaned=n, crashes=crashes)
+            publish("serve_worker_restart", orphaned=n, crashes=crashes,
+                    replica=self.name)
             warn_degraded(
                 "serve_worker",
                 "restarted",
@@ -804,4 +972,118 @@ class LinkageService:
                 p50_ms=float(p50), p95_ms=float(p95), p99_ms=float(p99),
                 mean_ms=float(lats.mean()),
             )
+        if self._tracer.enabled:
+            out["traces"] = self._tracer.snapshot()
+        return out
+
+    def phase_summary(self) -> dict:
+        """p50/p99 per phase (ms) over the recent delivered traces —
+        empty when tracing is off (``serve_trace_sample_rate`` 0). The
+        tail-latency attribution bench.py's serve mode emits."""
+        return self._tracer.phase_summary()
+
+    def slo_snapshot(self) -> dict:
+        """Rolling hit rate + multi-window burn rates
+        (:class:`~..obs.slo.SLOTracker`): delivered = good, shed = bad."""
+        return self._slo.snapshot()
+
+    @property
+    def flight_recorder(self):
+        return self._flight
+
+    def prometheus_samples(self) -> list:
+        """The service's metric families for the text-exposition endpoint
+        (:mod:`..obs.exposition`). Reads the same locked snapshots the
+        JSON endpoints use; safe from the scrape thread."""
+        from ..obs.exposition import Sample
+
+        from .health import health_rank
+
+        replica = {"replica": self.name}
+        summary = self.latency_summary()
+        out = [
+            Sample("splink_serve_served_total", summary["served"], replica,
+                   "counter", "Requests delivered with matches"),
+            Sample("splink_serve_shed_total", summary["shed"], replica,
+                   "counter", "Requests shed (all machine-readable reasons)"),
+            Sample("splink_serve_batches_total", summary["batches"], replica,
+                   "counter", "Engine batches dispatched"),
+            Sample("splink_serve_timeouts_total", summary["timeouts"],
+                   replica, "counter", "query(timeout=) cancellations"),
+            Sample("splink_serve_worker_crashes_total",
+                   summary["worker_crashes"], replica, "counter",
+                   "Worker deaths recovered by the watchdog"),
+            Sample("splink_serve_brownout_episodes_total",
+                   summary["brownout_episodes"], replica, "counter",
+                   "Brown-out episodes entered"),
+            Sample("splink_serve_queries_per_sec",
+                   summary["queries_per_sec"], replica, "gauge",
+                   "Lifetime served throughput"),
+            Sample("splink_serve_queue_fill",
+                   (len(self._queue) / self.queue_depth)
+                   if self.queue_depth else 0.0,
+                   replica, "gauge", "Bounded-queue occupancy 0..1"),
+            Sample("splink_serve_health_rank",
+                   health_rank(self._health.state), replica, "gauge",
+                   "0 healthy / 1 degraded / 2 broken"),
+            Sample("splink_serve_breaker_open",
+                   1.0 if self.breaker.state == "open" else 0.0, replica,
+                   "gauge", "Circuit breaker open"),
+            Sample("splink_serve_index_generation",
+                   summary["index_generation"], replica, "gauge",
+                   "Committed hot-swaps"),
+        ]
+        for q in ("p50_ms", "p95_ms", "p99_ms"):
+            if q in summary:
+                out.append(Sample(
+                    "splink_serve_latency_ms", summary[q],
+                    {**replica, "quantile": q[:-3]}, "gauge",
+                    "Request latency quantiles (ms)",
+                ))
+        for phase, stats in self.phase_summary().items():
+            # "wall" is the pseudo-series totalling the real phases: keep
+            # it OUT of the phase label — phases already sum to wall, so a
+            # PromQL sum over the label would double-count
+            metric = (
+                "splink_serve_trace_wall_ms"
+                if phase == "wall"
+                else "splink_serve_phase_ms"
+            )
+            for q in ("p50_ms", "p99_ms"):
+                labels = {**replica, "quantile": q[:-3]}
+                if phase != "wall":
+                    labels["phase"] = phase
+                out.append(Sample(
+                    metric, stats[q], labels, "gauge",
+                    "Traced wall latency (ms)" if phase == "wall"
+                    else "Tail-latency attribution per phase (ms)",
+                ))
+        slo = self._slo.snapshot()
+        out.append(Sample(
+            "splink_serve_slo_objective", slo["objective"], replica,
+            "gauge", "Delivery objective",
+        ))
+        for window, stats in slo["windows"].items():
+            labels = {**replica, "window_s": window}
+            if stats["hit_rate"] is not None:
+                out.append(Sample(
+                    "splink_serve_slo_hit_rate", stats["hit_rate"], labels,
+                    "gauge", "Rolling delivered/total per window",
+                ))
+            out.append(Sample(
+                "splink_serve_slo_burn_rate", stats["burn_rate"], labels,
+                "gauge", "Error-budget burn rate per window",
+            ))
+        if self._tracer.enabled:
+            trace = self._tracer.snapshot()
+            out.append(Sample(
+                "splink_serve_traces_sampled_total", trace["sampled"],
+                replica, "counter", "Requests sampled for tracing",
+            ))
+            for outcome, n in trace["outcomes"].items():
+                out.append(Sample(
+                    "splink_serve_traces_closed_total", n,
+                    {**replica, "outcome": outcome}, "counter",
+                    "Closed span trees by outcome",
+                ))
         return out

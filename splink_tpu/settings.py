@@ -79,6 +79,9 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "serve_breaker_threshold",
     "serve_hedge_ms",
     "serve_probe_queries",
+    "serve_trace_sample_rate",
+    "obs_exposition_port",
+    "obs_flight_records",
 ]
 
 

@@ -301,3 +301,38 @@ def test_serve_resilience_key_types_validated():
             serve_probe_queries=0,
         )
     )
+
+
+def test_serve_observability_defaults_filled():
+    """The obs v2 keys complete from the schema: tracing OFF (sample rate
+    0), exposition endpoint OFF (port 0), flight recorder ON at 256
+    records."""
+    s = complete_settings_dict(_minimal())
+    assert s["serve_trace_sample_rate"] == 0
+    assert s["obs_exposition_port"] == 0
+    assert s["obs_flight_records"] == 256
+
+
+def test_serve_observability_key_types_validated():
+    """Type/bound violations on the obs v2 keys are rejected by the
+    schema validator, not silently served."""
+    for bad in (
+        {"serve_trace_sample_rate": "all"},
+        {"serve_trace_sample_rate": -0.1},
+        {"serve_trace_sample_rate": 1.5},
+        {"obs_exposition_port": -1},
+        {"obs_exposition_port": 99999},
+        {"obs_exposition_port": 1.5},
+        {"obs_flight_records": -1},
+        {"obs_flight_records": "many"},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    # valid values pass (the sample rate is a number: floats allowed)
+    validate_settings(
+        _minimal(
+            serve_trace_sample_rate=0.25,
+            obs_exposition_port=9464,
+            obs_flight_records=0,
+        )
+    )
