@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke trace-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke trace-smoke warmup-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -69,6 +69,15 @@ blocking-smoke:
 trace-smoke:
 	python scripts/trace_smoke.py
 
+# Cold-start smoke: process A builds an index + compiles the serve menu +
+# commits the AOT executable sidecar; a FRESH process B restores the whole
+# menu and the gate asserts zero backend compiles (jax.monitoring split
+# accounting), zero persistent-cache reads, first-query scores bit-identical
+# to process A, and the fused-kernel audits clean in the restored process
+# (docs/serving.md#cold-start).
+warmup-smoke:
+	python scripts/warmup_smoke.py
+
 bench:
 	python bench.py
 
@@ -76,4 +85,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke serve-smoke chaos-smoke trace-smoke bench
+all: lint test tpu-smoke blocking-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke bench

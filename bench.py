@@ -273,7 +273,7 @@ def bench_serve():
     import jax
 
     from splink_tpu import Splink
-    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.obs.metrics import compile_requests, install_compile_monitor
     from splink_tpu.serve import LinkageService, QueryEngine
 
     install_compile_monitor()
@@ -302,7 +302,7 @@ def bench_serve():
     t0 = time.perf_counter()
     warm = engine.warmup()
     warmup_s = time.perf_counter() - t0
-    c_warm, _ = compile_totals()
+    c_warm = compile_requests()
 
     records = df.sample(
         n=min(n_queries, len(df)), replace=n_queries > len(df),
@@ -328,7 +328,7 @@ def bench_serve():
         f.result()
     wall = time.perf_counter() - t0
     svc.close()
-    c_end, _ = compile_totals()
+    c_end = compile_requests()
     summary = svc.latency_summary()
 
     # phase 3 — tracing-overhead tiers (obs v2): the same open burst with
@@ -360,7 +360,7 @@ def bench_serve():
     for tsvc in tiers.values():
         tsvc.close()
     qps_off, qps_sampled, qps_full = best[0.0], best[0.1], best[1.0]
-    c_traced, _ = compile_totals()
+    c_traced = compile_requests()
     phase_fields = {}
     for phase, stats in phases.items():
         phase_fields[f"{phase}_p50_ms"] = round(stats["p50_ms"], 3)
@@ -400,6 +400,201 @@ def bench_serve():
     }))
 
 
+def _coldstart_child(phase: str, workdir: str) -> int:
+    """One cold-start child process (`bench.py coldstart-child <phase>
+    <workdir>`). ``build`` trains + exports the index, compiles the serve
+    menu (populating the persistent compile cache) and commits the AOT
+    sidecar. ``serve`` measures process-cold -> first-query-served wall
+    time; the SPLINK_TPU_COLD_AOT env var selects whether the sidecar is
+    offered (the compile-cache tier is selected by the inherited
+    JAX_COMPILATION_CACHE_DIR pointing at the warm vs a fresh dir)."""
+    t_start = time.perf_counter()
+    import jax
+
+    # cache EVERY program regardless of its compile time: the tier
+    # comparison needs the warm-cache leg fully warm, not "warm above the
+    # 1s threshold" (jax's default min-compile-time would drop the cheap
+    # shapes and blur the tiers)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    from splink_tpu.obs.metrics import compile_stats, install_compile_monitor
+    from splink_tpu.serve import QueryEngine, load_index
+
+    install_compile_monitor()
+    index_dir = os.path.join(workdir, "index")
+    n_rows = int(os.environ.get("SPLINK_TPU_BENCH_COLD_ROWS", 200_000))
+    rng = np.random.default_rng(0)
+    df = _make_df(rng, n_rows)
+    if phase == "build":
+        from splink_tpu import Splink
+
+        settings = dict(SETTINGS)
+        settings["max_iterations"] = 5
+        settings["serve_top_k"] = 5
+        linker = Splink(settings, df=df)
+        linker.estimate_parameters()
+        linker.export_index(index_dir)
+        engine = QueryEngine(
+            load_index(index_dir), aot_dir=os.path.join(index_dir, "aot")
+        )
+        warm = engine.warmup()
+        engine.save_aot()
+        print(json.dumps({"phase": "build", "warm": warm}), flush=True)
+        return 0
+    t_import = time.perf_counter()
+    aot_dir = (
+        os.path.join(index_dir, "aot")
+        if os.environ.get("SPLINK_TPU_COLD_AOT") == "1"
+        else None
+    )
+    engine = QueryEngine(load_index(index_dir), aot_dir=aot_dir)
+    t_load = time.perf_counter()
+    warm = engine.warmup()
+    t_warm = time.perf_counter()
+    engine.query_arrays(df.head(16))
+    t_query = time.perf_counter()
+    print(json.dumps({
+        "phase": "serve",
+        "import_seconds": round(t_import - t_start, 3),
+        "index_load_seconds": round(t_load - t_import, 3),
+        "warmup_seconds": round(t_warm - t_load, 3),
+        "first_query_seconds": round(t_query - t_warm, 3),
+        "cold_to_first_query_seconds": round(t_query - t_start, 3),
+        "warm": warm,
+        "compile_stats": compile_stats(),
+    }), flush=True)
+    return 0
+
+
+def bench_coldstart():
+    """Cold-start benchmark (`python bench.py coldstart`): process-cold ->
+    first-query-served wall time across the three warmup tiers —
+
+      no-cache    every menu program backend-compiles (the pre-ISSUE cost
+                  a restarted replica paid),
+      cache-warm  the persistent XLA compile cache serves every program
+                  (now on for the CPU tier too, keyed by target
+                  fingerprint),
+      aot         the serialized-executable sidecar restores the menu
+                  with the compiler never invoked (and a FRESH compile
+                  cache, proving independence);
+
+    each tier is a REAL fresh interpreter (subprocess), plus steady-state
+    fused-vs-unfused engine throughput and latency percentiles in the
+    driver process. One JSON line, honest tier labelling when the
+    accelerator tunnel is down."""
+    import subprocess
+    import tempfile
+
+    tier = _probe_device_init()
+    with tempfile.TemporaryDirectory(prefix="bench_cold_") as workdir:
+        warm_cache = os.path.join(workdir, "xla_warm")
+        fresh = lambda name: os.path.join(workdir, name)  # noqa: E731
+
+        def child(phase, cache_dir, aot):
+            env = dict(os.environ)
+            env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+            env["SPLINK_TPU_COLD_AOT"] = "1" if aot else "0"
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "coldstart-child", phase, workdir],
+                env=env, capture_output=True, text=True, check=True,
+            )
+            return json.loads(out.stdout.strip().splitlines()[-1])
+
+        child("build", warm_cache, aot=False)
+        tiers = {
+            "nocache": child("serve", fresh("xla_cold_a"), aot=False),
+            "cache_warm": child("serve", warm_cache, aot=False),
+            "aot": child("serve", fresh("xla_cold_b"), aot=True),
+        }
+        # contract checks — mislabelled tiers make the round worthless
+        assert tiers["nocache"]["warm"]["compiles"] > 0
+        assert tiers["cache_warm"]["warm"]["compiles"] == 0
+        assert tiers["cache_warm"]["warm"]["cache_hits"] > 0
+        assert tiers["aot"]["warm"]["compiles"] == 0
+        assert tiers["aot"]["warm"]["cache_hits"] == 0
+        assert (
+            tiers["aot"]["warm"]["aot_restored"]
+            == tiers["aot"]["warm"]["combinations"]
+        )
+
+        # steady-state fused vs unfused (driver process, warmed engines)
+        import jax
+
+        from splink_tpu.serve import QueryEngine, load_index
+
+        n_queries = int(
+            os.environ.get("SPLINK_TPU_BENCH_COLD_QUERIES", 1000)
+        )
+        rng = np.random.default_rng(0)
+        df = _make_df(
+            rng, int(os.environ.get("SPLINK_TPU_BENCH_COLD_ROWS", 200_000))
+        )
+        queries = df.sample(n=n_queries, random_state=1)
+        index_dir = os.path.join(workdir, "index")
+        engines = {
+            label: QueryEngine(load_index(index_dir), fused=fused)
+            for label, fused in (("fused", True), ("unfused", False))
+        }
+        for eng in engines.values():
+            eng.warmup()
+        # INTERLEAVED best-of-N, the round-9 lesson: a single burst on a
+        # shared 2-core container drifts run to run by far more than the
+        # fused-vs-unfused delta, so both tiers must see the same drift
+        repeats = int(os.environ.get("SPLINK_TPU_BENCH_COLD_REPEATS", 3))
+        best = {label: 0.0 for label in engines}
+        lat = {label: [] for label in engines}
+        for _ in range(repeats):
+            for label, eng in engines.items():
+                for s in range(0, 60):
+                    q = queries.iloc[s : s + 1]
+                    t0 = time.perf_counter()
+                    eng.query_arrays(q)
+                    lat[label].append((time.perf_counter() - t0) * 1000.0)
+                t0 = time.perf_counter()
+                eng.query_arrays(queries)
+                best[label] = max(
+                    best[label], n_queries / (time.perf_counter() - t0)
+                )
+        steady = {}
+        for label in engines:
+            p50, p99 = np.percentile(np.asarray(lat[label]), [50, 99])
+            steady[label] = {
+                "qps": round(best[label], 1),
+                "p50_ms": round(float(p50), 3),
+                "p99_ms": round(float(p99), 3),
+            }
+
+    print(json.dumps({
+        "metric": "serve_cold_start_seconds",
+        "value": tiers["aot"]["cold_to_first_query_seconds"],
+        "unit": "seconds",
+        "cold_nocache_seconds": tiers["nocache"]["cold_to_first_query_seconds"],
+        "cold_cache_warm_seconds": tiers["cache_warm"]["cold_to_first_query_seconds"],
+        "cold_aot_seconds": tiers["aot"]["cold_to_first_query_seconds"],
+        "warmup_nocache_seconds": tiers["nocache"]["warmup_seconds"],
+        "warmup_cache_warm_seconds": tiers["cache_warm"]["warmup_seconds"],
+        "warmup_aot_seconds": tiers["aot"]["warmup_seconds"],
+        "speedup_vs_nocache": round(
+            tiers["nocache"]["cold_to_first_query_seconds"]
+            / tiers["aot"]["cold_to_first_query_seconds"], 2,
+        ),
+        "menu_combinations": tiers["aot"]["warm"]["combinations"],
+        "aot_restored": tiers["aot"]["warm"]["aot_restored"],
+        "cache_hits_warm_tier": tiers["cache_warm"]["warm"]["cache_hits"],
+        "fused_qps": steady["fused"]["qps"],
+        "fused_p50_ms": steady["fused"]["p50_ms"],
+        "fused_p99_ms": steady["fused"]["p99_ms"],
+        "unfused_qps": steady["unfused"]["qps"],
+        "unfused_p50_ms": steady["unfused"]["p50_ms"],
+        "unfused_p99_ms": steady["unfused"]["p99_ms"],
+        "tiers_detail": tiers,
+        "device": str(jax.devices()[0]),
+        **tier,
+    }))
+
+
 def bench_blocking():
     """Blocking-tier benchmark (`python bench.py blocking`): host join vs
     the device-native candidate-generation tier over the same rules and
@@ -419,7 +614,7 @@ def bench_blocking():
         iter_device_pairs,
     )
     from splink_tpu.data import encode_table
-    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.obs.metrics import compile_requests, install_compile_monitor
     from splink_tpu.settings import complete_settings_dict
 
     install_compile_monitor()
@@ -475,11 +670,11 @@ def bench_blocking():
         return total
 
     drive(chunk)  # warmup: compiles every per-rule chunked kernel
-    c0, _ = compile_totals()
+    c0 = compile_requests()
     t0 = time.perf_counter()
     emitted = drive(chunk)
     chunked_s = time.perf_counter() - t0
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     resident_budget = max(rp.total for rp in plan.rules)
     drive(resident_budget)  # warmup the resident-shape kernels
     t0 = time.perf_counter()
@@ -748,7 +943,12 @@ def main():
 
 
 if __name__ == "__main__":
-    if "serve" in sys.argv[1:]:
+    if "coldstart-child" in sys.argv[1:]:
+        i = sys.argv.index("coldstart-child")
+        sys.exit(_coldstart_child(sys.argv[i + 1], sys.argv[i + 2]))
+    elif "coldstart" in sys.argv[1:]:
+        bench_coldstart()
+    elif "serve" in sys.argv[1:]:
         bench_serve()
     elif "blocking" in sys.argv[1:]:
         bench_blocking()

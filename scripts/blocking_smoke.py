@@ -45,7 +45,7 @@ def main() -> int:
     from splink_tpu.blocking import block_using_rules
     from splink_tpu.blocking_device import build_device_plan, iter_device_pairs
     from splink_tpu.data import encode_table
-    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.obs.metrics import compile_requests, install_compile_monitor
     from splink_tpu.settings import complete_settings_dict
 
     install_compile_monitor()
@@ -92,11 +92,11 @@ def main() -> int:
     assert plan is not None
     n_chunks = sum(1 for _ in iter_device_pairs(plan, 1 << 14))  # warm
     assert n_chunks > 1, "fixture too small to exercise chunked emission"
-    c0, _ = compile_totals()
+    c0 = compile_requests()
     emitted = sum(
         len(i) for _r, i, _j in iter_device_pairs(plan, 1 << 14)
     )
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     assert c1 - c0 == 0, (
         f"steady-state emission performed {c1 - c0} recompiles"
     )

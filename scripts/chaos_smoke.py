@@ -131,7 +131,7 @@ def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
 
     from splink_tpu import Splink
     from splink_tpu.obs.events import EventSink, read_events, register_ambient
-    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.obs.metrics import compile_requests, install_compile_monitor
     from splink_tpu.serve import (
         IndexSwapError,
         QueryEngine,
@@ -236,10 +236,10 @@ def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
     _set_plan("")
     svc = _fresh_service(engine, autostart=False, queue_depth=64)
     futures = [svc.submit(dict(r)) for r in records[:60]]  # 94% full
-    c0, _ = compile_totals()
+    c0 = compile_requests()
     svc.start()
     results = [f.result(timeout=WAVE_TIMEOUT_S) for f in futures]
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     degraded = [r for r in results if r.degraded]
     assert degraded, "E: pressure must engage the brown-out tier"
     assert all(
@@ -265,9 +265,9 @@ def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
         "F: zero dropped in-flight requests across the swap"
     )
     assert stats["generation"] == 1 and stats["probes_checked"] == 8, stats
-    c0, _ = compile_totals()
+    c0 = compile_requests()
     post = _assert_serves(svc, records[:40], "F post-swap")
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     assert c1 - c0 == 0, f"F: {c1 - c0} recompiles after the hot-swap"
     checked = 0
     for rec, r in zip(records[:40], post):

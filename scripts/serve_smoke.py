@@ -23,7 +23,7 @@ def main() -> int:
     import pandas as pd
 
     from splink_tpu import Splink
-    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.obs.metrics import compile_requests, install_compile_monitor
     from splink_tpu.serve import LinkageService, QueryEngine, load_index
 
     install_compile_monitor()
@@ -73,8 +73,12 @@ def main() -> int:
 
     engine = QueryEngine(index)
     warm = engine.warmup()
-    assert warm["compiles"] == warm["combinations"] == 4, warm
-    c0, _ = compile_totals()
+    # one backend_compile request per combination: a real compile on a cold
+    # cache, a persistent-cache restore on a warm one (the linker enables
+    # the fingerprint-keyed cache on the CPU tier too)
+    assert warm["combinations"] == 4, warm
+    assert warm["compiles"] + warm["cache_hits"] == 4, warm
+    c0 = compile_requests()
 
     records = df.head(100).to_dict(orient="records")
     checked = 0
@@ -95,7 +99,7 @@ def main() -> int:
                 )
                 checked += 1
         summary = svc.latency_summary()
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     assert checked > 200, f"only {checked} pairs cross-checked"
     assert c1 - c0 == 0, (
         f"steady-state serving performed {c1 - c0} recompiles"

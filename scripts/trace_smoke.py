@@ -121,7 +121,7 @@ def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
         read_events,
         register_ambient,
     )
-    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.obs.metrics import compile_requests, install_compile_monitor
     from splink_tpu.obs.reqtrace import PHASES
     from splink_tpu.serve import LinkageService, QueryEngine, build_index
 
@@ -151,9 +151,9 @@ def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
         breaker_cooldown_s=0.3,
     )
     svc._flight.dump_dir = os.path.join(tmp, "flight")
-    c0, _ = compile_totals()
+    c0 = compile_requests()
     results = _drive(svc, records)
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     assert not any(r.shed for r in results), "steady state must not shed"
     assert c1 - c0 == 0, (
         f"tracing added {c1 - c0} steady-state recompile(s)"

@@ -1,0 +1,192 @@
+"""Cold-start smoke (`make warmup-smoke`): AOT sidecar restore across a
+REAL process boundary.
+
+Process A trains a linker on the fixture corpus, exports the LinkageIndex,
+compiles the full (query-bucket x candidate-bucket) serve menu (brown-out
+shapes included), commits the AOT executable sidecar and records its
+answers for the query frame. Process B — a FRESH interpreter, no shared
+jit caches, no persistent compilation cache — then restores the menu and
+the smoke asserts the three cold-start contracts end to end:
+
+  1. ZERO backend compiles in process B for the full menu (jax.monitoring
+     split accounting: every combination restores from the sidecar, none
+     compiles, none even reads the persistent cache);
+  2. process B's first-query scores are BIT-identical to process A's;
+  3. the fused-path audits stay clean in the restored process
+     (serve_score_fused under the x64 jaxpr tier, serve_score_fused_sharded
+     under the 8-virtual-device shard tier).
+
+Exits nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    # the shard-audit leg of phase B needs the 8-virtual-device mesh
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUERY_HEAD = 80
+
+
+def fixture_corpus():
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(7)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    n = 200
+    df = pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+    settings = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.surname = r.surname"],
+        "max_iterations": 5,
+        "serve_top_k": 16,
+        "serve_query_buckets": [16, 128],
+        "serve_candidate_buckets": [64, 256],
+        "serve_brownout_top_k": 4,
+    }
+    return df, settings
+
+
+def phase_build(workdir: str) -> int:
+    import numpy as np
+
+    from splink_tpu import Splink
+    from splink_tpu.serve import QueryEngine, load_index
+
+    df, settings = fixture_corpus()
+    linker = Splink(settings, df=df)
+    linker.get_scored_comparisons()
+    index_dir = os.path.join(workdir, "index")
+    linker.export_index(index_dir)
+    aot_dir = os.path.join(index_dir, "aot")
+    engine = QueryEngine(load_index(index_dir), aot_dir=aot_dir)
+    warm = engine.warmup()
+    engine.save_aot()
+    top_p, top_rows, top_valid, n_cand = engine.query_arrays(
+        df.head(QUERY_HEAD)
+    )
+    np.savez(
+        os.path.join(workdir, "answers.npz"),
+        top_p=top_p, top_rows=top_rows, top_valid=top_valid, n_cand=n_cand,
+    )
+    with open(os.path.join(workdir, "build.json"), "w") as fh:
+        json.dump({"warm": warm, "fused": engine.fused}, fh)
+    print(
+        f"warmup-smoke[A]: menu built ({warm['combinations']} combinations, "
+        f"{warm['compiles']} compiles + {warm['cache_hits']} cache hits), "
+        f"sidecar committed, {QUERY_HEAD} answers recorded"
+    )
+    return 0
+
+
+def phase_serve(workdir: str) -> int:
+    t_start = time.perf_counter()
+    import numpy as np
+
+    from splink_tpu.obs.metrics import compile_stats, install_compile_monitor
+    from splink_tpu.serve import QueryEngine, load_index
+
+    install_compile_monitor()
+    df, _settings = fixture_corpus()
+    index_dir = os.path.join(workdir, "index")
+    engine = QueryEngine(
+        load_index(index_dir), aot_dir=os.path.join(index_dir, "aot")
+    )
+    assert engine.fused, "the fused megakernel must be the default path"
+    t0 = time.perf_counter()
+    warm = engine.warmup()
+    t_ready = time.perf_counter()
+    assert warm["compiles"] == 0, (
+        f"AOT restore performed {warm['compiles']} backend compiles "
+        f"(expected 0): {warm}"
+    )
+    assert warm["cache_hits"] == 0, (
+        f"AOT restore read the persistent compile cache {warm['cache_hits']} "
+        f"times (expected pure sidecar restore): {warm}"
+    )
+    assert warm["aot_restored"] == warm["combinations"] > 0, warm
+    got = engine.query_arrays(df.head(QUERY_HEAD))
+    t_first = time.perf_counter()
+    stats = compile_stats()
+    assert stats["compiles"] == 0 and stats["requests"] == 0, stats
+    ref = np.load(os.path.join(workdir, "answers.npz"))
+    for name, g in zip(("top_p", "top_rows", "top_valid", "n_cand"), got):
+        e = ref[name]
+        assert e.dtype == g.dtype and e.shape == g.shape, name
+        assert np.array_equal(e, g), (
+            f"restored engine's {name} differs from process A's answers "
+            "(bit-identity required)"
+        )
+    # fused-path audits must hold in the RESTORED process too
+    from splink_tpu.analysis.shard_audit import run_shard_audit
+    from splink_tpu.analysis.trace_audit import run_audit
+
+    findings, _ = run_audit(["serve_score_fused"])
+    assert not findings, [str(f) for f in findings]
+    sfindings, _ = run_shard_audit(["serve_score_fused_sharded"])
+    assert not sfindings, [str(f) for f in sfindings]
+    print(
+        "warmup-smoke[B] OK: "
+        f"{warm['aot_restored']}/{warm['combinations']} executables "
+        "AOT-restored, 0 backend compiles, 0 cache reads, "
+        f"{QUERY_HEAD} first-query scores bit-identical to process A, "
+        "fused audits clean "
+        f"(menu ready {t_ready - t0:.2f}s after warmup start, "
+        f"{t_ready - t_start:.2f}s after import; first query at "
+        f"{t_first - t_start:.2f}s)"
+    )
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
+        phase, workdir = sys.argv[2], sys.argv[3]
+        return phase_build(workdir) if phase == "build" else phase_serve(workdir)
+    with tempfile.TemporaryDirectory(prefix="warmup_smoke_") as workdir:
+        env = dict(os.environ)
+        # hermetic: neither phase may touch the user's persistent compile
+        # cache (phase B asserts cache_hits == 0 — only the sidecar serves)
+        env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(workdir, "xla_cache")
+        for phase in ("build", "serve"):
+            rc = subprocess.call(
+                [sys.executable, os.path.abspath(__file__),
+                 "--phase", phase, workdir],
+                env=env, cwd=REPO,
+            )
+            if rc != 0:
+                print(f"warmup-smoke FAILED in phase {phase} (rc={rc})")
+                return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

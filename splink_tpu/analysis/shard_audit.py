@@ -847,6 +847,37 @@ def _ensure_default_registry() -> None:
         params = jax.device_put(params_small, rep)
         return fn, (packed_q, packed_ref, cand, valid, params), {}
 
+    # The fused megakernel twin of serve_score_topk_sharded: identical
+    # sharding story (query axis sharded, reference/params replicated,
+    # static query-side broadcast, top-k along the replicated candidate
+    # axis), ZERO collectives — and a committed SA-COST baseline BELOW the
+    # unfused kernel's (no stacked gamma matrix, no full-matrix m/u
+    # probability lookups), which is the measured per-device-bytes proof
+    # of the fusion.
+    @register_shard_kernel("serve_score_fused_sharded", n_pairs=64)
+    def _build_serve_score_fused_sharded():
+        import jax
+        import numpy as np
+
+        from ..parallel.mesh import pair_sharding, replicated
+        from ..serve.engine import make_score_fused_fn
+
+        mesh = audit_mesh()
+        program = shared_gamma_program()
+        _, params_small = shared_fs_inputs()
+        fn = make_score_fused_fn(
+            program._layout, program.settings["comparison_columns"], k=4
+        )
+        shard, rep = pair_sharding(mesh), replicated(mesh)
+        packed_q = jax.device_put(
+            np.zeros((64, program._packed.shape[1]), np.uint32), shard
+        )
+        packed_ref = jax.device_put(program._packed, rep)
+        cand = jax.device_put(np.zeros((64, 8), np.int32), shard)
+        valid = jax.device_put(np.zeros((64, 8), bool), shard)
+        params = jax.device_put(params_small, rep)
+        return fn, (packed_q, packed_ref, cand, valid, params), {}
+
     # Device-blocking emission decode+mask body sharded over the pair-
     # POSITION axis (the blocking analogue of the pair axis): the unit
     # tables, ranks, codes and meta replicate, each shard decodes and

@@ -645,6 +645,29 @@ def _ensure_default_registry() -> None:
         # no-embedded-constant design TA-CONST pins for gamma_batch
         return fn, (packed_q, program._packed, cand, valid, params), {}
 
+    # the fused gamma→score→top-k megakernel (engine default): same
+    # contract as serve_score_topk — per-comparison gammas fold into the
+    # running log-Bayes-factor instead of stacking the full gamma matrix,
+    # bit-identical outputs (parity-gated) with fewer HBM round-trips
+    # (SA-COST pins the bytes reduction in the shard tier)
+    @register_kernel("serve_score_fused")
+    def _build_serve_score_fused():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..serve.engine import make_score_fused_fn
+
+        program = _gamma_program()
+        _, params = _fs_inputs()
+        fn = make_score_fused_fn(
+            program._layout, program.settings["comparison_columns"], k=4
+        )
+        packed_q = jnp.asarray(np.zeros((16, program._packed.shape[1]),
+                                        np.uint32))
+        cand = jnp.asarray(np.zeros((16, 8), np.int32))
+        valid = jnp.asarray(np.zeros((16, 8), bool))
+        return fn, (packed_q, program._packed, cand, valid, params), {}
+
     # ----- device-native blocking (splink_tpu/blocking_device.py) -----
     # These kernels sit on the TRAINING-time hot path (candidate
     # generation for every materialised-pair run), so they are gated like
@@ -716,7 +739,7 @@ def _ensure_default_registry() -> None:
             {},
         )
 
-    # the brown-out tier's budgeted twin (engine._brownout_kernel): same
+    # the brown-out tier's budgeted twin (engine kind="brownout"): same
     # factory, reduced top-k over a small candidate capacity — the shape
     # the service dispatches under pressure, so it is gated like the
     # full-service program (it runs per degraded request). Not registered

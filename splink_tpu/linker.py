@@ -65,7 +65,17 @@ def _enable_compilation_cache(path, explicit: bool = False) -> None:
     process applies its setting and later linkers never re-apply — jax
     binds its cache object to the first directory it initialises with,
     so a mid-process dir change would make jax.config report one path
-    while entries keep landing in another. Empty/None disables."""
+    while entries keep landing in another. Empty/None disables.
+
+    On the CPU backend the cache directory is keyed by the host's
+    target-feature fingerprint (``cpu-<fp16>/`` subdirectory,
+    utils/envfp.py): XLA:CPU entries embed exact machine features and
+    reloading one compiled under different target flags "could lead to
+    SIGILL" (jax's own warning) — the fingerprint key means entries never
+    cross CPU types, which is what makes the cache safe to leave ON for
+    the CPU tier (it used to be accelerator-only by default; the serve
+    warmup and cold-EM compiles the BENCHMARKS.md cold-start rounds
+    measure are exactly what it now absorbs)."""
     global _compilation_cache_applied
     if not path:
         return
@@ -75,20 +85,19 @@ def _enable_compilation_cache(path, explicit: bool = False) -> None:
             "compilation cache in place"
         )
         return
-    if explicit is False:
-        # default-on applies to accelerator backends only: XLA:CPU AOT
-        # entries embed exact machine features and reloading one compiled
-        # under different target flags warns "could lead to SIGILL" —
-        # and CPU compiles are fast enough not to need the cache. An
-        # explicitly-set dir is honoured on any backend.
-        try:
-            import jax
-
-            if jax.default_backend() == "cpu":
-                return
-        except Exception:  # noqa: BLE001 - backend probe must not fail init
-            return
     path = os.path.expanduser(path)
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            from .utils.envfp import cpu_target_fingerprint
+
+            path = os.path.join(
+                path, f"cpu-{cpu_target_fingerprint()[:16]}"
+            )
+    except Exception:  # noqa: BLE001 - backend probe must not fail init
+        if not explicit:
+            return
     if _compilation_cache_applied is not None:
         if _compilation_cache_applied != path:
             logger.debug(
@@ -168,12 +177,12 @@ class Splink:
                 (/root/reference/splink/iterate.py:54-55).
             spark: ignored (the reference's SparkSession slot).
         """
-        # An explicit compilation_cache_dir opts in on any backend, incl.
-        # CPU. Completion never auto-fills this key (settings.py), so on
-        # current models presence == user intent — but models SAVED by
-        # earlier builds had the default auto-filled into their settings,
-        # so a value equal to the schema default is treated as implicit
-        # (users opting into CPU caching pick their own path).
+        # The persistent compilation cache is on for EVERY backend (the
+        # CPU tier keys entries by target-feature fingerprint, see
+        # _enable_compilation_cache). Completion never auto-fills this key
+        # (settings.py): the default resolves lazily so a reused settings
+        # dict never looks explicitly configured; explicit (non-default)
+        # values are tracked only to survive a failed backend probe.
         from .validate import get_default_value
 
         _cache_default = get_default_value(

@@ -75,12 +75,24 @@ class MetricsRegistry:
 # ---------------------------------------------------------------------------
 
 _COMPILE_LOCK = threading.Lock()
-_COMPILE = {"count": 0, "seconds": 0.0}
+# ``requests`` counts every trip through XLA's backend_compile entry point;
+# ``cache_hits`` counts the subset answered by the persistent compilation
+# cache (jax fires backend_compile_duration on a HIT too — the duration is
+# the cache deserialize, milliseconds, not a compile); ``aot_restores``
+# counts executables restored from a serialized AOT sidecar, which never
+# enter backend_compile at all (the engine reports them explicitly via
+# :func:`note_aot_restore`). Real compiles = requests - cache_hits.
+_COMPILE = {
+    "requests": 0,
+    "seconds": 0.0,
+    "cache_hits": 0,
+    "aot_restores": 0,
+}
 _MONITOR_INSTALLED = False
 
 
 def install_compile_monitor() -> None:
-    """Install the process-global jax compile listener (idempotent)."""
+    """Install the process-global jax compile listeners (idempotent)."""
     global _MONITOR_INSTALLED
     if _MONITOR_INSTALLED:
         return
@@ -92,17 +104,63 @@ def install_compile_monitor() -> None:
         with _COMPILE_LOCK:
             _COMPILE["seconds"] += secs
             if name.endswith("backend_compile_duration"):
-                _COMPILE["count"] += 1
+                _COMPILE["requests"] += 1
+
+    def _on_event(name: str, **_kw) -> None:
+        if name == "/jax/compilation_cache/cache_hits":
+            with _COMPILE_LOCK:
+                _COMPILE["cache_hits"] += 1
 
     jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    jax.monitoring.register_event_listener(_on_event)
     _MONITOR_INSTALLED = True
 
 
-def compile_totals() -> tuple[int, float]:
-    """(backend compiles, total compile seconds) accumulated so far in this
-    process. (0, 0.0) until the monitor is installed."""
+def note_aot_restore(n: int = 1) -> None:
+    """Record ``n`` executables restored from an AOT sidecar (deserialized,
+    never compiled — jax emits no monitoring event for these, so the serve
+    engine reports them here)."""
     with _COMPILE_LOCK:
-        return _COMPILE["count"], _COMPILE["seconds"]
+        _COMPILE["aot_restores"] += int(n)
+
+
+def compile_totals() -> tuple[int, float]:
+    """(REAL backend compiles, total backend-compile seconds) accumulated
+    so far in this process. A persistent-cache hit is NOT a compile: jax
+    fires the same backend_compile_duration event for a hit (the cache
+    read), which used to inflate this count and trip the zero-recompile
+    gates and the compile-stall health signal on a cache-restored replica —
+    hits are subtracted here and reported separately by
+    :func:`compile_stats`. (0, 0.0) until the monitor is installed."""
+    with _COMPILE_LOCK:
+        return _COMPILE["requests"] - _COMPILE["cache_hits"], _COMPILE["seconds"]
+
+
+def compile_requests() -> int:
+    """Raw backend_compile entry count (real compiles + persistent-cache
+    hits). THE counter for steady-state zero-recompile gates: a hot path
+    that re-lowers a warmed shape stalls on trace+lower+cache-read even
+    when the persistent cache serves the executable, and a gate on
+    :func:`compile_totals` (real compiles only) would miss exactly that
+    regression."""
+    with _COMPILE_LOCK:
+        return _COMPILE["requests"]
+
+
+def compile_stats() -> dict:
+    """The full accounting split: ``requests`` (backend_compile entries),
+    ``compiles`` (real backend compiles = requests - cache_hits),
+    ``cache_hits`` (persistent-cache restores), ``aot_restores``
+    (sidecar-deserialized executables; never touch backend_compile) and
+    ``seconds`` (total time inside backend_compile, hits included)."""
+    with _COMPILE_LOCK:
+        return {
+            "requests": _COMPILE["requests"],
+            "compiles": _COMPILE["requests"] - _COMPILE["cache_hits"],
+            "cache_hits": _COMPILE["cache_hits"],
+            "aot_restores": _COMPILE["aot_restores"],
+            "seconds": _COMPILE["seconds"],
+        }
 
 
 def device_memory_snapshot() -> list[dict]:

@@ -10,8 +10,10 @@ this package answers "which reference records match THIS record, now":
 
     # in the serving process
     from splink_tpu.serve import load_index, QueryEngine, LinkageService
-    engine = QueryEngine(load_index("index_dir"))
-    engine.warmup()                                   # compile every bucket
+    engine = QueryEngine(load_index("index_dir"),
+                         aot_dir="index_dir/aot")     # AOT sidecar (if built)
+    engine.warmup()     # restore the whole bucket menu without the backend
+    engine.save_aot()   # compiler (zero compiles), or compile + persist it
     with LinkageService(engine) as svc:
         result = svc.query({"first_name": "amelia", "surname": "smith",
                             "dob": "1987"})
@@ -30,6 +32,7 @@ tuning knobs, and ``python -m splink_tpu.serve`` for the CLI.
 """
 
 from .admission import CircuitBreaker, WaitEstimator
+from .aot import AotStore, AotStoreError
 from .bucketing import BucketPolicy, bucket_for
 from .engine import IndexSwapError, QueryEngine
 from .health import BROKEN, DEGRADED, HEALTHY, HealthMonitor
@@ -46,6 +49,8 @@ from .router import ReplicaRouter
 from .service import LinkageService, QueryResult
 
 __all__ = [
+    "AotStore",
+    "AotStoreError",
     "BucketPolicy",
     "bucket_for",
     "QueryEngine",

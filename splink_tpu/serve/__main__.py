@@ -29,12 +29,32 @@ def _read_frame(path: str):
     return pd.read_csv(path)
 
 
+def _aot_dir(index_dir: str) -> str | None:
+    """The index's AOT sidecar directory when one is present (restored
+    automatically — a stale sidecar degrades to fresh compiles)."""
+    import os
+
+    d = os.path.join(index_dir, "aot")
+    return d if os.path.isdir(d) else None
+
+
 def _cmd_build(args) -> int:
     from ..linker import load_from_json
 
     df = _read_frame(args.data)
     linker = load_from_json(args.model, df=df)
     index = linker.export_index(args.out)
+    aot = None
+    if args.aot:
+        import os
+
+        from . import QueryEngine
+
+        engine = QueryEngine(index, aot_dir=os.path.join(args.out, "aot"))
+        warm = engine.warmup()
+        engine.save_aot()
+        aot = {"executables": len(engine.warmed_shapes)
+               + len(engine.warmed_brownout_shapes), **warm}
     print(
         json.dumps(
             {
@@ -43,6 +63,7 @@ def _cmd_build(args) -> int:
                 "n_rules": len(index.rules),
                 "n_lanes": index.n_lanes,
                 "dtype": index.dtype,
+                **({"aot": aot} if aot else {}),
             }
         )
     )
@@ -52,7 +73,10 @@ def _cmd_build(args) -> int:
 def _cmd_query(args) -> int:
     from . import QueryEngine, load_index
 
-    engine = QueryEngine(load_index(args.index), top_k=args.k or None)
+    engine = QueryEngine(
+        load_index(args.index), top_k=args.k or None,
+        aot_dir=_aot_dir(args.index),
+    )
     engine.warmup()
     df = _read_frame(args.data)
     out = engine.query(df)
@@ -64,14 +88,16 @@ def _cmd_query(args) -> int:
 def _cmd_bench(args) -> int:
     import numpy as np
 
-    from ..obs.metrics import compile_totals, install_compile_monitor
+    from ..obs.metrics import compile_requests, install_compile_monitor
     from . import LinkageService, QueryEngine, load_index
 
     install_compile_monitor()
     index = load_index(args.index)
-    engine = QueryEngine(index, top_k=args.k or None)
+    engine = QueryEngine(
+        index, top_k=args.k or None, aot_dir=_aot_dir(args.index)
+    )
     warm = engine.warmup()
-    c_warm, _ = compile_totals()
+    c_warm = compile_requests()
     svc = LinkageService(engine, deadline_ms=args.deadline_ms)
     rng = np.random.default_rng(0)
     uid_col = index.settings["unique_id_column_name"]
@@ -96,7 +122,7 @@ def _cmd_bench(args) -> int:
         f.result()
     wall = time.perf_counter() - t0
     svc.close()
-    c_end, _ = compile_totals()
+    c_end = compile_requests()
     summary = svc.latency_summary()
     print(
         json.dumps(
@@ -108,6 +134,8 @@ def _cmd_bench(args) -> int:
                 "uid_column": uid_col,
                 "warmup_combinations": warm["combinations"],
                 "warmup_compiles": warm["compiles"],
+                "warmup_cache_hits": warm["cache_hits"],
+                "warmup_aot_restored": warm["aot_restored"],
                 "steady_state_compiles": c_end - c_warm,
                 **{k: round(v, 3) if isinstance(v, float) else v
                    for k, v in summary.items()},
@@ -128,6 +156,12 @@ def main(argv=None) -> int:
     b.add_argument("--model", required=True, help="save_model_as_json output")
     b.add_argument("--data", required=True, help="reference csv/parquet")
     b.add_argument("--out", required=True, help="index output directory")
+    b.add_argument(
+        "--aot", action="store_true",
+        help="also compile the serve bucket menu and commit the AOT "
+        "executable sidecar (<out>/aot) so replicas warm up without the "
+        "backend compiler (docs/serving.md#cold-start)",
+    )
     b.set_defaults(fn=_cmd_build)
 
     q = sub.add_parser("query", help="score query records against an index")

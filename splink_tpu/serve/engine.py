@@ -38,7 +38,9 @@ zero recompiles (proven by the ``jax.monitoring`` compile counter in
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import os
 import threading
 import time
 
@@ -147,12 +149,37 @@ def _top_k_rowwise(scores, k: int):
     return jnp.stack(vals, axis=1), jnp.stack(idxs, axis=1)
 
 
+def _finish_topk(p, cand, valid, k: int):
+    """Shared tail of both scoring paths: mask invalid slots to an
+    impossible -1, run the partition-safe row-wise top-k, and map the
+    winning slots back to reference rows. Invalid slots can never displace
+    a real candidate; ``top_valid`` reports which of the k slots are
+    real."""
+    import jax.numpy as jnp
+
+    q_n, capacity = cand.shape
+    scores = jnp.where(
+        valid.reshape(-1), p, jnp.asarray(-1.0, p.dtype)
+    ).reshape(q_n, capacity)
+    top_p, top_i = _top_k_rowwise(scores, k)
+    top_rows = jnp.take_along_axis(cand, top_i, axis=1)
+    top_valid = jnp.take_along_axis(valid, top_i, axis=1)
+    # a row with fewer than k valid candidates re-picks slot 0 with the
+    # -2 mask sentinel once real entries are exhausted; the score guard
+    # keeps such duplicates from reading slot 0's valid flag (real
+    # probabilities are >= 0, invalid slots -1, re-picks -2)
+    top_valid = top_valid & (top_p > -0.5)
+    return top_p, top_rows, top_valid
+
+
 def make_score_topk_fn(layout: dict, comparison_columns, k: int):
     """(packed_q, packed_ref, cand, valid, params) -> (top_p, top_rows,
     top_valid): gammas via the shared comparison dispatch (exact bodies),
-    Fellegi-Sunter match probabilities, masked top-k per query. Invalid
-    slots score an impossible -1 so they can never displace a real
-    candidate; ``top_valid`` reports which of the k slots are real."""
+    Fellegi-Sunter match probabilities, masked top-k per query. The
+    UNFUSED scoring path — it materialises the full (Q*C, n_comparisons)
+    gamma matrix and hands it to ``match_probability`` wholesale. Retained
+    as the parity oracle for :func:`make_score_fused_fn`, which is the
+    default serving path."""
     import jax.numpy as jnp
 
     from ..gammas import PairContext, _spec_gamma
@@ -173,20 +200,103 @@ def make_score_topk_fn(layout: dict, comparison_columns, k: int):
         ctx = PairContext(layout, rows_l, rows_r, None)
         G = jnp.stack([_spec_gamma(c, ctx) for c in cols], axis=1)
         p = match_probability(G, params)
-        scores = jnp.where(
-            valid.reshape(-1), p, jnp.asarray(-1.0, p.dtype)
-        ).reshape(q_n, capacity)
-        top_p, top_i = _top_k_rowwise(scores, k)
-        top_rows = jnp.take_along_axis(cand, top_i, axis=1)
-        top_valid = jnp.take_along_axis(valid, top_i, axis=1)
-        # a row with fewer than k valid candidates re-picks slot 0 with the
-        # -2 mask sentinel once real entries are exhausted; the score guard
-        # keeps such duplicates from reading slot 0's valid flag (real
-        # probabilities are >= 0, invalid slots -1, re-picks -2)
-        top_valid = top_valid & (top_p > -0.5)
-        return top_p, top_rows, top_valid
+        return _finish_topk(p, cand, valid, k)
 
     return score_topk
+
+
+def make_score_fused_fn(layout: dict, comparison_columns, k: int):
+    """The fused gamma→score→top-k megakernel: same signature and
+    BIT-identical results as :func:`make_score_topk_fn`, without ever
+    materialising the (Q*C, n_comparisons) gamma matrix.
+
+    The unfused path stacks every comparison's gamma levels into G, then
+    ``match_probability`` walks that matrix twice more (``_select_levels``
+    over the m and u tables) — three full (Q*C, C)-shaped intermediates
+    round-tripping through HBM per batch. Here each comparison's gamma
+    levels fold into a running per-pair log-Bayes-factor the moment they
+    are computed: one (Q*C,) accumulator crosses the comparisons, and the
+    per-comparison gamma vector dies inside the fusion. Every arithmetic
+    step mirrors the unfused expression tree exactly — the same
+    ``_safe_log`` probability tables, the same per-level compare-and-mask
+    lookup in the same level order, the same null (gamma = -1) masking,
+    the same left-to-right comparison accumulation order ``jnp.sum``
+    applies along the stacked axis — which is what makes the fused path
+    bit-identical, not merely close (gated by the parity tests and the
+    ``make warmup-smoke`` oracle comparison)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..gammas import PairContext, _spec_gamma
+    from ..models.fellegi_sunter import _safe_log
+
+    cols = tuple(comparison_columns)
+
+    def score_fused(packed_q, packed_ref, cand, valid, params):
+        # identical row materialisation to the unfused path (static
+        # broadcast on the query side, one reference gather) — the fusion
+        # target is the scoring chain, not the row reads
+        capacity = cand.shape[1]
+        rows_l = jnp.repeat(packed_q, capacity, axis=0)
+        rflat = cand.reshape(-1)
+        rows_r = packed_ref[rflat]
+        ctx = PairContext(layout, rows_l, rows_r, None)
+        log_m = _safe_log(params.m)  # (C, L)
+        log_u = _safe_log(params.u)
+        n_levels = log_m.shape[1]
+        log_bf = jnp.zeros(rows_l.shape[0], log_m.dtype)
+        for ci, c in enumerate(cols):
+            g = _spec_gamma(c, ctx)  # (Q*C,) int8; dies inside the fusion
+            # per-column twin of models.fellegi_sunter._select_levels:
+            # compare-and-mask accumulation over the static level axis in
+            # the same level order, scalar table entries broadcast
+            lp_m = jnp.zeros(g.shape, log_m.dtype)
+            lp_u = jnp.zeros(g.shape, log_u.dtype)
+            for lv in range(n_levels):
+                hit = g == lv
+                zero = jnp.zeros((), log_m.dtype)
+                lp_m = lp_m + jnp.where(hit, log_m[ci, lv], zero)
+                lp_u = lp_u + jnp.where(hit, log_u[ci, lv], zero)
+            null = g >= 0
+            zero = jnp.zeros((), log_m.dtype)
+            log_bf = log_bf + (
+                jnp.where(null, lp_m, zero) - jnp.where(null, lp_u, zero)
+            )
+        lam = params.lam
+        prior_logit = _safe_log(lam) - _safe_log(1.0 - lam)
+        p = jax.nn.sigmoid(prior_logit + log_bf)
+        return _finish_topk(p, cand, valid, k)
+
+    return score_fused
+
+
+def _exec_name(kind: str, q_pad: int, capacity: int) -> str:
+    """Canonical sidecar name of one compiled shape combination."""
+    return f"{kind}-q{q_pad}-c{capacity}"
+
+
+@contextlib.contextmanager
+def _persistent_cache_disabled():
+    """Force a REAL backend compile (no persistent-cache read) — the only
+    kind of executable that serializes into a loadable sidecar blob."""
+    import jax
+
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", prev)
+
+
+def _params_structs(mu_shape, dt):
+    """ShapeDtypeStruct pytree of the device-resident FSParams."""
+    import jax
+
+    from ..models.fellegi_sunter import FSParams
+
+    S = jax.ShapeDtypeStruct
+    return FSParams(lam=S((), dt), m=S(mu_shape, dt), u=S(mu_shape, dt))
 
 
 # ---------------------------------------------------------------------------
@@ -203,11 +313,23 @@ class QueryEngine:
     """
 
     def __init__(self, index, *, top_k: int | None = None, policy=None,
-                 telemetry=None, brownout_top_k: int | None = None):
+                 telemetry=None, brownout_top_k: int | None = None,
+                 fused: bool | None = None, aot_dir=None):
         from .bucketing import BucketPolicy, bucket_for
 
         self.index = index
         settings = index.settings
+        # Fused scoring (make_score_fused_fn) is the default hot path; the
+        # unfused program is the retained parity oracle (serve_fused=False
+        # or fused=False selects it).
+        self.fused = bool(
+            settings.get("serve_fused", True) if fused is None else fused
+        )
+        # AOT executable sidecar (serve/aot.py): when set, warmup restores
+        # every valid serialized executable instead of compiling, and
+        # save_aot() persists the compiled menu for the next process.
+        self._aot_dir = os.fspath(aot_dir) if aot_dir else None
+        self._aot_store = None  # memoised validated AotStore (or False)
         self.top_k = int(
             top_k
             if top_k is not None
@@ -242,8 +364,20 @@ class QueryEngine:
             else None
         )
         self._obs = telemetry
-        self._kernel = None
-        self._bkernel = None
+        # kind ("full" | "brownout") -> jitted fused program (stable
+        # identity; only used through .lower() for AOT-style compilation)
+        self._jits: dict = {}
+        # (kind, q_pad, capacity) -> jax.stages.Compiled: THE dispatch
+        # table. Each entry is an ahead-of-time compiled (or AOT-sidecar
+        # restored) executable for one exact shape combination — dispatch
+        # never goes through jit's tracing machinery, so a fresh process
+        # that restores the menu performs zero backend compiles.
+        self._execs: dict = {}
+        # key -> "compiled" | "aot": where each executable came from (an
+        # AOT-restored menu executes ONE dispatch probe during warmup
+        # instead of one per shape — see _warm_one)
+        self._exec_source: dict = {}
+        self._aot_exec_probed = False
         self._donate = None
         self._warmed: set[tuple[int, int]] = set()
         self._warmed_brownout: set[tuple[int, int]] = set()
@@ -278,8 +412,10 @@ class QueryEngine:
 
     def _build_kernel(self, k: int):
         """One jitted fused program for one top-k. ``capacity`` is a
-        static argument: each (capacity, shapes) combination compiles once
-        and is reused."""
+        static argument; the engine compiles each (capacity, shapes)
+        combination explicitly through ``.lower().compile()`` (the AOT
+        path — a compiled executable can be serialized into the sidecar
+        and restored by a fresh process without the backend compiler)."""
         import functools
 
         import jax
@@ -289,7 +425,10 @@ class QueryEngine:
         encode = make_encode_query_fn()
         layout = index.layout
         cols = tuple(index.settings["comparison_columns"])
-        score = make_score_topk_fn(layout, cols, k)
+        make_score = (
+            make_score_fused_fn if self.fused else make_score_topk_fn
+        )
+        score = make_score(layout, cols, k)
 
         def fused(
             capacity, packed_q, qbuckets, valid,
@@ -315,25 +454,157 @@ class QueryEngine:
             jax.jit, static_argnums=(0,), donate_argnums=donate
         )(fused)
 
-    def _fused_kernel(self):
-        """The full-service jitted program (built lazily, stable identity
-        so the jit cache persists across batches)."""
-        if self._kernel is None:
-            self._kernel = self._build_kernel(self.top_k)
-        return self._kernel
-
-    def _brownout_kernel(self):
-        """The budgeted brown-out twin: top-k ``brownout_top_k``, always
-        dispatched at the (cheapest) ``brownout_capacity`` candidate
-        bucket. Registered as ``serve_score_topk_brownout`` in the jaxpr
-        audit tier."""
-        if not self.brownout_top_k:
+    def _jit_kernel(self, kind: str):
+        """The jitted program for one tier (stable identity; lowered per
+        shape by :meth:`_ensure_exec`, never called directly)."""
+        if kind == "brownout" and not self.brownout_top_k:
             raise RuntimeError(
                 "brown-out tier is disabled (serve_brownout_top_k=0)"
             )
-        if self._bkernel is None:
-            self._bkernel = self._build_kernel(self.brownout_top_k)
-        return self._bkernel
+        jfn = self._jits.get(kind)
+        if jfn is None:
+            k = self.top_k if kind == "full" else self.brownout_top_k
+            jfn = self._jits[kind] = self._build_kernel(k)
+        return jfn
+
+    def _arg_structs(self, q_pad: int):
+        """ShapeDtypeStruct pytree of one dispatch's dynamic arguments at
+        query bucket ``q_pad`` — what ``.lower()`` needs instead of real
+        (allocated) example batches."""
+        import jax
+
+        index = self.index
+        S = jax.ShapeDtypeStruct
+        dt = index.float_dtype
+        i32, u32 = np.int32, np.uint32
+        return (
+            S((q_pad, index.n_lanes), u32),
+            S((len(index.rules), q_pad), i32),
+            S((), i32),
+            tuple(S(r.starts.shape, i32) for r in index.rules),
+            tuple(S(r.sizes.shape, i32) for r in index.rules),
+            tuple(S(r.rows_sorted.shape, i32) for r in index.rules),
+            tuple(S(r.row_bucket.shape, i32) for r in index.rules),
+            S(index.packed.shape, u32),
+            _params_structs(index.m.shape, dt),
+        )
+
+    def _ensure_exec(self, kind: str, q_pad: int, capacity: int):
+        """The compiled executable for one exact shape combination:
+        dispatch-table hit, else AOT-sidecar restore (zero backend
+        compiles), else a fresh ``.lower().compile()``."""
+        key = (kind, q_pad, capacity)
+        ex = self._execs.get(key)
+        if ex is not None:
+            return ex
+        store = self._aot_ready_store()
+        if store is not None:
+            ex = store.restore(_exec_name(kind, q_pad, capacity))
+            if ex is not None:
+                from ..obs.metrics import note_aot_restore
+
+                note_aot_restore()
+                self._execs[key] = ex
+                self._exec_source[key] = "aot"
+                return ex
+        from ..obs.metrics import compile_stats, install_compile_monitor
+
+        install_compile_monitor()
+        h0 = compile_stats()["cache_hits"]
+        lowered = self._jit_kernel(kind).lower(
+            capacity, *self._arg_structs(q_pad)
+        )
+        ex = self._execs[key] = lowered.compile()
+        # an executable the PERSISTENT cache served was itself
+        # deserialized — like an AOT restore, re-serializing it yields a
+        # blob that cannot be loaded ("Symbols not found"); save_aot must
+        # know to re-compile it cache-bypassed for the sidecar
+        self._exec_source[key] = (
+            "cache" if compile_stats()["cache_hits"] > h0 else "compiled"
+        )
+        return ex
+
+    # -- AOT executable sidecar -----------------------------------------
+
+    def _aot_binding(self) -> dict:
+        """The strict-invalidation identity every sidecar executable is
+        bound to (serve/aot.py adds the environment half: jax/jaxlib
+        version, backend, target-feature fingerprint)."""
+        index = self.index
+        return {
+            "index_state_hash": index.state_hash,
+            "index_fingerprint": index.content_fingerprint(),
+            "dtype": index.dtype,
+            "n_rules": len(index.rules),
+            "top_k": self.top_k,
+            "brownout_top_k": self.brownout_top_k,
+            "query_buckets": list(self.policy.query_buckets),
+            "candidate_buckets": list(self.policy.candidate_buckets),
+            "fused": self.fused,
+        }
+
+    def _aot_ready_store(self):
+        """The validated sidecar store, memoised; None when no sidecar is
+        configured, present, or valid (every invalidation reason emits one
+        ``serve_aot`` degradation event and serving falls back to fresh
+        compiles — never a wrong or foreign executable)."""
+        if self._aot_store is None:
+            if self._aot_dir is None:
+                self._aot_store = False
+            else:
+                from .aot import AotStore
+
+                store = AotStore(self._aot_dir)
+                self._aot_store = (
+                    store if store.validate(self._aot_binding()) else False
+                )
+        return self._aot_store or None
+
+    def save_aot(self, directory=None) -> str:
+        """Serialize every compiled executable currently in the dispatch
+        table into the AOT sidecar at ``directory`` (default: the engine's
+        ``aot_dir``), bound to the index fingerprint, settings hash, shape
+        menu and environment. Call after :meth:`warmup` so the sidecar
+        holds the full bucket menu. Returns the sidecar meta path."""
+        from .aot import AotStore
+
+        directory = directory or self._aot_dir
+        if not directory:
+            raise ValueError(
+                "no sidecar directory: pass save_aot(directory) or "
+                "construct the engine with aot_dir="
+            )
+        if not self._execs:
+            raise RuntimeError(
+                "nothing to save: run warmup() first so the dispatch table "
+                "holds the compiled bucket menu"
+            )
+        executables = {}
+        recompiled = 0
+        for (kind, q_pad, capacity), ex in self._execs.items():
+            if self._exec_source.get((kind, q_pad, capacity)) != "compiled":
+                # only an executable ACTUALLY backend-compiled in this
+                # process serializes into a loadable blob; one restored
+                # from the sidecar OR served by the persistent compile
+                # cache was itself deserialized, and re-serializing it
+                # succeeds silently but fails deserialize_and_load with
+                # "Symbols not found" — writing it would overwrite a
+                # valid sidecar with a poisoned one. Re-compile a fresh
+                # twin with the persistent cache bypassed; the existing
+                # executable keeps serving.
+                with _persistent_cache_disabled():
+                    ex = self._jit_kernel(kind).lower(
+                        capacity, *self._arg_structs(q_pad)
+                    ).compile()
+                recompiled += 1
+            executables[_exec_name(kind, q_pad, capacity)] = ex
+        path = AotStore.write(directory, self._aot_binding(), executables)
+        logger.info(
+            "AOT executable sidecar saved: %s (%d executables, %d "
+            "re-lowered from restored entries)",
+            directory, len(executables), recompiled,
+        )
+        return path
 
     # -- query paths ----------------------------------------------------
 
@@ -398,7 +669,7 @@ class QueryEngine:
             # service tags every result degraded and emits the episode
             # events)
             capacity = self.brownout_capacity
-            kernel = self._brownout_kernel()
+            kind = "brownout"
         else:
             counts = index.candidate_counts(qb)
             need = max(int(counts.max(initial=0)), self.top_k, 1)
@@ -413,7 +684,14 @@ class QueryEngine:
                     "truncated to the bucket (top-k over the truncated set)",
                     queries=n,
                 )
-            kernel = self._fused_kernel()
+            kind = "full"
+        if profile is not None:
+            from ..obs.metrics import compile_totals
+
+            # snapshot BEFORE the dispatch-table lookup: a cold shape
+            # compiles inside _ensure_exec, not inside the call
+            c0 = compile_totals()[1]
+        kernel = self._ensure_exec(kind, q_pad, capacity)
         # pinned upload buffers are reused without a host memset: the
         # encode_query kernel zeroes padding rows on device
         packed_pad = np.empty((q_pad, index.n_lanes), np.uint32)
@@ -421,12 +699,7 @@ class QueryEngine:
         qb_pad = np.empty((len(index.rules), q_pad), np.int32)
         qb_pad[:, :n] = qb
         dev = index.device_state()
-        if profile is not None:
-            from ..obs.metrics import compile_totals
-
-            c0 = compile_totals()[1]
         top_p, top_rows, top_valid, n_cand = kernel(
-            capacity,
             jnp.asarray(packed_pad),
             jnp.asarray(qb_pad),
             np.int32(n),
@@ -499,21 +772,36 @@ class QueryEngine:
     # -- warmup / compile accounting ------------------------------------
 
     def warmup(self) -> dict:
-        """Compile every (query-bucket, candidate-bucket) combination with
-        dummy batches so steady-state serving never compiles — the
-        brown-out tier's (query-bucket, ``brownout_capacity``) shapes
-        included when enabled, so a brown-out EPISODE is also
-        recompile-free. Returns ``{"combinations": N, "compiles": measured
-        backend compiles}`` — the compile count is the jax.monitoring-
-        measured proof that one combination costs exactly one compile
-        (and, after this, zero)."""
-        from ..obs.metrics import compile_totals, install_compile_monitor
+        """Ready every (query-bucket, candidate-bucket) combination so
+        steady-state serving never compiles — the brown-out tier's
+        (query-bucket, ``brownout_capacity``) shapes included when enabled,
+        so a brown-out EPISODE is also recompile-free. Each combination is
+        AOT-restored from the sidecar when one is configured and valid
+        (zero backend compiles), else compiled fresh. Freshly compiled
+        programs each execute one dummy batch; a restored menu executes
+        only the FIRST and the LARGEST full-service shape
+        (deserialization already validated the artifacts, the first probe
+        proves dispatch on this machine, the largest proves the biggest
+        buffer allocation — per-shape dummy batches made restored warmup
+        scale with menu compute for nothing).
+
+        Returns the jax.monitoring-measured accounting split:
+        ``combinations``, ``compiles`` (REAL backend compiles),
+        ``cache_hits`` (persistent-compilation-cache restores) and
+        ``aot_restored`` (sidecar-deserialized executables) — a cold
+        replica shows combinations == compiles, a persistent-cache-warm
+        one combinations == cache_hits, an AOT-restored one
+        combinations == aot_restored with compiles == 0."""
+        from ..obs.metrics import compile_stats, install_compile_monitor
 
         install_compile_monitor()
-        c0, _ = compile_totals()
+        s0 = compile_stats()
         combos = self.policy.warmup_combinations()
         for q_pad, capacity in combos:
-            self._warm_one(q_pad, capacity)
+            self._warm_one(
+                q_pad, capacity,
+                force_execute=(q_pad, capacity) == combos[-1],
+            )
         brownout_combos = []
         if self.brownout_top_k:
             brownout_combos = [
@@ -522,28 +810,48 @@ class QueryEngine:
             ]
             for q_pad, capacity in brownout_combos:
                 self._warm_one(q_pad, capacity, degraded=True)
-        c1, _ = compile_totals()
-        if self._obs is not None:
-            self._obs.count("serve_warmup_compiles", c1 - c0)
-        return {
+        s1 = compile_stats()
+        stats = {
             "combinations": len(combos) + len(brownout_combos),
-            "compiles": c1 - c0,
+            "compiles": s1["compiles"] - s0["compiles"],
+            "cache_hits": s1["cache_hits"] - s0["cache_hits"],
+            "aot_restored": s1["aot_restores"] - s0["aot_restores"],
         }
+        if self._obs is not None:
+            self._obs.count("serve_warmup_compiles", stats["compiles"])
+            self._obs.count("serve_warmup_cache_hits", stats["cache_hits"])
+            self._obs.count(
+                "serve_warmup_aot_restores", stats["aot_restored"]
+            )
+        return stats
 
     def _warm_one(self, q_pad: int, capacity: int,
-                  degraded: bool = False) -> None:
+                  degraded: bool = False, force_execute: bool = False) -> None:
         import jax.numpy as jnp
 
         with self._swap_lock:
             index = self.index
             dev = index.device_state()
-            kernel = (
-                self._brownout_kernel() if degraded else self._fused_kernel()
-            )
+            kind = "brownout" if degraded else "full"
+            kernel = self._ensure_exec(kind, q_pad, capacity)
+            if not force_execute and (
+                self._exec_source.get((kind, q_pad, capacity)) == "aot"
+            ):
+                # a restored executable was already validated by its
+                # deserialization; executing a dummy batch per shape is
+                # what made CPU-tier warmup scale with the menu (the big
+                # combos score millions of padded pairs for nothing). ONE
+                # dispatch probe per restored menu proves execution on
+                # this machine; the rest skip straight to ready.
+                if self._aot_exec_probed:
+                    (self._warmed_brownout if degraded else self._warmed).add(
+                        (q_pad, capacity)
+                    )
+                    return
+                self._aot_exec_probed = True
             packed = np.zeros((q_pad, index.n_lanes), np.uint32)
             qb = np.full((len(index.rules), q_pad), -1, np.int32)
             out = kernel(
-                capacity,
                 jnp.asarray(packed),
                 jnp.asarray(qb),
                 np.int32(0),
@@ -575,7 +883,8 @@ class QueryEngine:
         result fetch, no compile after warmup). The watchdog's circuit-
         breaker recovery probe: success proves the engine can dispatch."""
         self._warm_one(
-            self.policy.query_buckets[0], self.policy.candidate_buckets[0]
+            self.policy.query_buckets[0], self.policy.candidate_buckets[0],
+            force_execute=True,
         )
 
     @property
@@ -658,12 +967,23 @@ class QueryEngine:
         new_probes = None
         probes = self._probes  # snapshot: validation runs against THIS set
         try:
+            # a candidate loaded from disk may ship its own AOT sidecar
+            # (<dir>/aot) — the pending engine's pre-warm restores from it
+            # when its binding matches, cutting the swap's compile window;
+            # a stale/foreign sidecar degrades to fresh compiles as usual
+            pending_aot = None
+            if not isinstance(source, LinkageIndex):
+                cand_aot = os.path.join(os.fspath(source), "aot")
+                if os.path.isdir(cand_aot):
+                    pending_aot = cand_aot
             pending = QueryEngine(
                 new_index,
                 top_k=self.top_k,
                 policy=self.policy,
                 telemetry=self._obs,
                 brownout_top_k=self.brownout_top_k,
+                fused=self.fused,
+                aot_dir=pending_aot,
             )
             warm = pending.warmup()
             plan.fire("swap_validate", generation=generation)
@@ -688,9 +1008,13 @@ class QueryEngine:
             ) from e
         with self._swap_lock:
             self.index = pending.index
-            self._kernel = pending._kernel
-            self._bkernel = pending._bkernel
+            self._jits = pending._jits
+            self._execs = pending._execs
+            self._exec_source = pending._exec_source
+            self._aot_exec_probed = pending._aot_exec_probed
             self._donate = pending._donate
+            self._aot_dir = pending._aot_dir
+            self._aot_store = pending._aot_store
             self._warmed = pending._warmed
             self._warmed_brownout = pending._warmed_brownout
             if new_probes is not None:

@@ -239,6 +239,7 @@ class LinkageIndex:
         self.state_hash = state_hash
         self._device = None  # memoised device-resident arrays
         self._vocab_maps: dict | None = None
+        self._content_fp: str | None = None
 
     # ------------------------------------------------------------------
     # Introspection
@@ -255,6 +256,27 @@ class LinkageIndex:
     @property
     def float_dtype(self):
         return np.float64 if self.dtype == "float64" else np.float32
+
+    def content_fingerprint(self) -> str:
+        """sha256 over every array a serve executable's answers depend on
+        (packed matrix, per-rule CSR, trained parameters, dtype, settings
+        hash) — the identity the AOT executable sidecar binds to. Two
+        indexes with the same fingerprint produce bit-identical kernel
+        results; anything else invalidates the sidecar. Memoised (one hash
+        walk over ~the artifact size)."""
+        if self._content_fp is None:
+            h = hashlib.sha256()
+            h.update(self.state_hash.encode())
+            h.update(self.dtype.encode())
+            h.update(np.ascontiguousarray(self.packed).tobytes())
+            for r in self.rules:
+                for a in (r.rows_sorted, r.starts, r.sizes, r.row_bucket):
+                    h.update(np.ascontiguousarray(a).tobytes())
+            h.update(np.float64(self.lam).tobytes())
+            h.update(np.ascontiguousarray(self.m, np.float64).tobytes())
+            h.update(np.ascontiguousarray(self.u, np.float64).tobytes())
+            self._content_fp = h.hexdigest()
+        return self._content_fp
 
     def candidate_counts(self, qbuckets: np.ndarray) -> np.ndarray:
         """(n,) int64 upper-bound candidate count per query (duplicates

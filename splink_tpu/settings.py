@@ -59,13 +59,13 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "profile_dir",
     "telemetry_dir",
     "telemetry_memory",
-    # NOTE: compilation_cache_dir is deliberately NOT auto-filled. The
-    # linker must be able to tell a user-set value (opts in on any
-    # backend) from the schema default (accelerator backends only), and
-    # completion mutates the caller's dict in place — auto-filling would
-    # make a reused settings dict look explicitly configured on the
-    # second Splink() construction. The linker resolves the default
-    # lazily instead.
+    # NOTE: compilation_cache_dir is deliberately NOT auto-filled:
+    # completion mutates the caller's dict in place, so auto-filling
+    # would make a reused settings dict look explicitly configured on
+    # the second Splink() construction. The linker resolves the schema
+    # default lazily instead (the cache is on for every backend; the
+    # CPU tier keys entries by target-feature fingerprint — see
+    # linker._enable_compilation_cache).
     "float64",
     "checkpoint_dir",
     "checkpoint_interval",
@@ -79,6 +79,7 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "serve_breaker_threshold",
     "serve_hedge_ms",
     "serve_probe_queries",
+    "serve_fused",
     "serve_trace_sample_rate",
     "obs_exposition_port",
     "obs_flight_records",

@@ -1,0 +1,81 @@
+"""Execution-environment fingerprints for compiled-artifact invalidation.
+
+Two consumers bind compiled XLA artifacts to the machine that produced
+them:
+
+  * the AOT executable sidecar (:mod:`..serve.aot`) — a serialized
+    executable is literal machine code; restoring one compiled for a
+    different ISA is a SIGILL, not a slowdown, so the sidecar is rejected
+    unless the full environment fingerprint matches;
+  * the persistent XLA compilation cache (:func:`..linker._enable_compilation_cache`)
+    — jax's own cache key covers the program and compile options but not
+    the host CPU's target features, and XLA CPU compiles for the host ISA
+    (``-march=native`` semantics). The linker therefore keys the cache
+    directory on :func:`cpu_target_fingerprint`, which is what makes CPU-
+    tier caching safe to enable (a shared cache volume mounted on
+    heterogeneous machines partitions per CPU type instead of serving
+    foreign code).
+
+Everything here is stdlib-only until a fingerprint actually needs the jax
+backend probe.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import platform
+
+
+def cpu_target_fingerprint() -> str:
+    """Stable hex fingerprint of the host CPU's instruction-set surface:
+    the architecture plus the feature flags the kernel reports
+    (``flags`` on x86, ``Features`` on ARM). Two hosts with the same
+    fingerprint can safely exchange XLA-CPU-compiled code; the flag SET is
+    order-normalised so kernel-version reordering does not split the
+    key."""
+    parts = [platform.machine() or "unknown"]
+    flags = ""
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as fh:
+            for line in fh:
+                key, _, val = line.partition(":")
+                if key.strip().lower() in ("flags", "features"):
+                    flags = " ".join(sorted(val.split()))
+                    break
+    except OSError:  # non-Linux: coarser, but still arch-bound
+        flags = platform.processor() or ""
+    parts.append(flags)
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def backend_target_fingerprint(backend: str | None = None) -> str:
+    """Target fingerprint for the active jax backend: the CPU feature
+    fingerprint on the CPU tier, the device kind + platform version on
+    accelerators (a v4 executable must not restore on a v5 replica)."""
+    import jax
+
+    backend = backend or jax.default_backend()
+    if backend == "cpu":
+        return cpu_target_fingerprint()
+    dev = jax.devices(backend)[0]
+    kind = getattr(dev, "device_kind", backend)
+    version = getattr(dev.client, "platform_version", "")
+    return hashlib.sha256(f"{backend}|{kind}|{version}".encode()).hexdigest()
+
+
+def environment_fingerprint() -> dict:
+    """The full invalidation identity of this process's compile
+    environment: jax/jaxlib versions (the serialization format owners),
+    the backend, its target fingerprint, and the x64 switch (an x64
+    process lowers different programs)."""
+    import jax
+    import jaxlib
+
+    backend = jax.default_backend()
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": backend,
+        "target": backend_target_fingerprint(backend),
+        "x64": bool(jax.config.jax_enable_x64),
+    }

@@ -9,6 +9,7 @@ Must run before jax is imported anywhere in the test process.
 """
 
 import os
+import tempfile
 
 # Force CPU: the environment pre-sets JAX_PLATFORMS=axon (real TPU) and
 # pre-imports jax at interpreter startup, so the env var alone is ignored —
@@ -16,6 +17,20 @@ import os
 # CPU devices; x64 (needed for oracle-exact comparisons) is also unavailable
 # on TPU.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Hermetic persistent compilation cache: the linker now enables the cache on
+# EVERY backend (CPU entries keyed by target fingerprint), and the env var
+# takes precedence over any settings value — pinning it to a per-session
+# temp dir keeps test runs from reading ~/.cache state left by earlier runs
+# (compile-count assertions account for in-session cache hits via
+# obs.metrics.compile_stats). Tests that exercise the settings-driven path
+# monkeypatch-delete the var.
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    import atexit
+    import shutil
+
+    _xla_cache_dir = tempfile.mkdtemp(prefix="splink_tpu_test_xla_cache_")
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = _xla_cache_dir
+    atexit.register(shutil.rmtree, _xla_cache_dir, True)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -248,7 +248,7 @@ def test_mesh_emission_parity():
 def test_zero_steady_state_recompiles():
     """After the first emission warms the per-rule kernels, re-driving the
     SAME plan — uneven tail chunks and all — compiles nothing."""
-    from splink_tpu.obs.metrics import compile_totals, install_compile_monitor
+    from splink_tpu.obs.metrics import compile_requests, install_compile_monitor
 
     install_compile_monitor()
     s = _settings(["l.first_name = r.first_name", "l.surname = r.surname"])
@@ -256,9 +256,9 @@ def test_zero_steady_state_recompiles():
     plan = build_device_plan(s, t)
     assert plan is not None
     first = [c for c in iter_device_pairs(plan, 128)]
-    c0, _ = compile_totals()
+    c0 = compile_requests()
     second = [c for c in iter_device_pairs(plan, 128)]
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     assert c1 == c0, f"{c1 - c0} steady-state recompiles"
     flat = lambda cs: [(r, i.tolist(), j.tolist()) for r, i, j in cs]  # noqa: E731
     assert flat(first) == flat(second)

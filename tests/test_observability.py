@@ -304,53 +304,75 @@ def test_profile_dir_captures_traces(tmp_path):
         set_trace_dir(None)  # process-wide flag: do not leak into other tests
 
 
-def test_reused_settings_dict_stays_cache_default_gated():
-    """Reusing ONE settings dict (no compilation_cache_dir) for two
-    linkers must not enable the cache on the CPU backend: completion
-    mutates the dict in place, and an auto-filled default key must not
-    masquerade as a user opt-in on the second construction."""
+def test_cpu_cache_keyed_by_target_fingerprint(tmp_path, monkeypatch):
+    """On the CPU backend the persistent compilation cache is ON (no more
+    accelerator-only gate) and its directory is keyed by the host's
+    target-feature fingerprint: XLA:CPU executables embed exact machine
+    features, so the ``cpu-<fp16>`` subdirectory is what keeps a shared
+    cache volume from serving SIGILL-prone foreign code. Completion still
+    never auto-fills the settings key."""
+    import os
+
     import jax
     import pandas as pd
 
     import splink_tpu.linker as linker_mod
     from splink_tpu import Splink
+    from splink_tpu.settings import complete_settings_dict
+    from splink_tpu.utils.envfp import cpu_target_fingerprint
 
+    if jax.default_backend() != "cpu":
+        pytest.skip("CPU-only keying: not exercisable on an accelerator")
+    # the conftest-pinned env var must not short-circuit the settings path
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
     prev_applied = linker_mod._compilation_cache_applied
-    df = pd.DataFrame({"unique_id": [0, 1], "name": ["a", "b"]})
-    s = {
-        "link_type": "dedupe_only",
-        "comparison_columns": [{"col_name": "name", "num_levels": 2}],
-        "blocking_rules": ["l.name = r.name"],
-    }
+    prev_dir = jax.config.jax_compilation_cache_dir
     try:
         linker_mod._compilation_cache_applied = None
-        if jax.default_backend() != "cpu":
-            pytest.skip("CPU-only gate: not exercisable on an accelerator")
+        base = tmp_path / "xla"
+        linker_mod._enable_compilation_cache(str(base), explicit=False)
+        applied = linker_mod._compilation_cache_applied
+        expect = os.path.join(
+            str(base), f"cpu-{cpu_target_fingerprint()[:16]}"
+        )
+        assert applied == expect
+        assert jax.config.jax_compilation_cache_dir == expect
+        # two hosts with different feature sets never share entries: the
+        # fingerprint is a pure function of machine + flags
+        assert cpu_target_fingerprint() == cpu_target_fingerprint()
+        # completion never fills the key (the linker resolves the schema
+        # default lazily; a reused dict must not look explicitly set)
+        s = complete_settings_dict(
+            {
+                "link_type": "dedupe_only",
+                "comparison_columns": [
+                    {"col_name": "name", "num_levels": 2}
+                ],
+                "blocking_rules": ["l.name = r.name"],
+            }
+        )
+        assert "compilation_cache_dir" not in s
+        # first linker wins holds for the fingerprinted path too
+        linker_mod._enable_compilation_cache(
+            str(tmp_path / "other"), explicit=False
+        )
+        assert linker_mod._compilation_cache_applied == expect
+        # and a default-config linker construction leaves it untouched
+        df = pd.DataFrame({"unique_id": [0, 1], "name": ["a", "b"]})
         Splink(s, df=df)
-        assert linker_mod._compilation_cache_applied is None
-        Splink(s, df=df)  # same (now completed) dict again
-        assert linker_mod._compilation_cache_applied is None
-        assert "compilation_cache_dir" not in s  # completion never fills it
-        # legacy saved models carry the auto-filled DEFAULT value in their
-        # settings (earlier builds completed it in): equal-to-default must
-        # read as implicit, not as a CPU opt-in
-        from splink_tpu.validate import get_default_value
-
-        legacy = {
-            **s,
-            "compilation_cache_dir": get_default_value(
-                "compilation_cache_dir", is_column_setting=False
-            ),
-        }
-        Splink(legacy, df=df)
-        assert linker_mod._compilation_cache_applied is None
+        assert linker_mod._compilation_cache_applied == expect
     finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
         linker_mod._compilation_cache_applied = prev_applied
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
 
 
-def test_compilation_cache_dir_applies(tmp_path):
+def test_compilation_cache_dir_applies(tmp_path, monkeypatch):
     """settings["compilation_cache_dir"] -> jax persistent compilation
-    cache enabled at that path (process-wide, first linker wins); entries
+    cache enabled at that path (process-wide, first linker wins; on the
+    CPU backend under the target-fingerprint subdirectory); entries
     actually land once a compile exceeds the time threshold (forced to 0
     here so the CPU tier's sub-second compiles qualify)."""
     import os
@@ -361,7 +383,14 @@ def test_compilation_cache_dir_applies(tmp_path):
 
     import splink_tpu.linker as linker_mod
     from splink_tpu import Splink
+    from splink_tpu.utils.envfp import cpu_target_fingerprint
 
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    expect = str(tmp_path / "xla")
+    if jax.default_backend() == "cpu":
+        expect = os.path.join(
+            expect, f"cpu-{cpu_target_fingerprint()[:16]}"
+        )
     prev_dir = jax.config.jax_compilation_cache_dir
     prev_applied = linker_mod._compilation_cache_applied
     prev_min_time = jax.config.jax_persistent_cache_min_compile_time_secs
@@ -384,7 +413,7 @@ def test_compilation_cache_dir_applies(tmp_path):
     try:
         linker_mod._compilation_cache_applied = None
         Splink(s, df=df)
-        assert jax.config.jax_compilation_cache_dir == str(cache)
+        assert jax.config.jax_compilation_cache_dir == expect
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         # drop in-process executable caches: earlier tests may have
         # compiled these same shapes, and only a real compile persists.
@@ -403,10 +432,10 @@ def test_compilation_cache_dir_applies(tmp_path):
         # empty value disables for a fresh process but must NOT clear the
         # already-applied process-wide dir (first linker wins)
         Splink({**s, "compilation_cache_dir": ""}, df=df)
-        assert jax.config.jax_compilation_cache_dir == str(cache)
+        assert jax.config.jax_compilation_cache_dir == expect
         # a later linker with a DIFFERENT dir must also be ignored
         Splink({**s, "compilation_cache_dir": str(tmp_path / "b")}, df=df)
-        assert jax.config.jax_compilation_cache_dir == str(cache)
+        assert jax.config.jax_compilation_cache_dir == expect
     finally:
         jax.config.update("jax_compilation_cache_dir", prev_dir)
         jax.config.update(

@@ -158,19 +158,23 @@ def test_warmup_compiles_once_per_bucket_combo(trained):
     combinations after warmup, and steady-state serving (any bucketed
     batch size) performs ZERO recompiles — measured by the jax.monitoring
     compile counter."""
-    from splink_tpu.obs.metrics import compile_totals
+    from splink_tpu.obs.metrics import compile_requests
 
     df, _, _, index = trained
     policy = BucketPolicy((8, 32), (64, 128))
     eng = QueryEngine(index, top_k=8, policy=policy)
     stats = eng.warmup()
     assert stats["combinations"] == 4
-    assert stats["compiles"] == 4
-    c0, _ = compile_totals()
+    # each combination costs exactly one backend_compile request — a real
+    # compile, or a persistent-cache restore when an earlier test in this
+    # session already compiled the identical program (the split accounting
+    # tells them apart; neither may happen in steady state below)
+    assert stats["compiles"] + stats["cache_hits"] == 4
+    c0 = compile_requests()
     eng.query_arrays(df.head(3))
     eng.query_arrays(df.head(30))
     eng.query_arrays(df.head(70))  # > largest bucket: splits into chunks
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     assert c1 - c0 == 0, "steady-state serving must not recompile"
     assert eng.warmed_shapes == {(8, 64), (8, 128), (32, 64), (32, 128)}
 

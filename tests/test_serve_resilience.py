@@ -366,19 +366,19 @@ def _service(engine, **over):
 
 
 def test_warmup_covers_brownout_shapes(trained):
-    from splink_tpu.obs.metrics import compile_totals
+    from splink_tpu.obs.metrics import compile_requests
 
     _, _, index = trained
     eng = QueryEngine(index, policy=BucketPolicy((16,), (64,)))
     assert eng.brownout_top_k == 2 and eng.brownout_capacity == 64
     stats = eng.warmup()
     assert stats["combinations"] == 2  # 1 full-service + 1 brown-out shape
-    assert stats["compiles"] == 2
-    c0, _ = compile_totals()
+    assert stats["compiles"] + stats["cache_hits"] == 2
+    c0 = compile_requests()
     df, _, _ = trained
     eng.query_arrays(df.head(5))
     eng.query_arrays(df.head(5), degraded=True)
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     assert c1 - c0 == 0, "warmed brown-out episode must not recompile"
 
 
@@ -559,18 +559,18 @@ def test_deadline_rejected_at_admission_and_at_dispatch(engine, trained):
 
 
 def test_brownout_serves_degraded_without_recompiles(engine, trained):
-    from splink_tpu.obs.metrics import compile_totals
+    from splink_tpu.obs.metrics import compile_requests
 
     df, _, _ = trained
     svc = _service(engine, autostart=False, queue_depth=16)
     futures = [
         svc.submit(r) for r in df.head(12).to_dict(orient="records")
     ]  # 75% full at dispatch
-    c0, _ = compile_totals()
+    c0 = compile_requests()
     with pytest.warns(DegradationWarning, match="brown"):
         svc.start()
         results = [f.result(timeout=WAIT) for f in futures]
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     assert all(not r.shed and r.degraded for r in results)
     assert all(len(r.matches) <= engine.brownout_top_k for r in results)
     assert c1 - c0 == 0, "a warmed brown-out episode must not recompile"
@@ -623,7 +623,7 @@ def test_health_endpoint_degrades_and_recovers(engine, trained):
 
 
 def test_hot_swap_parity_commit_and_rollbacks(trained, tmp_path, clean_faults):
-    from splink_tpu.obs.metrics import compile_totals
+    from splink_tpu.obs.metrics import compile_requests
 
     df, linker, index = trained
     eng = QueryEngine(index, policy=BucketPolicy((16,), (64, 256)))
@@ -636,9 +636,9 @@ def test_hot_swap_parity_commit_and_rollbacks(trained, tmp_path, clean_faults):
     linker.export_index(path2)
     stats = eng.swap_index(path2)
     assert stats["generation"] == 1 and stats["probes_checked"] == 6
-    c0, _ = compile_totals()
+    c0 = compile_requests()
     after = eng.query_arrays(df.head(20))
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     assert c1 - c0 == 0, "post-swap steady state must not recompile"
     for a, b in zip(before, after):
         assert np.array_equal(a, b), "post-swap answers must be bit-identical"

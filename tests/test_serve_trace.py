@@ -219,15 +219,15 @@ def test_root_claims_exactly_one_delivery():
 
 
 def test_delivered_phases_sum_to_wall(engine, trained, capture):
-    from splink_tpu.obs.metrics import compile_totals
+    from splink_tpu.obs.metrics import compile_requests
 
     df, _, _ = trained
     records = df.head(40).to_dict(orient="records")
     svc = _service(engine)
-    c0, _ = compile_totals()
+    c0 = compile_requests()
     futures = [svc.submit(dict(r)) for r in records]
     results = [f.result(timeout=WAIT) for f in futures]
-    c1, _ = compile_totals()
+    c1 = compile_requests()
     svc.close()
     assert not any(r.shed for r in results)
     assert c1 - c0 == 0, "tracing must not add steady-state recompiles"

@@ -303,6 +303,18 @@ def test_serve_resilience_key_types_validated():
     )
 
 
+def test_serve_fused_key():
+    """serve_fused completes true (the fused megakernel is the default
+    serving path), validates as a strict boolean, and false (the unfused
+    parity oracle) passes."""
+    s = complete_settings_dict(_minimal())
+    assert s["serve_fused"] is True
+    for bad in ({"serve_fused": "yes"}, {"serve_fused": 1}):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    validate_settings(_minimal(serve_fused=False))
+
+
 def test_serve_observability_defaults_filled():
     """The obs v2 keys complete from the schema: tracing OFF (sample rate
     0), exposition endpoint OFF (port 0), flight recorder ON at 256

@@ -380,6 +380,43 @@ def test_metrics_registry_and_compile_monitor():
     assert c1 > c0 and s1 > s0
 
 
+def test_compile_stats_split_accounting():
+    """The accounting split behind compile_totals: real compiles =
+    backend_compile requests - persistent-cache hits, AOT restores
+    tracked separately (jax emits no event for a deserialized
+    executable; the serve engine reports them via note_aot_restore), and
+    compile_totals keeps reporting REAL compiles only — the zero-
+    recompile gates and the compile-stall health signal must not misfire
+    on a cache- or sidecar-restored replica."""
+    import jax
+    import jax.numpy as jnp
+
+    from splink_tpu.obs.metrics import (
+        compile_stats,
+        compile_totals,
+        install_compile_monitor,
+        note_aot_restore,
+    )
+
+    install_compile_monitor()
+    before = compile_stats()
+    assert before["compiles"] == before["requests"] - before["cache_hits"]
+    # at least the lowered program itself compiles (helper programs —
+    # jnp.ones's fill, transfer stubs — may add more requests; the
+    # INVARIANT is what matters, not the exact count)
+    jax.jit(lambda x: x - 2).lower(jnp.ones(23)).compile()
+    mid = compile_stats()
+    assert mid["requests"] >= before["requests"] + 1
+    assert mid["compiles"] + mid["cache_hits"] == mid["requests"]
+    assert compile_totals()[0] == mid["compiles"]
+    note_aot_restore(3)
+    after = compile_stats()
+    assert after["aot_restores"] == mid["aot_restores"] + 3
+    # an AOT restore is invisible to the compile counters
+    assert after["requests"] == mid["requests"]
+    assert compile_totals()[0] == after["compiles"]
+
+
 def test_event_sanitisation(tmp_path):
     """numpy scalars/arrays and non-finite floats serialise to strict JSON."""
     from splink_tpu.obs.events import EventSink
