@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke trace-smoke warmup-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -58,6 +58,15 @@ chaos-smoke:
 blocking-smoke:
 	python scripts/blocking_smoke.py
 
+# Approximate-blocking smoke: minhash-LSH candidate-set determinism across
+# two runs, approx_pair_budget held, zero steady-state recompiles across
+# chunk shapes, and serve fallback parity with a host-side oracle —
+# garbled queries return approx-tagged candidates whose scores are
+# bit-identical to offline scoring of the same pairs
+# (docs/blocking.md#approximate-tier).
+approx-smoke:
+	python scripts/approx_smoke.py
+
 # Request-tracing smoke: the serving tier under an injected slow batch +
 # breaker storm with tracing at full sample rate, asserting the
 # attribution contract — per-request phase durations sum to the measured
@@ -85,4 +94,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke bench
+all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke bench

@@ -918,6 +918,70 @@ def _ensure_default_registry() -> None:
             {},
         )
 
+    # Approximate-blocking minhash signatures sharded over the RECORD
+    # axis: each shard sketches its own rows against the replicated hash
+    # parameters — embarrassingly parallel, zero collectives, outputs
+    # record-sharded. This is the index-build / signature-refresh shape on
+    # a mesh.
+    @register_shard_kernel("approx_minhash_sharded", n_pairs=64)
+    def _build_approx_minhash_sharded():
+        import jax
+        import numpy as np
+
+        from ..approx.minhash import (
+            column_salts,
+            hash_params,
+            make_minhash_fn,
+        )
+        from ..parallel.mesh import pair_sharding, replicated
+
+        mesh = audit_mesh()
+        shard, rep = pair_sharding(mesh), replicated(mesh)
+        fn = make_minhash_fn(2, 4, 2, ((12, "ascii"),))
+        rng = np.random.default_rng(0)
+        bytes_ = jax.device_put(
+            rng.integers(97, 123, size=(64, 12)).astype(np.uint8), shard
+        )
+        lens = jax.device_put(np.full(64, 8, np.int32), shard)
+        a, b = hash_params(8)
+        salts = column_salts(1)
+        return (
+            fn,
+            (bytes_, lens, jax.device_put(a, rep), jax.device_put(b, rep),
+             jax.device_put(salts, rep)),
+            {},
+        )
+
+    # Approximate-blocking verification sharded over the candidate-PAIR
+    # axis: i/j shard, the band-code matrix and the per-column byte/aux
+    # tables replicate, each shard gathers and verifies its own pairs —
+    # zero collectives, outputs pair-sharded (the blocking-emission
+    # pattern block_pair_decode_sharded pins, applied to the verify pass).
+    @register_shard_kernel("approx_verify_sharded", n_pairs=64)
+    def _build_approx_verify_sharded():
+        import jax
+        import numpy as np
+
+        from ..approx.lsh import make_verify_fn
+        from ..parallel.mesh import pair_sharding, replicated
+
+        mesh = audit_mesh()
+        shard, rep = pair_sharding(mesh), replicated(mesh)
+        fn = make_verify_fn(2, 4, ((12, "ascii"),), True)
+        rng = np.random.default_rng(0)
+        i = jax.device_put(np.zeros(64, np.int32), shard)
+        j = jax.device_put(np.ones(64, np.int32), shard)
+        band_codes = jax.device_put(
+            rng.integers(-1, 4, size=(4, 16)).astype(np.int32), rep
+        )
+        bytes_ = jax.device_put(
+            rng.integers(97, 123, size=(16, 12)).astype(np.uint8), rep
+        )
+        lens = jax.device_put(np.full(16, 8, np.int32), rep)
+        mask = jax.device_put(np.zeros((16, 1), np.uint32), rep)
+        count = jax.device_put(np.full(16, 7, np.int32), rep)
+        return fn, (i, j, band_codes, bytes_, lens, mask, count), {}
+
     # String similarity is per-pair elementwise: zero collectives, output
     # sharded.
     @register_shard_kernel("jaro_winkler_sharded", n_pairs=64)

@@ -739,6 +739,62 @@ def _ensure_default_registry() -> None:
             {},
         )
 
+    # ----- approximate blocking (splink_tpu/approx/) -----
+    # The minhash-signature and LSH-verification kernels run over every
+    # record / every candidate pair of an approx-tier run (and the minhash
+    # kernel again per serve fallback batch), so they are gated like the
+    # blocking kernels: pinned uint32/int32 widths under the forced-x64
+    # trace, no embedded hash-parameter constants, no callbacks,
+    # deterministic traces.
+
+    @register_kernel("approx_minhash")
+    def _build_approx_minhash():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..approx.minhash import (
+            column_salts,
+            hash_params,
+            make_minhash_fn,
+        )
+
+        fn = make_minhash_fn(2, 4, 2, ((12, "ascii"),))
+        rng = np.random.default_rng(0)
+        bytes_ = jnp.asarray(
+            rng.integers(97, 123, size=(16, 12)).astype(np.uint8)
+        )
+        lens = jnp.asarray(np.full(16, 8, np.int32))
+        a, b = hash_params(8)
+        salts = column_salts(1)
+        return (
+            fn,
+            (bytes_, lens, jnp.asarray(a), jnp.asarray(b),
+             jnp.asarray(salts)),
+            {},
+        )
+
+    @register_kernel("approx_verify")
+    def _build_approx_verify():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..approx.lsh import make_verify_fn
+
+        fn = make_verify_fn(2, 4, ((12, "ascii"),), True)
+        rng = np.random.default_rng(0)
+        i = jnp.asarray(np.zeros(32, np.int32))
+        j = jnp.asarray(np.ones(32, np.int32))
+        band_codes = jnp.asarray(
+            rng.integers(-1, 4, size=(4, 16)).astype(np.int32)
+        )
+        bytes_ = jnp.asarray(
+            rng.integers(97, 123, size=(16, 12)).astype(np.uint8)
+        )
+        lens = jnp.asarray(np.full(16, 8, np.int32))
+        mask = jnp.asarray(np.zeros((16, 1), np.uint32))
+        count = jnp.asarray(np.full(16, 7, np.int32))
+        return fn, (i, j, band_codes, bytes_, lens, mask, count), {}
+
     # the brown-out tier's budgeted twin (engine kind="brownout"): same
     # factory, reduced top-k over a small candidate capacity — the shape
     # the service dispatches under pressure, so it is gated like the

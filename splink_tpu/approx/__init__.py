@@ -1,0 +1,36 @@
+"""Approximate blocking: device-native minhash-LSH + q-gram similarity tier.
+
+Every exact blocking rule is an equality conjunction over key codes — a
+record with a typo in each blocking key is unreachable by both training and
+serving (ROADMAP item 2). This package adds the recall tier:
+
+  * :mod:`.minhash` — per-record minhash signatures over q-gram sets
+    (reusing the exact gram codes of ``ops/qgram.py``) with LSH banding,
+    as jitted fixed-shape kernels;
+  * :mod:`.lsh` — LSH-bucket candidate generation through the SAME
+    segmented-sort / unit-decode machinery as ``blocking_device.py``, an
+    optional q-gram Jaccard verification pass, and progressive emission:
+    candidates ranked by estimated similarity and emitted best-first under
+    an explicit ``approx_pair_budget``.
+
+Opt in with ``approx_blocking: true`` in the settings; the tier composes
+with the exact rules (a pair any exact rule produced is never re-emitted)
+and also backs the serve fallback bucket path (``serve/index.py``): a
+query whose exact keys hit no bucket falls back to LSH-bucket candidates
+tagged ``approx=True`` instead of returning empty. See docs/blocking.md
+("Approximate tier").
+"""
+
+from .lsh import (  # noqa: F401
+    ApproxConfig,
+    approx_block_into,
+    approx_columns,
+    build_approx_plan,
+    generate_approx_candidates,
+)
+from .minhash import (  # noqa: F401
+    band_key_arrays,
+    factorise_band_codes,
+    hash_params,
+    make_minhash_fn,
+)

@@ -1,0 +1,209 @@
+"""Minhash signatures over q-gram sets, as jitted fixed-shape kernels.
+
+Each record's q-gram SET (over the approx columns, union across columns
+with a per-column salt so ``"ab"`` in *name* and ``"ab"`` in *city* are
+distinct set members) is sketched into ``bands * rows_per_band`` minhash
+values, and each band's rows fold into one uint32 band key. Two records
+share a band key for band ``b`` with probability ``J^rows_per_band`` where
+``J`` is their q-gram Jaccard similarity — so across ``bands`` independent
+bands the candidate probability is the classic S-curve
+``1 - (1 - J^r)^b`` (ShallowBlocker, arXiv:2312.15835, uses exactly this
+recall/cost dial for set-similarity blocking).
+
+Design constraints carried over from the rest of the codebase:
+
+  * exact gram identity — grams are the injective packed codes of
+    :func:`..ops.qgram._gram_codes` (no tokenisation, no gram-level hash
+    collisions; only the minhash itself is probabilistic);
+  * fixed shapes, pinned dtypes — records stream through power-of-two
+    bucketed chunks, all arithmetic is uint32/int32 (the forced-x64 audit
+    tier traces the identical jaxpr), so steady-state signature
+    computation never recompiles;
+  * determinism — hash parameters derive from a FIXED seed
+    (:data:`APPROX_SEED`); the same corpus yields the same band keys in
+    every process, which is what makes the candidate set reproducible and
+    the serve fallback index rebuildable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..blocking_device import _pow2  # the ONE pow2 shape-bucketing helper
+
+# Fixed seed for the universal-hash parameters: band keys must be
+# deterministic across processes (index build vs query side, run vs rerun).
+APPROX_SEED = 0x0A99B10C
+
+# Records per signature chunk (power-of-two bucketed): bounds the transient
+# (chunk, n_windows, n_hashes) uint32 intermediate to a few tens of MB.
+SIG_CHUNK = 1 << 13
+
+_U32 = np.uint32
+_NO_SIG = np.uint32(0xFFFFFFFF)
+
+
+def hash_params(n_hashes: int) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic per-hash multiply/add parameters: ``a`` odd (a bijection
+    over Z_2^32), ``b`` arbitrary. Seeded by :data:`APPROX_SEED` only."""
+    rng = np.random.default_rng(APPROX_SEED)
+    a = rng.integers(0, 1 << 32, size=n_hashes, dtype=np.uint64).astype(_U32) | _U32(1)
+    b = rng.integers(0, 1 << 32, size=n_hashes, dtype=np.uint64).astype(_U32)
+    return a, b
+
+
+def column_salts(n_cols: int) -> np.ndarray:
+    """Deterministic per-column salts: the same gram in different columns
+    must be a different set member (column identity is part of the key)."""
+    rng = np.random.default_rng(APPROX_SEED ^ 0x5A17)
+    return rng.integers(1, 1 << 32, size=n_cols, dtype=np.uint64).astype(_U32)
+
+
+@functools.lru_cache(maxsize=64)
+def make_minhash_fn(q: int, bands: int, rows_per_band: int, col_shapes: tuple):
+    """Jitted minhash-signature + LSH-band kernel for one static column
+    layout.
+
+    ``col_shapes`` is a tuple of ``(width, kind)`` per column (``kind`` is
+    ``"ascii"`` or ``"wide"`` — it fixes the bytes dtype the caller
+    uploads, and with it the bits-per-char of the gram packing).
+
+    fn(bytes_0, .., bytes_{C-1}, len_0, .., len_{C-1}, a, b, salts)
+        -> (band_keys (n, bands) uint32, has_sig (n,) bool)
+
+    Per record: every valid q-gram window of every column packs to its
+    exact integer code (:func:`..ops.qgram._gram_codes`), folds through a
+    salted uint32 mix, and each of the ``bands * rows_per_band`` hash
+    functions takes the min over ALL columns' grams; each band's
+    ``rows_per_band`` signature lanes then FNV-fold into the band key.
+    ``has_sig`` is False when no column contributes a single valid window
+    (null / shorter-than-q values) — such records are unreachable by the
+    approx tier, exactly as a null key never joins in exact blocking.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.qgram import _gram_codes
+
+    n_cols = len(col_shapes)
+    n_hashes = bands * rows_per_band
+
+    def record_sig(cols, lens, a, b, salts):
+        sig = jnp.full((n_hashes,), _NO_SIG, jnp.uint32)
+        has = jnp.zeros((), bool)
+        for c in range(n_cols):
+            words, valid = _gram_codes(cols[c], lens[c], q)
+            # fold the gram's code words into one salted uint32 value
+            h = jnp.broadcast_to(salts[c], (words.shape[0],))
+            for w in range(words.shape[1]):
+                h = (h ^ words[:, w]) * jnp.uint32(0x9E3779B1)
+                h = h ^ (h >> 15)
+            # per-hash-function value: multiply/add then a murmur-style
+            # finalisation (a is odd, so h -> h*a is a bijection and the
+            # min over grams is a faithful minhash of the gram set)
+            hk = h[:, None] * a[None, :] + b[None, :]
+            hk = hk ^ (hk >> 13)
+            hk = hk * jnp.uint32(0x85EBCA6B)
+            hk = hk ^ (hk >> 16)
+            hk = jnp.where(valid[:, None], hk, _NO_SIG)
+            sig = jnp.minimum(sig, jnp.min(hk, axis=0))
+            has = has | jnp.any(valid)
+        # band keys: FNV-fold the band's signature lanes + a band salt
+        bk = sig.reshape(bands, rows_per_band)
+        key = jnp.full((bands,), jnp.uint32(0x811C9DC5), jnp.uint32)
+        for r in range(rows_per_band):
+            key = (key ^ bk[:, r]) * jnp.uint32(0x01000193)
+        key = key ^ (key >> 16)
+        key = key ^ (
+            jnp.arange(bands, dtype=jnp.int32).astype(jnp.uint32)
+            * jnp.uint32(0x9E3779B1)
+        )
+        return key, has
+
+    @jax.jit
+    def fn(*args):
+        cols = args[:n_cols]
+        lens = args[n_cols : 2 * n_cols]
+        a, b, salts = args[2 * n_cols :]
+        return jax.vmap(
+            lambda *rec: record_sig(rec[:n_cols], rec[n_cols:], a, b, salts)
+        )(*cols, *lens)
+
+    return fn
+
+
+def band_key_arrays(
+    columns: list[tuple[np.ndarray, np.ndarray]],
+    q: int,
+    bands: int,
+    rows_per_band: int,
+    chunk: int = SIG_CHUNK,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host driver: LSH band keys for every record.
+
+    ``columns`` is a list of ``(bytes_, lengths)`` pairs — the encoded
+    fixed-width representation of each approx column (null rows carry
+    length 0). Records stream through power-of-two bucketed chunks of the
+    jitted kernel (at most two distinct shapes per call: the full chunk
+    and one padded tail), so repeated runs perform zero steady-state
+    recompiles.
+
+    Returns ``(keys (n, bands) uint32, has_sig (n,) bool)``.
+    """
+    import jax.numpy as jnp
+
+    if not columns:
+        raise ValueError("minhash needs at least one column")
+    n = len(columns[0][1])
+    col_shapes = tuple(
+        (int(b.shape[1]), "ascii" if b.dtype == np.uint8 else "wide")
+        for b, _ in columns
+    )
+    fn = make_minhash_fn(q, bands, rows_per_band, col_shapes)
+    a, b_par = hash_params(bands * rows_per_band)
+    salts = column_salts(len(columns))
+    a_dev = jnp.asarray(a)
+    b_dev = jnp.asarray(b_par)
+    s_dev = jnp.asarray(salts)
+    keys = np.empty((n, bands), _U32)
+    has = np.empty(n, bool)
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        m = _pow2(max(e - s, 1))
+        args = []
+        for bytes_, _ in columns:
+            buf = np.zeros((m, bytes_.shape[1]), bytes_.dtype)
+            buf[: e - s] = bytes_[s:e]
+            args.append(jnp.asarray(buf))
+        for _, lengths in columns:
+            lbuf = np.zeros(m, np.int32)
+            lbuf[: e - s] = lengths[s:e]
+            args.append(jnp.asarray(lbuf))
+        k, h = fn(*args, a_dev, b_dev, s_dev)
+        keys[s:e] = np.asarray(k)[: e - s]
+        has[s:e] = np.asarray(h)[: e - s]
+    return keys, has
+
+
+def factorise_band_codes(
+    keys: np.ndarray, has_sig: np.ndarray
+) -> tuple[np.ndarray, list[np.ndarray]]:
+    """Factorise per-band uint32 keys into dense int32 codes for the
+    segmented-sort join: ``(codes (bands, n) int32, uniq_keys per band)``.
+    Code ``-1`` marks records without a signature (never join). The unique
+    key arrays are ascending, so code order == ascending band-key order —
+    the property the serve bucket dictionaries rely on."""
+    n, bands = keys.shape
+    codes = np.full((bands, n), -1, np.int32)
+    uniqs: list[np.ndarray] = []
+    valid = np.flatnonzero(has_sig)
+    for b in range(bands):
+        if len(valid):
+            uniq, inv = np.unique(keys[valid, b], return_inverse=True)
+            codes[b, valid] = inv.astype(np.int32)
+        else:
+            uniq = np.zeros(0, _U32)
+        uniqs.append(uniq)
+    return codes, uniqs

@@ -749,7 +749,10 @@ def _eval_residual(table: EncodedTable, residual: str, i: np.ndarray, j: np.ndar
 
 @check_types
 def estimate_pair_upper_bound(
-    settings: dict, table: EncodedTable, n_left: int | None = None
+    settings: dict,
+    table: EncodedTable,
+    n_left: int | None = None,
+    include_approx: bool = True,
 ) -> int:
     """Cheap O(n) upper bound on the candidate-pair count: per-rule join
     sizes from key-group histograms, ignoring sequential-rule dedup and
@@ -765,9 +768,29 @@ def estimate_pair_upper_bound(
             assert n_left is not None
             return n_left * (n - n_left)
         return n * (n - 1) // 2
-    return sum(
+    bound = sum(
         _rule_group_stats(link_type, table, rule, n_left)[1] for rule in rules
     )
+    if include_approx and settings.get("approx_blocking"):
+        # the approximate tier appends at most its explicit pair budget —
+        # but only when it can actually run (a job with no sketchable
+        # string column skips the tier and contributes zero), and never
+        # more than the job's total possible pair count (the default 4M
+        # budget must not push a 500-row job past the resident gate or
+        # inflate its single gamma batch).
+        # ``include_approx=False`` gives the EXACT-rules-only bound, which
+        # is what the device-blocking auto gate sizes its jit-warmup
+        # decision on (the approx tier has its own kernels either way).
+        from .approx.lsh import DEFAULT_BUDGET, approx_columns
+
+        if approx_columns(settings, table):
+            budget = int(settings.get("approx_pair_budget") or DEFAULT_BUDGET)
+            if link_type == "link_only" and n_left is not None:
+                total = n_left * (n - n_left)
+            else:
+                total = n * (n - 1) // 2
+            bound += min(budget, total)
+    return bound
 
 
 def _rule_group_stats(
@@ -894,6 +917,12 @@ def block_using_rules(
     # full-size copies at the 10M-row configs).
     prior_rules: list[tuple[np.ndarray | None, str | None]] = []
     sink = _PairSink(settings.get("spill_dir"), idx_dtype)
+    # The approximate tier (splink_tpu/approx/: minhash-LSH band joins +
+    # q-gram verification + progressive pair budgeting) runs AFTER the
+    # exact rules when opted in — it composes through the same sequential
+    # dedup semantics (a pair any exact rule produced is never re-emitted)
+    # and appends its budget-ordered chunks to the same sink.
+    approx_on = bool(settings.get("approx_blocking"))
     try:
         # Device-native tier first (blocking_device.py): the sort-based
         # hash join runs as jitted kernels and streams budgeted chunks into
@@ -902,18 +931,29 @@ def block_using_rules(
         # or "auto"-mode jobs too small to pay the jit warmup — the host
         # path below stays the fallback AND the parity oracle.
         mode = settings.get("device_blocking", "auto")
+        exact_done = False
         if mode in ("auto", "on"):
             from .blocking_device import device_block_rules
 
             out = device_block_rules(
-                settings, table, n_left, sink, pair_consumer, mode
+                settings, table, n_left, sink, pair_consumer, mode,
+                finish=not approx_on,
             )
             if out is not None:
+                if not approx_on:
+                    return out
+                exact_done = True
+        if not exact_done:
+            out = _block_rules_into(
+                sink, rules, settings, table, link_type, all_rows, n_left,
+                prior_rules, pair_consumer, finish=not approx_on,
+            )
+            if not approx_on:
                 return out
-        return _block_rules_into(
-            sink, rules, settings, table, link_type, all_rows, n_left,
-            prior_rules, pair_consumer,
-        )
+        from .approx import approx_block_into
+
+        approx_block_into(settings, table, n_left, sink, pair_consumer)
+        return sink.finish()
     except BaseException:
         sink.abort()
         raise
@@ -921,8 +961,8 @@ def block_using_rules(
 
 def _block_rules_into(
     sink, rules, settings, table, link_type, all_rows, n_left, prior_rules,
-    pair_consumer=None,
-) -> PairIndex:
+    pair_consumer=None, finish: bool = True,
+) -> PairIndex | None:
     # Per-rule pairs are generated and CONSUMED in bounded chunks: the
     # residual/dedup filters are elementwise, so running them chunk-wise is
     # semantics-preserving and keeps peak host RAM at O(chunk) — the
@@ -1014,7 +1054,7 @@ def _block_rules_into(
         prior_rules.append((codes_l, codes_r, residual))
         logger.debug("blocking rule %r -> %d new pairs", rule, n_new)
 
-    return sink.finish()
+    return sink.finish() if finish else None
 
 
 def _rule_holds(

@@ -721,6 +721,7 @@ def device_block_rules(
     sink,
     pair_consumer=None,
     mode: str = "auto",
+    finish: bool = True,
 ):
     """The device tier of :func:`blocking.block_using_rules`: build the
     plan, stream chunked emission into the caller's sink, and return the
@@ -728,6 +729,9 @@ def device_block_rules(
     shape, or an "auto"-mode job too small to pay the jit warmup). A plan
     that FAILS to build never aborts the run (the host path is always
     there); an emission failure propagates — the sink already holds pairs.
+    ``finish=False`` leaves the sink open (and returns it unfinished) so
+    the caller can append a further tier — the approximate LSH tier rides
+    through this.
     """
     if mode == "auto":
         import jax
@@ -740,7 +744,12 @@ def device_block_rules(
             # C++ one ~0.75x — on the CPU backend auto keeps the host
             # path; 'on' still forces the device tier (tests, parity)
             return None
-        if estimate_pair_upper_bound(settings, table, n_left) < AUTO_MIN_PAIRS:
+        # exact-rules-only bound: this gate weighs the EXACT tier's jit
+        # warmup against its join size, so the approx tier's budget (which
+        # runs its own kernels regardless) must not inflate the decision
+        if estimate_pair_upper_bound(
+            settings, table, n_left, include_approx=False
+        ) < AUTO_MIN_PAIRS:
             return None
     try:
         plan = build_device_plan(settings, table, n_left)
@@ -766,7 +775,7 @@ def device_block_rules(
                 i.astype(sink.idx_dtype, copy=False),
                 j.astype(sink.idx_dtype, copy=False),
             )
-    return sink.finish()
+    return sink.finish() if finish else sink
 
 
 # --------------------------------------------------------------------------

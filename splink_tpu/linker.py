@@ -579,6 +579,23 @@ class Splink:
         mode = self.settings.get("device_pair_generation", "auto")
         if mode == "off" or not self._pattern_capable():
             return None
+        if self.settings.get("approx_blocking"):
+            # the virtual pair index enumerates EXACT-rule pairs only; the
+            # approximate LSH tier emits through materialised blocking, so
+            # taking the virtual path here would silently drop every
+            # approx pair — the recall feature the setting opts into.
+            # With no sketchable string column the tier is a no-op and
+            # the virtual path loses nothing (same gate as
+            # estimate_pair_upper_bound).
+            from .approx.lsh import approx_columns
+
+            if approx_columns(self.settings, self._ensure_encoded()):
+                logger.info(
+                    "device pair generation disabled: approx_blocking "
+                    "needs materialised blocking (the virtual pair index "
+                    "has no approximate tier)"
+                )
+                return None
         from .pairgen import build_virtual_plan
 
         table = self._ensure_encoded()

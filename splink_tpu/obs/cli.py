@@ -168,6 +168,38 @@ def summarize_events(events: list[dict]) -> str:
                     f"pairs in {rr.get('chunks')} chunk(s)"
                 )
 
+    # ---- approximate-blocking telemetry ----------------------------------
+    approx = [e for e in events if e.get("type") == "blocking_approx"]
+    if approx:
+        lines.append("")
+        lines.append(f"approx blocking: {len(approx)} run(s)")
+        for ev in approx:
+            # torn/old records may miss fields: render 0, never crash
+            lines.append(
+                f"  bands={ev.get('bands') or 0}x{ev.get('rows_per_band') or 0} "
+                f"q={ev.get('q') or 0} candidates={ev.get('candidates') or 0:,} "
+                f"survivors={ev.get('survivors') or 0:,}"
+                + (" (verified)" if ev.get("verified") else "")
+                + f" emitted={ev.get('emitted') or 0:,}"
+                f" budget={ev.get('budget') or 0:,}"
+                f" fill={ev.get('budget_fill') or 0}"
+            )
+            extra = []
+            if ev.get("exact_overlap_removed"):
+                extra.append(
+                    f"exact-tier overlap removed "
+                    f"{ev.get('exact_overlap_removed'):,}"
+                )
+            if ev.get("oversize_buckets_dropped"):
+                extra.append(
+                    f"oversize buckets dropped "
+                    f"{ev.get('oversize_buckets_dropped')}"
+                )
+            if ev.get("cols"):
+                extra.append("cols " + ",".join(ev["cols"]))
+            if extra:
+                lines.append("    " + "; ".join(extra))
+
     # ---- resilience events ----------------------------------------------
     # serve-tier events (health transitions, breaker state changes, index
     # hot-swaps, worker restarts, brown-out boundaries) belong in the same

@@ -421,7 +421,10 @@ class QueryEngine:
         import jax
 
         index = self.index
-        n_rules = len(index.rules)
+        # the gather menu covers the exact rules AND the approx LSH bands
+        # (the fallback bucket path rides the same compiled programs, so a
+        # fallback batch is recompile-free and brown-out compatible)
+        n_rules = len(index.gather_units)
         encode = make_encode_query_fn()
         layout = index.layout
         cols = tuple(index.settings["comparison_columns"])
@@ -477,14 +480,15 @@ class QueryEngine:
         S = jax.ShapeDtypeStruct
         dt = index.float_dtype
         i32, u32 = np.int32, np.uint32
+        units = index.gather_units
         return (
             S((q_pad, index.n_lanes), u32),
-            S((len(index.rules), q_pad), i32),
+            S((len(units), q_pad), i32),
             S((), i32),
-            tuple(S(r.starts.shape, i32) for r in index.rules),
-            tuple(S(r.sizes.shape, i32) for r in index.rules),
-            tuple(S(r.rows_sorted.shape, i32) for r in index.rules),
-            tuple(S(r.row_bucket.shape, i32) for r in index.rules),
+            tuple(S(r.starts.shape, i32) for r in units),
+            tuple(S(r.sizes.shape, i32) for r in units),
+            tuple(S(r.rows_sorted.shape, i32) for r in units),
+            tuple(S(r.row_bucket.shape, i32) for r in units),
             S(index.packed.shape, u32),
             _params_structs(index.m.shape, dt),
         )
@@ -536,6 +540,9 @@ class QueryEngine:
             "index_fingerprint": index.content_fingerprint(),
             "dtype": index.dtype,
             "n_rules": len(index.rules),
+            "n_approx_bands": (
+                0 if index.approx is None else index.approx.bands
+            ),
             "top_k": self.top_k,
             "brownout_top_k": self.brownout_top_k,
             "query_buckets": list(self.policy.query_buckets),
@@ -612,11 +619,20 @@ class QueryEngine:
         """Host-side query encode (see LinkageIndex.encode_queries)."""
         return self.index.encode_queries(df)
 
-    def query_arrays(self, df, *, degraded: bool = False, profile=None):
+    def query_arrays(self, df, *, degraded: bool = False, profile=None,
+                     approx_out: list | None = None):
         """Score a query DataFrame; returns
         ``(top_p, top_rows, top_valid, n_candidates)`` numpy arrays of
         shape (n, k) / (n,). ``top_rows`` are reference ROW indices; map
         through ``index.unique_id`` for ids (``query`` does).
+
+        ``approx_out``, when a list, receives one (n,) bool array marking
+        the queries served through the approx LSH FALLBACK bucket path
+        (their exact keys hit no bucket; candidates come from minhash band
+        buckets and results should surface as ``approx=True``). The scores
+        themselves are bit-identical to offline scoring of the same
+        (query, candidate) pairs — the fallback changes WHICH candidates
+        are gathered, never how a pair is scored.
 
         ``degraded=True`` runs the brown-out program: top-k
         ``brownout_top_k`` over candidates truncated to the cheapest
@@ -635,6 +651,12 @@ class QueryEngine:
                     "brown-out tier is disabled (serve_brownout_top_k=0)"
                 )
             batch = self.encode(df)
+            if approx_out is not None:
+                approx_out.append(
+                    batch.approx_used
+                    if batch.approx_used is not None
+                    else np.zeros(batch.n, bool)
+                )
             out_p = np.full((batch.n, k), -1.0, self.index.float_dtype)
             out_rows = np.zeros((batch.n, k), np.int32)
             out_valid = np.zeros((batch.n, k), bool)
@@ -696,7 +718,7 @@ class QueryEngine:
         # encode_query kernel zeroes padding rows on device
         packed_pad = np.empty((q_pad, index.n_lanes), np.uint32)
         packed_pad[:n] = batch.packed[start:stop]
-        qb_pad = np.empty((len(index.rules), q_pad), np.int32)
+        qb_pad = np.empty((len(index.gather_units), q_pad), np.int32)
         qb_pad[:, :n] = qb
         dev = index.device_state()
         top_p, top_rows, top_valid, n_cand = kernel(
@@ -745,23 +767,31 @@ class QueryEngine:
     def query(self, df):
         """Score a query DataFrame; returns a tidy DataFrame with one row
         per (query, match): query id, matched reference id, rank, match
-        probability and the query's candidate count."""
+        probability, the query's candidate count and — when the index
+        carries the approx tier — an ``approx`` flag marking matches found
+        through the LSH fallback bucket path (the query's exact keys hit
+        no bucket)."""
         import pandas as pd
 
-        top_p, top_rows, top_valid, n_cand = self.query_arrays(df)
+        approx_out: list = []
+        top_p, top_rows, top_valid, n_cand = self.query_arrays(
+            df, approx_out=approx_out
+        )
+        approx_used = approx_out[0]
         ref_uid = self.index.unique_id
         q_idx, rank = np.nonzero(top_valid)
         uid_col = self.index.settings["unique_id_column_name"]
         query_uid = self._query_uids(df)
-        return pd.DataFrame(
-            {
-                f"{uid_col}_q": query_uid[q_idx],
-                f"{uid_col}_m": ref_uid[top_rows[q_idx, rank]],
-                "rank": rank.astype(np.int64),
-                "match_probability": top_p[q_idx, rank],
-                "n_candidates": n_cand[q_idx],
-            }
-        )
+        out = {
+            f"{uid_col}_q": query_uid[q_idx],
+            f"{uid_col}_m": ref_uid[top_rows[q_idx, rank]],
+            "rank": rank.astype(np.int64),
+            "match_probability": top_p[q_idx, rank],
+            "n_candidates": n_cand[q_idx],
+        }
+        if self.index.approx is not None:
+            out["approx"] = approx_used[q_idx]
+        return pd.DataFrame(out)
 
     def _query_uids(self, df) -> np.ndarray:
         uid_col = self.index.settings["unique_id_column_name"]
@@ -850,7 +880,7 @@ class QueryEngine:
                     return
                 self._aot_exec_probed = True
             packed = np.zeros((q_pad, index.n_lanes), np.uint32)
-            qb = np.full((len(index.rules), q_pad), -1, np.int32)
+            qb = np.full((len(index.gather_units), q_pad), -1, np.int32)
             out = kernel(
                 jnp.asarray(packed),
                 jnp.asarray(qb),

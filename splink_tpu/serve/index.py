@@ -140,6 +140,47 @@ def _canonical_key_values(table: EncodedTable, col: str) -> np.ndarray:
     return out
 
 
+def _encode_value_chars(
+    bytes_: np.ndarray, lengths: np.ndarray, row: int, value: str,
+    width: int, kind: str,
+) -> None:
+    """Write one query value's chars into ``row`` of (bytes_, lengths)
+    with the reference byte semantics — values truncate at the reference
+    width; a non-ASCII char in an ascii column becomes 0xFF, which
+    definitionally matches no reference byte. The ONE definition behind
+    ``LinkageIndex._pin_string_column`` and ``_encode_query_bytes``: the
+    serve-fallback parity contract needs query-side gram sets bit-equal
+    to the reference encoding, so the rule must not fork."""
+    chars = value[:width]
+    lengths[row] = len(chars)
+    for j, ch in enumerate(chars):
+        cp = ord(ch)
+        if kind == "ascii":
+            bytes_[row, j] = cp if cp < 128 else 0xFF
+        else:
+            bytes_[row, j] = cp
+
+
+def _encode_query_bytes(
+    sc: EncodedStringColumn, width: int, kind: str, rows: np.ndarray
+):
+    """(bytes, lengths) for the given ``rows`` of a query string column,
+    pinned to the REFERENCE width and ascii/wide kind (the byte semantics
+    of ``_encode_value_chars``), without the vocabulary work the minhash
+    kernel doesn't need. Null rows keep length 0 (no grams). Encoding
+    only the requested rows keeps the serve fallback's cost proportional
+    to the MISSED queries, not the whole batch."""
+    n = len(rows)
+    dt = np.uint8 if kind == "ascii" else np.uint32
+    bytes_ = np.zeros((n, width), dt)
+    lengths = np.zeros(n, np.int32)
+    for k, i in enumerate(rows):
+        if sc.null_mask[i]:
+            continue
+        _encode_value_chars(bytes_, lengths, k, str(sc.values[i]), width, kind)
+    return bytes_, lengths
+
+
 def _rule_key_cols(rule: str) -> list[str]:
     """The symmetric equality key columns of one blocking rule, or raise
     for shapes serving cannot index (residuals, cross-column keys, keyless
@@ -193,13 +234,50 @@ class ServeRule:
 
 
 @dataclass
+class ApproxBand:
+    """One LSH band's frozen bucket index — the same CSR quartet as a
+    :class:`ServeRule`, so the engine's candidate-gather kernel consumes a
+    band exactly like a blocking rule (the cross-band dedup IS the
+    sequential-rule dedup mask)."""
+
+    rows_sorted: np.ndarray  # (n_valid,) int32
+    starts: np.ndarray  # (n_buckets,) int32
+    sizes: np.ndarray  # (n_buckets,) int32
+    row_bucket: np.ndarray  # (n_rows,) int32; -1 = no signature
+    bucket_of: dict = field(default_factory=dict)  # int band key -> bucket
+
+
+@dataclass
+class ApproxServe:
+    """The serve fallback bucket path (docs/blocking.md#approximate-tier):
+    minhash-LSH band buckets over the approx columns. A query whose EXACT
+    keys hit no bucket resolves its band keys through ``bucket_of`` and is
+    scored against the union of its band buckets instead of returning
+    empty; results are tagged ``approx=True``."""
+
+    cols: list[str]
+    col_meta: dict  # name -> {"width": int, "kind": "ascii"|"wide"}
+    q: int
+    bands: int
+    rows_per_band: int
+    band_index: list[ApproxBand] = field(default_factory=list)
+
+
+@dataclass
 class QueryBatch:
-    """Host-side encoded query batch, ready for the engine."""
+    """Host-side encoded query batch, ready for the engine.
+
+    ``qbuckets`` covers the engine's FULL gather menu: one row per exact
+    blocking rule followed by one row per approx LSH band (all -1 when the
+    index carries no approx tier or the query resolved exactly).
+    ``approx_used`` marks queries served through the fallback bucket
+    path."""
 
     packed: np.ndarray  # (n, n_lanes) uint32, same layout as the index
-    qbuckets: np.ndarray  # (n_rules, n) int32; -1 = no candidates
+    qbuckets: np.ndarray  # (n_gather, n) int32; -1 = no candidates
     n: int
     unique_id: np.ndarray  # (n,) query ids (positional when absent)
+    approx_used: np.ndarray | None = None  # (n,) bool, None = no approx tier
 
 
 class LinkageIndex:
@@ -222,6 +300,7 @@ class LinkageIndex:
         unique_id: np.ndarray,
         tf_tables: dict,
         state_hash: str,
+        approx: ApproxServe | None = None,
     ):
         self.settings = settings
         self.dtype = dtype  # "float32" | "float64"
@@ -237,6 +316,7 @@ class LinkageIndex:
         self.unique_id = unique_id
         self.tf_tables = tf_tables  # name -> (n_tokens,) int64 counts
         self.state_hash = state_hash
+        self.approx = approx  # LSH fallback bucket path (None = exact only)
         self._device = None  # memoised device-resident arrays
         self._vocab_maps: dict | None = None
         self._content_fp: str | None = None
@@ -257,6 +337,17 @@ class LinkageIndex:
     def float_dtype(self):
         return np.float64 if self.dtype == "float64" else np.float32
 
+    @property
+    def gather_units(self) -> list:
+        """The engine's full candidate-gather menu: the exact blocking
+        rules followed by the approx LSH bands (each entry carries the
+        same rows_sorted/starts/sizes/row_bucket CSR quartet, so the
+        gather kernel is agnostic to which tier an entry came from)."""
+        units = list(self.rules)
+        if self.approx is not None:
+            units.extend(self.approx.band_index)
+        return units
+
     def content_fingerprint(self) -> str:
         """sha256 over every array a serve executable's answers depend on
         (packed matrix, per-rule CSR, trained parameters, dtype, settings
@@ -272,6 +363,19 @@ class LinkageIndex:
             for r in self.rules:
                 for a in (r.rows_sorted, r.starts, r.sizes, r.row_bucket):
                     h.update(np.ascontiguousarray(a).tobytes())
+            if self.approx is not None:
+                # approx config + band CSRs change the compiled gather
+                # menu, so they are part of the executable-binding
+                # identity; an exact-only index hashes exactly as before
+                ap = self.approx
+                h.update(
+                    f"approx:{ap.q}:{ap.bands}:{ap.rows_per_band}:"
+                    f"{','.join(ap.cols)}".encode()
+                )
+                for band in ap.band_index:
+                    for a in (band.rows_sorted, band.starts, band.sizes,
+                              band.row_bucket):
+                        h.update(np.ascontiguousarray(a).tobytes())
             h.update(np.float64(self.lam).tobytes())
             h.update(np.ascontiguousarray(self.m, np.float64).tobytes())
             h.update(np.ascontiguousarray(self.u, np.float64).tobytes())
@@ -280,12 +384,12 @@ class LinkageIndex:
 
     def candidate_counts(self, qbuckets: np.ndarray) -> np.ndarray:
         """(n,) int64 upper-bound candidate count per query (duplicates
-        across rules included — the capacity the engine pads to)."""
+        across rules/bands included — the capacity the engine pads to)."""
         total = np.zeros(qbuckets.shape[1], np.int64)
-        for r, rule in enumerate(self.rules):
+        for r, unit in enumerate(self.gather_units):
             qb = qbuckets[r]
             has = qb >= 0
-            total[has] += rule.sizes[qb[has]]
+            total[has] += unit.sizes[qb[has]]
         return total
 
     # ------------------------------------------------------------------
@@ -302,13 +406,14 @@ class LinkageIndex:
             from ..models.fellegi_sunter import FSParams
 
             dt = self.float_dtype
+            units = self.gather_units
             self._device = {
                 "packed": jnp.asarray(self.packed),
-                "starts": tuple(jnp.asarray(r.starts) for r in self.rules),
-                "sizes": tuple(jnp.asarray(r.sizes) for r in self.rules),
-                "rows": tuple(jnp.asarray(r.rows_sorted) for r in self.rules),
+                "starts": tuple(jnp.asarray(r.starts) for r in units),
+                "sizes": tuple(jnp.asarray(r.sizes) for r in units),
+                "rows": tuple(jnp.asarray(r.rows_sorted) for r in units),
                 "row_bucket": tuple(
-                    jnp.asarray(r.row_bucket) for r in self.rules
+                    jnp.asarray(r.row_bucket) for r in units
                 ),
                 "params": FSParams(
                     lam=jnp.asarray(np.asarray(self.lam, dt)),
@@ -393,7 +498,9 @@ class LinkageIndex:
                 f"index holds {self.n_lanes} — the settings or encoding "
                 "drifted from the artifact"
             )
-        qbuckets = np.full((len(self.rules), len(df)), -1, np.int32)
+        n_rules = len(self.rules)
+        n_gather = len(self.gather_units)
+        qbuckets = np.full((n_gather, len(df)), -1, np.int32)
         for r, rule in enumerate(self.rules):
             tokens = [
                 _canonical_key_values(qtable, col) for col in rule.key_cols
@@ -402,12 +509,58 @@ class LinkageIndex:
                 qbuckets[r, q] = rule.query_bucket(
                     [t[q] for t in tokens]
                 )
+        approx_used = None
+        if self.approx is not None:
+            # fallback bucket path: queries whose EXACT keys all missed
+            # resolve their LSH band keys instead of returning empty.
+            # Signatures are computed for the MISSED rows only — a batch
+            # with one garbled query must not pay the per-character
+            # re-encode + minhash kernel for every clean row in it.
+            missed = ~(qbuckets[:n_rules] >= 0).any(axis=0)
+            approx_used = np.zeros(len(df), bool)
+            if missed.any():
+                rows = np.flatnonzero(missed)
+                keys, has_sig = self._query_band_keys(qtable, rows)
+                for b, band in enumerate(self.approx.band_index):
+                    row = qbuckets[n_rules + b]
+                    for k, q in enumerate(rows):
+                        if has_sig[k]:
+                            row[q] = band.bucket_of.get(
+                                int(keys[k, b]), -1
+                            )
+                approx_used = missed & (qbuckets[n_rules:] >= 0).any(axis=0)
         return QueryBatch(
             packed=packed_q,
             qbuckets=qbuckets,
             n=len(df),
             unique_id=np.asarray(pd.Series(df[uid_col]).to_numpy()),
+            approx_used=approx_used,
         )
+
+    def _query_band_keys(self, qtable: EncodedTable, rows: np.ndarray):
+        """(keys (len(rows), bands) uint32, has_sig (len(rows),) bool) for
+        the given query rows: every approx column re-encoded at the
+        REFERENCE width/kind (the jitted minhash kernel is
+        shape-specialised per column layout, so pinning keeps query-side
+        signatures on the same compiled kernel as the index build — and
+        gram sets identical for shared values)."""
+        from ..approx.minhash import band_key_arrays
+
+        ap = self.approx
+        columns = []
+        for name in ap.cols:
+            sc = qtable.strings.get(name)
+            if sc is None:
+                raise ValueError(
+                    f"query data is missing approx column {name!r}"
+                )
+            meta = ap.col_meta[name]
+            columns.append(
+                _encode_query_bytes(
+                    sc, int(meta["width"]), meta["kind"], rows
+                )
+            )
+        return band_key_arrays(columns, ap.q, ap.bands, ap.rows_per_band)
 
     def _pin_string_column(
         self, sc: EncodedStringColumn, meta: dict
@@ -437,17 +590,7 @@ class LinkageIndex:
                 if tid is None:
                     tid = fresh[v] = n_ref + len(fresh)
             token_ids[i] = tid
-            chars = v[:width]
-            lengths[i] = len(chars)
-            for j, ch in enumerate(chars):
-                cp = ord(ch)
-                if kind == "ascii":
-                    # a non-ASCII query char in an ASCII-only reference
-                    # column definitionally matches no reference char;
-                    # 0xFF never appears in ASCII reference bytes
-                    bytes_[i, j] = cp if cp < 128 else 0xFF
-                else:
-                    bytes_[i, j] = cp
+            _encode_value_chars(bytes_, lengths, i, v, width, kind)
         return EncodedStringColumn(
             bytes_=bytes_,
             lengths=lengths,
@@ -489,6 +632,12 @@ class LinkageIndex:
             arrays[f"rule{r}_starts"] = rule.starts
             arrays[f"rule{r}_sizes"] = rule.sizes
             arrays[f"rule{r}_row_bucket"] = rule.row_bucket
+        if self.approx is not None:
+            for b, band in enumerate(self.approx.band_index):
+                arrays[f"approx{b}_rows"] = band.rows_sorted
+                arrays[f"approx{b}_starts"] = band.starts
+                arrays[f"approx{b}_sizes"] = band.sizes
+                arrays[f"approx{b}_row_bucket"] = band.row_bucket
         for name, counts in self.tf_tables.items():
             arrays[f"tf_{name}"] = counts
         if self.unique_id.dtype != object:
@@ -522,6 +671,22 @@ class LinkageIndex:
                 for r in self.rules
             ],
             "tf_columns": sorted(self.tf_tables),
+            "approx": (
+                None
+                if self.approx is None
+                else {
+                    "cols": list(self.approx.cols),
+                    "col_meta": self.approx.col_meta,
+                    "q": self.approx.q,
+                    "bands": self.approx.bands,
+                    "rows_per_band": self.approx.rows_per_band,
+                    # JSON keys must be strings; band keys are uint32 ints
+                    "bucket_of": [
+                        {str(k): v for k, v in band.bucket_of.items()}
+                        for band in self.approx.band_index
+                    ],
+                }
+            ),
             "n_rows": self.n_rows,
             "unique_id_json": (
                 self.unique_id.tolist()
@@ -612,6 +777,26 @@ def load_index(directory: str | os.PathLike) -> LinkageIndex:
     else:
         unique_id = npz["unique_id"]
     tf_tables = {name: npz[f"tf_{name}"] for name in meta.get("tf_columns", [])}
+    approx = None
+    am = meta.get("approx")
+    if am is not None:
+        approx = ApproxServe(
+            cols=list(am["cols"]),
+            col_meta=dict(am["col_meta"]),
+            q=int(am["q"]),
+            bands=int(am["bands"]),
+            rows_per_band=int(am["rows_per_band"]),
+            band_index=[
+                ApproxBand(
+                    rows_sorted=npz[f"approx{b}_rows"],
+                    starts=npz[f"approx{b}_starts"],
+                    sizes=npz[f"approx{b}_sizes"],
+                    row_bucket=npz[f"approx{b}_row_bucket"],
+                    bucket_of={int(k): v for k, v in bo.items()},
+                )
+                for b, bo in enumerate(am["bucket_of"])
+            ],
+        )
     return LinkageIndex(
         settings=settings,
         dtype=meta["dtype"],
@@ -627,6 +812,7 @@ def load_index(directory: str | os.PathLike) -> LinkageIndex:
         unique_id=unique_id,
         tf_tables=tf_tables,
         state_hash=meta["state_hash"],
+        approx=approx,
     )._rebuild_layout()
 
 
@@ -709,6 +895,10 @@ def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
             for rule in rules_text
         ]
 
+        approx = None
+        if settings.get("approx_blocking"):
+            approx = _build_approx_serve(table, settings)
+
         from ..term_frequencies import term_frequency_columns
 
         tf_tables = {}
@@ -749,6 +939,7 @@ def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
             unique_id=np.asarray(table.unique_id),
             tf_tables=tf_tables,
             state_hash=state_hash,
+            approx=approx,
         )
     finally:
         if clear_caches:
@@ -827,6 +1018,79 @@ def _build_serve_rule(
         sizes=sizes.astype(np.int32),
         row_bucket=row_bucket,
         bucket_of=bucket_of,
+    )
+
+
+def _build_approx_serve(table: EncodedTable, settings: dict):
+    """The index's LSH fallback tier: band-key bucket CSRs over the approx
+    columns (splink_tpu/approx/minhash.py band keys — the SAME fixed-seed
+    kernel the query side runs, so reference and query signatures agree for
+    shared values). Returns None when no approx column exists."""
+    # MAX_BUCKET_ROWS is the ONE degenerate-bucket contract, shared with
+    # the offline tier: a band bucket wider than it is a near-constant
+    # signature, so it stays in the CSR (cross-band dedup needs
+    # row_bucket) but is never resolvable from the query side — serving
+    # it would truncate at the candidate-bucket menu anyway while blowing
+    # the padded capacity for every fallback batch.
+    from ..approx.lsh import MAX_BUCKET_ROWS, ApproxConfig, compute_band_codes
+
+    cfg = ApproxConfig.from_settings(settings, table)
+    if cfg is None:
+        return None
+    band_codes, uniq_keys = compute_band_codes(table, cfg)
+    col_meta = {}
+    for name in cfg.cols:
+        sc = table.strings[name]
+        col_meta[name] = {
+            "width": int(sc.width),
+            "kind": "ascii" if sc.bytes_.dtype == np.uint8 else "wide",
+        }
+    n = table.n_rows
+    bands = []
+    for b in range(cfg.bands):
+        codes = band_codes[b]
+        rows = np.flatnonzero(codes >= 0).astype(np.int32)
+        rows_sorted, uniq_codes, starts, sizes = _sort_groups(
+            codes.astype(np.int64), rows
+        )
+        if len(uniq_codes) == 0:
+            bands.append(
+                ApproxBand(
+                    rows_sorted=np.zeros(1, np.int32),
+                    starts=np.zeros(1, np.int32),
+                    sizes=np.zeros(1, np.int32),
+                    row_bucket=np.full(n, -1, np.int32),
+                )
+            )
+            continue
+        row_bucket = np.full(n, -1, np.int32)
+        row_bucket[rows_sorted] = np.repeat(
+            np.arange(len(uniq_codes), dtype=np.int32), sizes
+        )
+        # code order == ascending band-key order (factorise_band_codes), so
+        # bucket k's key is uniq_keys[b][uniq_codes[k]]
+        keys_of_bucket = uniq_keys[b][uniq_codes.astype(np.int64)]
+        bucket_of = {
+            int(keys_of_bucket[k]): int(k)
+            for k in range(len(uniq_codes))
+            if sizes[k] <= MAX_BUCKET_ROWS
+        }
+        bands.append(
+            ApproxBand(
+                rows_sorted=rows_sorted.astype(np.int32),
+                starts=starts.astype(np.int32),
+                sizes=sizes.astype(np.int32),
+                row_bucket=row_bucket,
+                bucket_of=bucket_of,
+            )
+        )
+    return ApproxServe(
+        cols=list(cfg.cols),
+        col_meta=col_meta,
+        q=cfg.q,
+        bands=cfg.bands,
+        rows_per_band=cfg.rows_per_band,
+        band_index=bands,
     )
 
 

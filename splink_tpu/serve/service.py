@@ -106,6 +106,9 @@ class QueryResult:
     shed: bool = False
     latency_ms: float | None = None
     degraded: bool = False
+    # the query's exact blocking keys hit no bucket and the matches came
+    # from the approx LSH fallback bucket path (docs/blocking.md)
+    approx: bool = False
     reason: str | None = None
 
 
@@ -761,9 +764,11 @@ class LinkageService:
 
     def _score(self, df, degraded: bool = False,
                profile=None) -> list[QueryResult]:
+        approx_out: list = []
         top_p, top_rows, top_valid, n_cand = self.engine.query_arrays(
-            df, degraded=degraded, profile=profile
+            df, degraded=degraded, profile=profile, approx_out=approx_out
         )
+        approx_used = approx_out[0]
         uids = self.engine.index.unique_id
         out = []
         for i in range(len(df)):
@@ -773,7 +778,11 @@ class LinkageService:
                 if top_valid[i, r]
             ]
             out.append(
-                QueryResult(matches=matches, n_candidates=int(n_cand[i]))
+                QueryResult(
+                    matches=matches,
+                    n_candidates=int(n_cand[i]),
+                    approx=bool(approx_used[i]),
+                )
             )
         return out
 

@@ -55,6 +55,12 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "max_resident_pairs",
     "device_blocking",
     "blocking_chunk_pairs",
+    "approx_blocking",
+    "approx_q",
+    "approx_bands",
+    "approx_rows_per_band",
+    "approx_threshold",
+    "approx_pair_budget",
     "spill_dir",
     "profile_dir",
     "telemetry_dir",

@@ -1,0 +1,667 @@
+"""Approximate blocking (splink_tpu/approx/): the dirty-data recall tier.
+
+The contract under test (ISSUE 10 / docs/blocking.md#approximate-tier):
+
+  * on a corpus whose EVERY blocking key carries a seeded typo, the exact
+    tier's recall of the true matches collapses (<5%) while the approx
+    tier recovers >=95% of them within ``approx_pair_budget``;
+  * candidate sets are deterministic across runs (fixed-seed minhash);
+  * the budget is a hard cap and emission is BEST-FIRST (progressive
+    blocking);
+  * the tier composes with the exact rules through the sequential-dedup
+    semantics: no pair an exact rule produced is re-emitted, no pair is
+    emitted twice across bands;
+  * the serve fallback bucket path: a query whose exact keys hit no
+    bucket returns approx-tagged candidates whose scores are BIT-identical
+    to offline scoring of the same pairs, with zero steady-state
+    recompiles;
+  * the new kernels audit clean in all three analysis layers AND the
+    registrations are falsifiable (broken twins trip TA-DTYPE / SA-COLL).
+"""
+
+import copy
+import warnings
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu.approx.lsh import (
+    ApproxConfig,
+    approx_columns,
+    generate_approx_candidates,
+)
+from splink_tpu.blocking import block_using_rules
+from splink_tpu.data import encode_table
+from splink_tpu.obs.events import register_ambient, unregister_ambient
+from splink_tpu.settings import complete_settings_dict
+
+N_BASE = 80
+
+
+def _settings(**over):
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name"},
+            {"col_name": "surname"},
+        ],
+        "blocking_rules": [
+            "l.first_name = r.first_name",
+            "l.surname = r.surname",
+        ],
+        "approx_blocking": True,
+    }
+    s.update(over)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return complete_settings_dict(s)
+
+
+def _corrupt(value: str, rng) -> str:
+    """Deterministic single-character corruption: one character becomes
+    '#', which appears in no clean value — a corrupted key can never
+    accidentally equal another record's clean key."""
+    k = int(rng.integers(0, len(value)))
+    return value[:k] + "#" + value[k + 1 :]
+
+
+def typo_corpus(n=N_BASE, seed=7):
+    """n base records with near-unique keys + n twins with EVERY blocking
+    key corrupted. True matches are (k, k + n)."""
+    rng = np.random.default_rng(seed)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    base = pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [
+                f"{rng.choice(firsts)}{k:02d}" for k in range(n)
+            ],
+            "surname": [f"{rng.choice(lasts)}{k:02d}" for k in range(n)],
+        }
+    )
+    twins = base.copy()
+    twins["unique_id"] = twins["unique_id"] + n
+    crng = np.random.default_rng(seed + 1)
+    twins["first_name"] = [_corrupt(v, crng) for v in twins["first_name"]]
+    twins["surname"] = [_corrupt(v, crng) for v in twins["surname"]]
+    df = pd.concat([base, twins], ignore_index=True)
+    true = {(k, k + n) for k in range(n)}
+    return df, true
+
+
+def _pair_set(pair_index):
+    return set(zip(pair_index.idx_l.tolist(), pair_index.idx_r.tolist()))
+
+
+class _Capture:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, type, **fields):
+        self.events.append({"type": type, **fields})
+
+    def of(self, type):
+        return [e for e in self.events if e["type"] == type]
+
+
+@pytest.fixture()
+def capture():
+    cap = _Capture()
+    register_ambient(cap)
+    yield cap
+    unregister_ambient(cap)
+
+
+# ----------------------------------------------------------------------
+# Recall / precision harness on the typo corpus
+# ----------------------------------------------------------------------
+
+
+def test_exact_tier_collapses_approx_recovers():
+    """The acceptance criterion: corrupted keys make the exact tier blind
+    (<5% recall) while the approx tier recovers >=95% of the true matches
+    within its pair budget."""
+    df, true = typo_corpus()
+    s_exact = _settings(approx_blocking=False)
+    table = encode_table(df, s_exact)
+    exact = _pair_set(block_using_rules(s_exact, table))
+    exact_recall = len(true & exact) / len(true)
+    assert exact_recall < 0.05, f"exact recall {exact_recall} should collapse"
+
+    s = _settings(approx_threshold=0.2, approx_pair_budget=4 * N_BASE)
+    table = encode_table(df, s)
+    pairs = _pair_set(block_using_rules(s, table))
+    recall = len(true & pairs) / len(true)
+    assert recall >= 0.95, f"approx recall {recall} below the 95% bar"
+    # precision sanity: the budget holds and the exact pairs still ride
+    assert exact <= pairs
+
+
+def test_candidate_set_deterministic_across_runs():
+    df, _ = typo_corpus()
+    s = _settings(approx_threshold=0.2)
+    p1 = block_using_rules(s, encode_table(df, s))
+    p2 = block_using_rules(s, encode_table(df, s))
+    assert np.array_equal(p1.idx_l, p2.idx_l)
+    assert np.array_equal(p1.idx_r, p2.idx_r)
+
+
+def test_budget_cap_and_best_first():
+    """Budget is a hard cap, and the emitted pairs are the TOP-ranked
+    candidates: shrinking the budget yields a prefix of the larger
+    budget's emission order."""
+    df, true = typo_corpus()
+    s_exact = _settings(approx_blocking=False)
+    table = encode_table(df, s_exact)
+    exact_n = block_using_rules(s_exact, table).n_pairs
+
+    s_big = _settings(approx_threshold=0.0, approx_pair_budget=10_000)
+    big = block_using_rules(s_big, encode_table(df, s_big))
+    approx_big = list(
+        zip(big.idx_l[exact_n:].tolist(), big.idx_r[exact_n:].tolist())
+    )
+    assert len(approx_big) <= 10_000
+
+    s_small = _settings(approx_threshold=0.0, approx_pair_budget=40)
+    small = block_using_rules(s_small, encode_table(df, s_small))
+    approx_small = list(
+        zip(small.idx_l[exact_n:].tolist(), small.idx_r[exact_n:].tolist())
+    )
+    assert len(approx_small) == 40  # cap held exactly (enough candidates)
+    assert approx_small == approx_big[:40], "emission must be best-first"
+
+
+def test_composes_with_exact_rules():
+    """No pair an exact rule produced is re-emitted, and no pair appears
+    twice (cross-band dedup)."""
+    df, _ = typo_corpus()
+    # give the exact tier something to find: clean duplicate rows
+    extra = df.head(10).copy()
+    extra["unique_id"] = extra["unique_id"] + 1000
+    df = pd.concat([df, extra], ignore_index=True)
+    s = _settings(approx_pair_budget=100_000)
+    table = encode_table(df, s)
+    out = block_using_rules(s, table)
+    pairs = list(zip(out.idx_l.tolist(), out.idx_r.tolist()))
+    assert len(pairs) == len(set(pairs)), "a pair was emitted twice"
+
+    s_exact = _settings(approx_blocking=False)
+    exact = _pair_set(block_using_rules(s_exact, encode_table(df, s_exact)))
+    assert exact <= set(pairs)
+
+
+def test_device_tier_composition():
+    """approx rides the device-native exact tier too (device_blocking=on
+    streams the exact rules through the device join, then the approx tier
+    appends to the same sink)."""
+    df, true = typo_corpus(40)
+    s = _settings(
+        device_blocking="on",
+        approx_threshold=0.2,
+        approx_pair_budget=1000,
+    )
+    table = encode_table(df, s)
+    pairs = _pair_set(block_using_rules(s, table))
+    recall = len(true & pairs) / len(true)
+    assert recall >= 0.95
+
+    s_host = _settings(
+        device_blocking="off",
+        approx_threshold=0.2,
+        approx_pair_budget=1000,
+    )
+    host_pairs = _pair_set(block_using_rules(s_host, encode_table(df, s_host)))
+    assert pairs == host_pairs, "device/host exact tiers must compose equally"
+
+
+def test_link_only_approx():
+    df, true = typo_corpus(40)
+    base = df.iloc[:40].copy()
+    twins = df.iloc[40:].copy()
+    s = _settings(
+        link_type="link_only",
+        approx_threshold=0.2,
+        approx_pair_budget=1000,
+    )
+    table = encode_table(
+        pd.concat([base, twins], ignore_index=True), s
+    )
+    pairs = _pair_set(block_using_rules(s, table, n_left=40))
+    recall = len(true & pairs) / len(true)
+    assert recall >= 0.95
+    # link_only orientation: left input rows on the l side
+    assert all(i < 40 <= j for i, j in pairs)
+
+
+def test_verification_threshold_filters():
+    """A high Jaccard threshold removes low-similarity candidates that an
+    unverified run keeps."""
+    df, _ = typo_corpus()
+    s_off = _settings(approx_threshold=0.0, approx_pair_budget=100_000)
+    t = encode_table(df, s_off)
+    r_off = generate_approx_candidates(s_off, t)
+    assert r_off is not None
+    s_on = _settings(approx_threshold=0.6, approx_pair_budget=100_000)
+    r_on = generate_approx_candidates(s_on, encode_table(df, s_on))
+    assert r_on[4]["survivors"] < r_off[4]["survivors"]
+    assert (r_on[3] >= np.float32(0.6)).all()
+
+
+def test_no_sketchable_column_skips_tier():
+    df = pd.DataFrame(
+        {"unique_id": range(6), "amount": [1.0, 2.0, 1.0, 3.0, 2.0, 1.0]}
+    )
+    s = _settings(
+        comparison_columns=[{"col_name": "amount", "data_type": "numeric"}],
+        blocking_rules=["l.amount = r.amount"],
+    )
+    table = encode_table(df, s)
+    assert approx_columns(s, table) == []
+    assert ApproxConfig.from_settings(s, table) is None
+    out = block_using_rules(s, table)  # must not raise, exact pairs only
+    assert out.n_pairs > 0
+
+
+def test_blocking_approx_event_published(capture):
+    df, _ = typo_corpus(40)
+    s = _settings(approx_threshold=0.2, approx_pair_budget=500)
+    block_using_rules(s, encode_table(df, s))
+    evs = capture.of("blocking_approx")
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["bands"] == s["approx_bands"]
+    assert ev["candidates"] > 0
+    assert ev["survivors"] <= ev["candidates"]
+    assert ev["emitted"] <= 500
+    assert 0.0 <= ev["budget_fill"] <= 1.0
+    assert ev["verified"] is True
+    assert "oversize_buckets_dropped" in ev
+
+
+# ----------------------------------------------------------------------
+# Settings keys
+# ----------------------------------------------------------------------
+
+
+def test_approx_settings_defaults():
+    s = _settings()
+    assert s["approx_blocking"] is True
+    assert s["approx_q"] == 2
+    assert s["approx_bands"] == 16
+    assert s["approx_rows_per_band"] == 2
+    assert s["approx_threshold"] == 0
+    assert s["approx_pair_budget"] == 4194304
+    off = _settings(approx_blocking=False)
+    assert off["approx_blocking"] is False
+
+
+# ----------------------------------------------------------------------
+# Audit registrations: clean AND falsifiable
+# ----------------------------------------------------------------------
+
+
+def test_approx_kernels_registered_and_clean():
+    from splink_tpu.analysis.trace_audit import run_audit
+
+    findings, audited = run_audit(["approx_minhash", "approx_verify"])
+    assert audited == 2
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_approx_shard_kernels_registered_and_clean():
+    from splink_tpu.analysis.shard_audit import run_shard_audit
+
+    findings, audited = run_shard_audit(
+        ["approx_minhash_sharded", "approx_verify_sharded"]
+    )
+    assert audited == 2
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_bad_minhash_twin_trips_ta_dtype():
+    """An unpinned arange in the band fold goes int64 under the forced-x64
+    trace — the dtype leak TA-DTYPE exists to catch (the real kernel pins
+    jnp.arange(bands, dtype=jnp.int32))."""
+    from splink_tpu.analysis.trace_audit import KernelSpec, audit_kernel
+
+    def build():
+        import jax.numpy as jnp
+
+        def bad(sig):
+            bands = sig.shape[0]
+            salt = jnp.arange(bands)  # unpinned: int64 under x64
+            return sig ^ salt.astype(jnp.uint32)
+
+        sig = jnp.zeros(8, jnp.uint32)
+        return bad, (sig,), {}
+
+    findings = audit_kernel(
+        KernelSpec(name="bad_approx_minhash_dtype", build=build)
+    )
+    assert any(f.rule == "TA-DTYPE" for f in findings), [
+        f.format() for f in findings
+    ]
+
+
+def test_bad_verify_shard_twin_trips_sa_coll():
+    """Ranking INSIDE the sharded verify kernel — a sort over the sharded
+    pair axis, the op the design keeps on the host — forces GSPMD to
+    gather the axis: SA-COLL fires."""
+    import jax
+
+    from splink_tpu.analysis.shard_audit import (
+        audit_mesh,
+        register_shard_kernel,
+        run_shard_audit,
+    )
+    from splink_tpu.parallel.mesh import pair_sharding
+
+    registry: dict = {}
+
+    @register_shard_kernel(
+        "bad_approx_rank_sharded", n_pairs=64, registry=registry
+    )
+    def _build():
+        mesh = audit_mesh()
+        sim = jax.device_put(
+            np.zeros(64, np.float32), pair_sharding(mesh)
+        )
+
+        def bad(sim):
+            return jax.lax.sort((sim,), num_keys=1)[0]
+
+        return bad, (sim,), {}
+
+    findings, audited = run_shard_audit(registry=registry, baselines={})
+    assert audited == 1
+    assert any(f.rule == "SA-COLL" for f in findings), [
+        f.format() for f in findings
+    ]
+
+
+# ----------------------------------------------------------------------
+# Serve fallback bucket path
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_setup():
+    """Trained linker over the clean base + an approx-armed index; the
+    garbled twins are the fallback queries."""
+    from splink_tpu import Splink
+
+    df, _ = typo_corpus(40)
+    base = df.iloc[:40].reset_index(drop=True)
+    twins = df.iloc[40:].reset_index(drop=True)
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": [
+            "l.first_name = r.first_name",
+            "l.surname = r.surname",
+        ],
+        "max_iterations": 3,
+        "approx_blocking": True,
+    }
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        linker = Splink(s, df=base)
+        linker.get_scored_comparisons()
+        index = linker.export_index()
+    return base, twins, linker, index
+
+
+@pytest.fixture(scope="module")
+def serve_engine(serve_setup):
+    from splink_tpu.serve import BucketPolicy, QueryEngine
+
+    _, _, _, index = serve_setup
+    eng = QueryEngine(index, top_k=8, policy=BucketPolicy((16,), (64, 256)))
+    eng.warmup()
+    return eng
+
+
+def test_serve_fallback_returns_approx_tagged(serve_setup, serve_engine):
+    """Garbled queries (typo in EVERY blocking key) previously returned
+    empty; with the approx tier they return approx-tagged candidates."""
+    base, twins, _, index = serve_setup
+    assert index.approx is not None
+    assert index.approx.bands == 16
+    res = serve_engine.query(twins)
+    assert len(res) > 0
+    assert "approx" in res.columns
+    assert res["approx"].all()
+    # >=95% of the garbled twins find their true base record
+    found = {
+        int(r["unique_id_q"]) - 40
+        for _, r in res.iterrows()
+        if int(r["unique_id_m"]) == int(r["unique_id_q"]) - 40
+    }
+    assert len(found) >= 0.95 * len(twins)
+    # exact-resolving queries are NOT approx-tagged
+    res_clean = serve_engine.query(base.head(8))
+    assert not res_clean["approx"].any()
+
+
+def test_serve_fallback_parity_with_offline_oracle(serve_setup, serve_engine):
+    """The acceptance criterion: fallback scores are BIT-identical to
+    offline scoring of the same (query, candidate) pairs. The oracle is a
+    second linker over base+twins with the SAME trained params, whose
+    approx-tier blocking produces those pairs for the offline scorer."""
+    from splink_tpu import Splink
+
+    base, twins, linker, index = serve_setup
+    combined = pd.concat([base, twins], ignore_index=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s2 = copy.deepcopy(linker.settings)
+        s2["approx_pair_budget"] = 100_000
+        s2["max_iterations"] = 0  # score with the trained params, no EM
+        oracle = Splink(s2, df=combined)
+        oracle.params = linker.params
+        df_e = oracle.get_scored_comparisons()
+    offline = {
+        (int(r["unique_id_l"]), int(r["unique_id_r"])): r["match_probability"]
+        for _, r in df_e.iterrows()
+    }
+    top_p, top_rows, top_valid, _ = serve_engine.query_arrays(twins)
+    checked = 0
+    for q in range(len(twins)):
+        for r in range(top_p.shape[1]):
+            if not top_valid[q, r]:
+                continue
+            m = int(index.unique_id[top_rows[q, r]])
+            key = (m, q + 40)  # base uid < twin uid
+            if key not in offline:
+                continue  # offline budget/bands may rank it out
+            assert np.float32(offline[key]) == top_p[q, r], key
+            checked += 1
+    assert checked >= len(twins), "parity must cover a real sample"
+
+
+def test_serve_fallback_zero_steady_state_recompiles(serve_setup, serve_engine):
+    from splink_tpu.obs.metrics import compile_requests
+
+    _, twins, _, _ = serve_setup
+    serve_engine.query_arrays(twins)  # warm
+    c0 = compile_requests()
+    serve_engine.query_arrays(twins)
+    assert compile_requests() - c0 == 0
+
+
+def test_serve_index_roundtrip_preserves_approx(serve_setup, tmp_path):
+    from splink_tpu.serve import load_index
+
+    _, twins, _, index = serve_setup
+    index.save(tmp_path)
+    idx2 = load_index(tmp_path)
+    assert idx2.approx is not None
+    assert idx2.approx.cols == index.approx.cols
+    assert idx2.content_fingerprint() == index.content_fingerprint()
+    for b1, b2 in zip(index.approx.band_index, idx2.approx.band_index):
+        assert np.array_equal(b1.rows_sorted, b2.rows_sorted)
+        assert b1.bucket_of == b2.bucket_of
+    batch1 = index.encode_queries(twins)
+    batch2 = idx2.encode_queries(twins)
+    assert np.array_equal(batch1.qbuckets, batch2.qbuckets)
+    assert np.array_equal(batch1.approx_used, batch2.approx_used)
+
+
+def test_exact_only_index_has_no_approx_row(serve_setup):
+    """An index built WITHOUT the approx tier keeps the legacy gather
+    shape and QueryBatch contract."""
+    from splink_tpu import Splink
+
+    base, twins, linker, _ = serve_setup
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s2 = copy.deepcopy(linker.settings)
+        s2["approx_blocking"] = False
+        plain = Splink(s2, df=base)
+        plain.params = linker.params
+        idx = plain.export_index()
+    assert idx.approx is None
+    batch = idx.encode_queries(twins.head(4))
+    assert batch.qbuckets.shape[0] == len(idx.rules)
+    assert batch.approx_used is None
+
+
+def test_virtual_pair_generation_defers_to_approx():
+    """device_pair_generation must NOT bypass the approx tier: the virtual
+    pair index enumerates exact-rule pairs only, so with approx_blocking
+    on the linker takes materialised blocking and the scored output still
+    contains the approx pairs (review finding, PR 10)."""
+    from splink_tpu import Splink
+
+    df, true = typo_corpus(30)
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": [
+            "l.first_name = r.first_name",
+            "l.surname = r.surname",
+        ],
+        "max_iterations": 2,
+        "approx_blocking": True,
+        "approx_threshold": 0.2,
+        "approx_pair_budget": 1000,
+        "device_pair_generation": "on",
+    }
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        linker = Splink(s, df=df)
+        df_e = linker.get_scored_comparisons()
+    assert linker.device_pair_generation_active is False
+    scored = set(
+        zip(df_e["unique_id_l"].astype(int), df_e["unique_id_r"].astype(int))
+    )
+    assert len(true & scored) >= 0.95 * len(true)
+
+
+def test_pair_bound_counts_approx_only_when_available():
+    """estimate_pair_upper_bound adds the approx budget only when the tier
+    can actually run — capped at the job's total possible pair count — and
+    include_approx=False gives the exact-rules-only bound the device auto
+    gate uses."""
+    from splink_tpu.blocking import estimate_pair_upper_bound
+
+    df, _ = typo_corpus(20)
+    s = _settings(approx_pair_budget=123)
+    table = encode_table(df, s)
+    with_approx = estimate_pair_upper_bound(s, table)
+    exact_only = estimate_pair_upper_bound(s, table, include_approx=False)
+    assert with_approx == exact_only + 123
+
+    # a budget beyond the job's total possible pair count adds only the
+    # total (40 rows -> 780): the default 4M budget must not inflate a
+    # tiny job's bound past the resident gate / gamma batch clamp
+    s_big = _settings(approx_pair_budget=12345)
+    assert (
+        estimate_pair_upper_bound(s_big, table)
+        == exact_only + len(df) * (len(df) - 1) // 2
+    )
+
+    # no sketchable column: the tier contributes zero
+    df2 = pd.DataFrame(
+        {"unique_id": range(4), "amount": [1.0, 2.0, 1.0, 2.0]}
+    )
+    s2 = _settings(
+        comparison_columns=[{"col_name": "amount", "data_type": "numeric"}],
+        blocking_rules=["l.amount = r.amount"],
+        approx_pair_budget=12345,
+    )
+    t2 = encode_table(df2, s2)
+    assert estimate_pair_upper_bound(s2, t2) == estimate_pair_upper_bound(
+        s2, t2, include_approx=False
+    )
+
+
+def test_pre_ranking_working_set_is_bounded():
+    """The candidate accumulation prunes to the running top-budget: a tiny
+    budget yields arrays capped near 2x budget, and the emitted prefix is
+    unchanged vs an unpruned (huge-budget) run."""
+    df, _ = typo_corpus(60)
+    s_small = _settings(approx_threshold=0.0, approx_pair_budget=16)
+    t = encode_table(df, s_small)
+    res_small = generate_approx_candidates(s_small, t)
+    i_s, j_s, c_s, sm_s, stats_small = res_small
+    assert len(i_s) <= 16 + max(16, 4 * (1 << 13))  # prune_cap bound
+    s_big = _settings(approx_threshold=0.0, approx_pair_budget=1 << 24)
+    res_big = generate_approx_candidates(s_big, encode_table(df, s_big))
+    i_b, j_b, c_b, sm_b, stats_big = res_big
+    # survivors COUNT every candidate either way
+    assert stats_small["survivors"] == stats_big["survivors"]
+    # top-16 by the emission ranking agrees between pruned and unpruned
+    top_s = np.lexsort((j_s, i_s, -c_s, -sm_s))[:16]
+    top_b = np.lexsort((j_b, i_b, -c_b, -sm_b))[:16]
+    assert list(zip(i_s[top_s], j_s[top_s])) == list(
+        zip(i_b[top_b], j_b[top_b])
+    )
+
+
+def test_oversize_bucket_does_not_suppress_later_bands(monkeypatch):
+    """An oversize-dropped bucket must not mask its pairs in later bands
+    (review finding, PR 10): the bucket's CODES are nulled — not just its
+    emission units removed — so a pair whose rows also collide in a
+    healthy band emits there instead of being lost to the cross-band
+    sequential-dedup mask. Oracle: the exact within-bucket pair union
+    over the post-null band codes, computed independently in numpy."""
+    import splink_tpu.approx.lsh as lsh
+
+    monkeypatch.setattr(lsh, "MAX_BUCKET_ROWS", 6)
+    df, _ = typo_corpus(60)
+    s = _settings(approx_threshold=0.0, approx_pair_budget=1 << 24)
+    table = encode_table(df, s)
+    plan = lsh.build_approx_plan(s, table)
+    assert plan.oversize_buckets > 0, "fixture must trip the bucket cap"
+    i, j, _c, _sim, stats = lsh.generate_approx_candidates(
+        s, table, plan=plan
+    )
+    got = set(zip(i.tolist(), j.tolist()))
+    exp = set()
+    bc = plan.band_codes  # post-null
+    for b in range(bc.shape[0]):
+        codes = bc[b]
+        for code in np.unique(codes[codes >= 0]):
+            rows = np.flatnonzero(codes == code)
+            for x in range(len(rows)):
+                for y in range(x + 1, len(rows)):
+                    exp.add((int(rows[x]), int(rows[y])))
+    assert got == exp
+    assert stats["oversize_buckets_dropped"] == plan.oversize_buckets

@@ -348,3 +348,49 @@ def test_serve_observability_key_types_validated():
             obs_flight_records=0,
         )
     )
+
+
+def test_approx_blocking_defaults_filled():
+    """The approximate-blocking keys complete from the schema: tier OFF by
+    default, q=2 grams, a 16x2 LSH banding, verification off, 4M budget."""
+    s = complete_settings_dict(_minimal())
+    assert s["approx_blocking"] is False
+    assert s["approx_q"] == 2
+    assert s["approx_bands"] == 16
+    assert s["approx_rows_per_band"] == 2
+    assert s["approx_threshold"] == 0
+    assert s["approx_pair_budget"] == 4194304
+
+
+def test_approx_blocking_key_types_validated():
+    """Type/bound violations on the approx keys are rejected by the schema
+    validator (the PR 5/7 key-validation pattern)."""
+    for bad in (
+        {"approx_blocking": "yes"},
+        {"approx_blocking": 1},
+        {"approx_q": 0},
+        {"approx_q": 9},
+        {"approx_q": "two"},
+        {"approx_bands": 0},
+        {"approx_bands": 2.5},
+        {"approx_rows_per_band": 0},
+        {"approx_rows_per_band": "many"},
+        {"approx_threshold": -0.1},
+        {"approx_threshold": 1.5},
+        {"approx_threshold": "strict"},
+        {"approx_pair_budget": 0},
+        {"approx_pair_budget": "big"},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    # valid values pass (threshold is a number: floats allowed)
+    validate_settings(
+        _minimal(
+            approx_blocking=True,
+            approx_q=3,
+            approx_bands=32,
+            approx_rows_per_band=1,
+            approx_threshold=0.4,
+            approx_pair_budget=1024,
+        )
+    )
