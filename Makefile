@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -87,6 +87,14 @@ trace-smoke:
 warmup-smoke:
 	python scripts/warmup_smoke.py
 
+# Drift smoke: build a profiled index, serve a clean query stream (quiet
+# windows, zero recompiles with sketching on), then inject a skewed stream
+# and assert the two-window drift alert fires, the flight recorder dumps,
+# and `obs drift` + the Prometheus exposition render the captured record
+# (docs/observability.md#drift).
+drift-smoke:
+	python scripts/drift_smoke.py
+
 bench:
 	python bench.py
 
@@ -94,4 +102,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke bench
+all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke drift-smoke bench

@@ -1006,3 +1006,60 @@ def _ensure_default_registry() -> None:
             strings.jaro_winkler_vmapped(s1, s2, l1, l2, p, bt)
         )
         return fn, (s, s, ln, ln, p, bt), {}
+
+    # Quality-profile capture on the mesh: the training gammas arrive
+    # pair-sharded (the index build reuses whatever sharding the EM run
+    # left them in), params replicate, and the flat histogram reduces into
+    # the replicated output through exactly the scatter-add psums the
+    # committed baseline pins — the pattern-kernel collective class.
+    @register_shard_kernel(
+        "quality_profile_sharded",
+        n_pairs=1024,
+        allow_collectives=("all-reduce",),
+    )
+    def _build_quality_profile_sharded():
+        from ..obs.quality import make_profile_fn
+
+        mesh, G, params, _ = _sharded_fs(1024)
+        fn = make_profile_fn((3, 3, 3), bins=8)
+        return fn, (G, params), {}
+
+    # Serve-time drift sketch on the mesh: the accumulator and reference
+    # table replicate, the per-batch top-k outputs arrive query-sharded
+    # (the serving axis serve_score_fused_sharded pins), and the updated
+    # accumulator reduces back replicated via the same scatter-add psum
+    # class — sketching composes with multi-chip serving without adding a
+    # collective beyond its own histogram reduction.
+    @register_shard_kernel(
+        "serve_drift_sketch_sharded",
+        n_pairs=64,
+        allow_collectives=("all-reduce",),
+    )
+    def _build_serve_drift_sketch_sharded():
+        import jax
+        import numpy as np
+
+        from ..obs.drift import make_sketch_fn
+        from ..parallel.mesh import pair_sharding, replicated
+
+        mesh = audit_mesh()
+        program = shared_gamma_program()
+        cols = program.settings["comparison_columns"]
+        bins = 8
+        width = max(int(c["num_levels"]) for c in cols) + 1
+        size = len(cols) * width + 2 * bins
+        fn = make_sketch_fn(program._layout, cols, bins)
+        shard, rep = pair_sharding(mesh), replicated(mesh)
+        acc = jax.device_put(np.zeros(size, np.int32), rep)
+        packed_q = jax.device_put(
+            np.zeros((64, program._packed.shape[1]), np.uint32), shard
+        )
+        packed_ref = jax.device_put(program._packed, rep)
+        top_rows = jax.device_put(np.zeros((64, 4), np.int32), shard)
+        top_valid = jax.device_put(np.zeros((64, 4), bool), shard)
+        top_p = jax.device_put(np.zeros((64, 4), np.float32), shard)
+        return (
+            fn,
+            (acc, packed_q, packed_ref, top_rows, top_valid, top_p),
+            {},
+        )

@@ -818,3 +818,46 @@ def _ensure_default_registry() -> None:
         cand = jnp.asarray(np.zeros((16, 4), np.int32))
         valid = jnp.asarray(np.zeros((16, 4), bool))
         return fn, (packed_q, program._packed, cand, valid, params), {}
+
+    # ----- linkage quality observatory (splink_tpu/obs/quality.py,
+    #       obs/drift.py) -----
+    # The profile kernel runs once per build_index over every training
+    # gamma chunk; the sketch kernel runs per SERVED BATCH, folded onto
+    # the fused megakernel's outputs — a dtype leak or embedded constant
+    # there costs every request, and any host callback would break the
+    # zero-extra-sync contract the drift-smoke gates. Both follow the
+    # pattern-kernel int32 scatter-add histogram protocol.
+
+    @register_kernel("quality_profile")
+    def _build_quality_profile():
+        from ..obs.quality import make_profile_fn
+
+        G, params = _fs_inputs()
+        fn = make_profile_fn((3, 3, 3), bins=8)
+        return fn, (G, params), {}
+
+    @register_kernel("serve_drift_sketch")
+    def _build_serve_drift_sketch():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..obs.drift import make_sketch_fn
+
+        program = _gamma_program()
+        _, params = _fs_inputs()
+        cols = program.settings["comparison_columns"]
+        bins = 8
+        width = max(int(c["num_levels"]) for c in cols) + 1
+        size = len(cols) * width + 2 * bins
+        fn = make_sketch_fn(program._layout, cols, bins)
+        acc = jnp.asarray(np.zeros(size, np.int32))
+        packed_q = jnp.asarray(np.zeros((16, program._packed.shape[1]),
+                                        np.uint32))
+        top_rows = jnp.asarray(np.zeros((16, 4), np.int32))
+        top_valid = jnp.asarray(np.zeros((16, 4), bool))
+        top_p = jnp.asarray(np.zeros((16, 4), np.float32))
+        return (
+            fn,
+            (acc, packed_q, program._packed, top_rows, top_valid, top_p),
+            {},
+        )

@@ -450,6 +450,26 @@ def run_em_checkpointed(
     )
 
 
+def trimmed_trajectory(result: EMResult) -> dict:
+    """Host-side convergence record of one EM run: the per-iteration log
+    likelihood (entry 0 = the initial parameters, reference
+    ``param_history`` layout; entry i = the likelihood under params i,
+    None where not computed) plus update count and convergence flag —
+    ONLY the series the Params history cannot reconstruct. The lambda
+    path and max m/u movement live in the diagnostics event's
+    ``trajectory`` payload (obs/quality._trajectory_payload); the full
+    device histories stay in the result for callers that want them."""
+    import numpy as np
+
+    n = int(result.n_updates)
+    ll = np.asarray(result.ll_history)[: n + 1]
+    return {
+        "n_updates": n,
+        "converged": bool(result.converged),
+        "ll": [None if np.isnan(v) else round(float(v), 4) for v in ll],
+    }
+
+
 @jax.jit
 def score_pairs(G, params: FSParams):
     """Final E-step scoring: match probability for every pair."""

@@ -238,6 +238,9 @@ class Splink:
         self._P_virtual: np.ndarray | None = None
         self._virtual_want_ids = False
         self._pair_bound: int | None = None  # estimate_pair_upper_bound memo
+        # last EMResult replayed into Params (EM diagnostics attach its
+        # trimmed trajectory: per-iteration ll lives only device-side)
+        self._last_em_result = None
         # checkpoint/resume state for the current estimate_parameters call
         # (argument overrides; the settings keys are the fallback)
         self._ckpt_dir_arg: str | None = None
@@ -968,12 +971,14 @@ class Splink:
         )
         self._obs.count("pairs_gamma_scored", int(counts.sum()))
         self._obs.gauge("gamma_patterns_distinct", int(seen.sum()))
+        self._last_em_result = None  # same staleness guard as _run_em
+        # always cheap here (the pattern matrix is small by construction);
+        # feeds telemetry AND the EM diagnostics' level-support evidence
+        hist = _gamma_histograms(self.settings, patterns, weights=counts)
         if self._obs.enabled:
-            self._obs.record(
-                "gamma_histogram",
-                _gamma_histograms(self.settings, patterns, weights=counts),
-            )
+            self._obs.record("gamma_histogram", hist)
         self._run_em_resident_weighted(patterns[seen], counts[seen], compute_ll)
+        self._emit_em_diagnostics(hist)
 
     # ------------------------------------------------------------------
     # Public API (reference parity)
@@ -1105,12 +1110,26 @@ class Splink:
         from .utils.logging_utils import warn_degraded
 
         self._obs.count("pairs_gamma_scored", len(G))
-        if self._obs.enabled:
-            self._obs.record(
-                "gamma_histogram", _gamma_histograms(self.settings, G)
-            )
+        # a stale result from an earlier call must not attach its
+        # trajectory to this run's diagnostics (the streamed/checkpointed
+        # paths replay history without going through _replay_history)
+        self._last_em_result = None
+        # the gamma histogram doubles as the EM diagnostics' level-support
+        # evidence (obs/quality.em_diagnostics) and as the quality
+        # profile's raw material; in the resident regime it is cheap
+        # relative to the gamma computation that just ran, so compute it
+        # there unconditionally — the huge streamed-with-telemetry-off
+        # case alone skips it (diagnostics then omit support counts)
+        hist = None
+        if self._obs.enabled or len(G) <= int(
+            self.settings["max_resident_pairs"]
+        ):
+            hist = _gamma_histograms(self.settings, G)
+            if self._obs.enabled:
+                self._obs.record("gamma_histogram", hist)
         if len(G) > int(self.settings["max_resident_pairs"]):
             self._run_em_streamed(G, compute_ll)
+            self._emit_em_diagnostics(hist)
             return
         # the resident attempt may replay completed updates into
         # self.params (checkpoint boundaries / save_state_fn) before it
@@ -1129,6 +1148,7 @@ class Splink:
                 pairs=len(G),
             )
             self._run_em_streamed(G, compute_ll)
+        self._emit_em_diagnostics(hist)
 
     def _run_em_resident(self, G: np.ndarray, compute_ll: bool) -> None:
         """Fused on-device EM with the gamma matrix resident in HBM."""
@@ -1494,10 +1514,32 @@ class Splink:
                 float(lam_h[k]), np.asarray(m_h[k]), np.asarray(u_h[k])
             )
 
+    def _emit_em_diagnostics(self, gamma_hist: dict | None) -> None:
+        """Offline EM diagnostics (obs/quality.em_diagnostics): final
+        m/u/Bayes-factor table with identifiability warnings — levels
+        with ~zero training support, levels where m~=u — logged as
+        warnings and emitted as one ``em_diagnostics`` telemetry event
+        (rendered by ``obs summarize``). Never raises into the run."""
+        try:
+            from .em import trimmed_trajectory
+            from .obs.quality import em_diagnostics
+
+            diag = em_diagnostics(self.params, gamma_hist)
+            if self._last_em_result is not None:
+                # the device-side trajectory carries the per-iteration
+                # log likelihood the Params history cannot reconstruct
+                diag["run"] = trimmed_trajectory(self._last_em_result)
+            for w in diag["warnings"]:
+                logger.warning("EM identifiability: %s", w)
+            self._obs.emit_event("em_diagnostics", **diag)
+        except Exception as e:  # noqa: BLE001 - diagnostics are best-effort
+            logger.warning("EM diagnostics failed: %s", e)
+
     def _replay_history(self, result, compute_ll: bool) -> None:
         """Install a run_em result's device-side history into the Params
         object so history, convergence logging, charts and save/load match
         the reference's per-iteration bookkeeping."""
+        self._last_em_result = result
         n_updates = int(result.n_updates)
         ll_hist = np.asarray(result.ll_history)
         self._replay_em_history(

@@ -26,10 +26,16 @@ Layers (each importable on its own, none imports jax at module scope):
   * :mod:`.exposition` — stdlib Prometheus text endpoint
     (``obs_exposition_port``).
   * :mod:`.flight`  — bounded crash flight recorder, dumped to JSONL on
-    breaker-open / worker restart / swap rollback / SIGUSR2
+    breaker-open / worker restart / swap rollback / drift alert / SIGUSR2
     (``obs_flight_records``).
+  * :mod:`.quality` — training-reference quality profiles (captured at
+    ``build_index`` into the LinkageIndex artifact) + offline EM
+    identifiability diagnostics (``quality_profile``).
+  * :mod:`.drift`   — serve-time device drift sketches, PSI /
+    Jensen-Shannon scoring of rolling windows vs the reference, and the
+    two-window drift alerts (``drift_window_s`` / ``drift_alert_psi``).
   * :mod:`.cli`     — ``python -m splink_tpu.obs
-    summarize|export-trace|attribute|serve-dash``.
+    summarize|export-trace|attribute|drift|serve-dash``.
 
 Zero-cost contract: with no sink configured (``telemetry_dir`` empty) the
 linker adds NO host callbacks and compiled programs are unchanged — the
@@ -40,9 +46,11 @@ the single sanctioned ``io_callback``).
 See docs/observability.md for the event schema and CLI usage.
 """
 
+from .drift import DriftMonitor, js_divergence, psi
 from .events import EventSink, publish, read_events
-from .exposition import ExpositionServer, Sample
+from .exposition import ExpositionServer, HistogramSample, Sample
 from .flight import FlightRecorder
+from .quality import QualityProfile, em_diagnostics
 from .metrics import MetricsRegistry, compile_totals, install_compile_monitor
 from .reqtrace import PHASES, PhaseProfile, RequestTrace, ServeTracer
 from .runtime import RunContext
@@ -66,5 +74,11 @@ __all__ = [
     "SLOTracker",
     "ExpositionServer",
     "Sample",
+    "HistogramSample",
     "FlightRecorder",
+    "QualityProfile",
+    "em_diagnostics",
+    "DriftMonitor",
+    "psi",
+    "js_divergence",
 ]

@@ -1,12 +1,14 @@
 """CLI: ``python -m splink_tpu.obs
-summarize|export-trace|attribute|serve-dash``.
+summarize|export-trace|attribute|drift|serve-dash``.
 
 ``summarize`` renders a per-stage / per-iteration report of one run's
 telemetry record; ``export-trace`` converts it to Chrome trace-event JSON
 (load at ui.perfetto.dev); ``attribute`` decomposes serve tail latency
 into the request-trace phases (obs v2 — which phase ate the p99);
-``serve-dash`` renders a live terminal dashboard by polling a service's
-Prometheus exposition endpoint. This module's logic is pure stdlib and
+``drift`` reports the drift observatory — the PSI trajectory of the served
+distribution against the training-reference profile plus the alert
+timeline; ``serve-dash`` renders a live terminal dashboard by polling a
+service's Prometheus exposition endpoint. This module's logic is pure stdlib and
 never initialises a jax backend or touches a device — but invoking it as
 ``python -m splink_tpu.obs`` imports the ``splink_tpu`` package, whose
 top-level ``__init__`` imports jax, so the package's dependencies must be
@@ -28,6 +30,12 @@ from .tracer import chrome_trace_from_events
 
 def _fmt_s(v) -> str:
     return f"{v:.3f}s" if isinstance(v, (int, float)) else "-"
+
+
+def _or0(v):
+    """Torn-record tolerance for fields where 0.0 is a REAL value (a
+    collapsed match yield): substitute only on missing, never on falsy."""
+    return 0 if v is None else v
 
 
 def summarize_events(events: list[dict]) -> str:
@@ -200,14 +208,120 @@ def summarize_events(events: list[dict]) -> str:
             if extra:
                 lines.append("    " + "; ".join(extra))
 
+    # ---- EM diagnostics (obs/quality.em_diagnostics) ---------------------
+    diags = [e for e in events if e.get("type") == "em_diagnostics"]
+    if diags:
+        ev = diags[-1]  # one per EM run; latest wins
+        lines.append("")
+        lines.append(
+            f"EM diagnostics: lambda={ev.get('lam') or 0} "
+            f"({ev.get('n_iterations') or 0} archived state(s))"
+        )
+        lines.append(f"  {'column':<18}{'level':>6}{'m':>10}{'u':>10}"
+                     f"{'log2 bf':>9}{'support':>10}")
+        for col in ev.get("columns") or []:
+            name = col.get("name") or "?"
+            ms = col.get("m") or []
+            us = col.get("u") or []
+            bfs = col.get("log2_bf") or []
+            sup = col.get("support")
+            for lv in range(col.get("num_levels") or 0):
+                m_v = ms[lv] if lv < len(ms) else None
+                u_v = us[lv] if lv < len(us) else None
+                bf = bfs[lv] if lv < len(bfs) else None
+                s_v = sup[lv] if sup and lv < len(sup) else None
+                lines.append(
+                    f"  {(name if lv == 0 else ''):<18}{lv:>6}"
+                    f"{(f'{m_v:.4f}' if isinstance(m_v, (int, float)) else '-'):>10}"
+                    f"{(f'{u_v:.4f}' if isinstance(u_v, (int, float)) else '-'):>10}"
+                    f"{(f'{bf:+.2f}' if isinstance(bf, (int, float)) else '-'):>9}"
+                    f"{(f'{s_v:,}' if isinstance(s_v, int) else '-'):>10}"
+                )
+        warns = ev.get("warnings") or []
+        for w in warns:
+            lines.append(f"  ! {w}")
+        if not warns:
+            lines.append("  (no identifiability warnings)")
+
+    # ---- quality profile + serve-time drift ------------------------------
+    profiles = [e for e in events if e.get("type") == "quality_profile"]
+    for ev in profiles:
+        # torn/old records may miss fields: render 0/empty, never crash
+        lines.append("")
+        lines.append(
+            f"quality profile: {len(ev.get('columns') or [])} column(s), "
+            f"{ev.get('n_pairs') or 0:,} training pair(s) over "
+            f"{ev.get('n_rows') or 0:,} row(s), "
+            f"{ev.get('bins') or 0} score bins"
+        )
+        nulls = ev.get("null_rates") or {}
+        if nulls:
+            lines.append(
+                "  null rates: "
+                + ", ".join(f"{k}={v or 0:.4f}" for k, v in sorted(nulls.items()))
+            )
+    drift_windows = [e for e in events if e.get("type") == "drift_window"]
+    drift_alerts = [e for e in events
+                    if e.get("type") in ("drift_alert", "drift_clear")]
+    if drift_windows or drift_alerts:
+        lines.append("")
+        lines.append(
+            f"drift: {len(drift_windows)} window report(s), "
+            f"{sum(1 for e in drift_alerts if e['type'] == 'drift_alert')} "
+            "alert(s)"
+        )
+        if drift_windows:
+            last = drift_windows[-1]
+            lines.append(
+                f"  last window ({last.get('window_s') or 0}s): "
+                f"queries={last.get('queries') or 0:,} "
+                f"pairs={last.get('pairs') or 0:,} "
+                f"max_psi={last.get('max_psi') or 0}"
+            )
+            channels = last.get("channels") or {}
+            if channels:
+                lines.append(
+                    "  psi: " + ", ".join(
+                        f"{ch}={v if v is not None else '-'}"
+                        for ch, v in sorted(channels.items())
+                    )
+                )
+        for ev in drift_alerts:
+            if ev["type"] == "drift_alert":
+                for a in ev.get("alerts") or []:
+                    if "short_yield" in a:
+                        # a yield of exactly 0.0 is the headline value of
+                        # a collapse alert: or-0 only the MISSING fields
+                        lines.append(
+                            f"  ALERT {a.get('channel') or '?'}: "
+                            f"yield {_or0(a.get('short_yield'))}/"
+                            f"{_or0(a.get('long_yield'))} over "
+                            f"{a.get('window_s') or 0}s/"
+                            f"{a.get('long_window_s') or 0}s "
+                            f"(collapse factor {a.get('threshold') or 0})"
+                        )
+                        continue
+                    lines.append(
+                        f"  ALERT {a.get('channel') or '?'}: "
+                        f"psi {a.get('short_psi') or 0}/"
+                        f"{a.get('long_psi') or 0} over "
+                        f"{a.get('window_s') or 0}s/"
+                        f"{a.get('long_window_s') or 0}s "
+                        f"(threshold {a.get('threshold') or 0})"
+                    )
+            else:
+                lines.append("  alert cleared")
+
     # ---- resilience events ----------------------------------------------
     # serve-tier events (health transitions, breaker state changes, index
-    # hot-swaps, worker restarts, brown-out boundaries) belong in the same
-    # chronological incident timeline as the training-side ones
+    # hot-swaps, worker restarts, brown-out boundaries, drift alerts)
+    # belong in the same chronological incident timeline as the
+    # training-side ones
     res = [e for e in events
            if e.get("type") in ("retry", "fault", "checkpoint", "degradation",
                                 "health", "breaker", "index_swap",
-                                "serve_worker_restart", "brownout_end")]
+                                "serve_worker_restart", "brownout_end",
+                                "drift_alert", "drift_clear")]
     if res:
         lines.append("")
         lines.append(f"resilience events: {len(res)}")
@@ -320,6 +434,85 @@ def attribute_events(events: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def drift_events_report(events: list[dict]) -> str:
+    """``obs drift``: the drift observatory's report over one telemetry
+    record — per replica, the rolling-window PSI trajectory (first/last
+    per channel), serve-side OOV/approx rates and the alert timeline.
+    Torn records render 0/-, never crash (the summarize contract)."""
+    profiles = [e for e in events if e.get("type") == "quality_profile"]
+    windows = [e for e in events if e.get("type") == "drift_window"]
+    alerts = [e for e in events
+              if e.get("type") in ("drift_alert", "drift_clear")]
+    if not (profiles or windows or alerts):
+        return "(no drift events in this record — quality_profile off, " \
+               "or the index carries no reference profile)"
+    lines: list[str] = []
+    for ev in profiles:
+        lines.append(
+            f"reference profile: {len(ev.get('columns') or [])} column(s), "
+            f"{ev.get('n_pairs') or 0:,} training pair(s), "
+            f"{ev.get('bins') or 0} score bins"
+        )
+    replicas = sorted({e.get("replica") or "?" for e in windows})
+    for rep in replicas:
+        wins = [e for e in windows if (e.get("replica") or "?") == rep]
+        lines.append("")
+        lines.append(f"replica {rep}: {len(wins)} window report(s)")
+        channels = sorted({
+            ch for e in wins for ch in (e.get("channels") or {})
+        })
+        lines.append(f"  {'channel':<24}{'first psi':>12}{'last psi':>12}")
+        for ch in channels:
+            series = [
+                (e.get("channels") or {}).get(ch)
+                for e in wins
+                if (e.get("channels") or {}).get(ch) is not None
+            ]
+            first = series[0] if series else None
+            last = series[-1] if series else None
+            lines.append(
+                f"  {ch:<24}"
+                f"{(f'{first:.4f}' if isinstance(first, (int, float)) else '-'):>12}"
+                f"{(f'{last:.4f}' if isinstance(last, (int, float)) else '-'):>12}"
+            )
+        last = wins[-1]
+        lines.append(
+            f"  last window: queries={last.get('queries') or 0:,} "
+            f"pairs={last.get('pairs') or 0:,} "
+            f"oov_rate={last.get('oov_rate') if last.get('oov_rate') is not None else '-'} "
+            f"approx_rate={last.get('approx_rate') if last.get('approx_rate') is not None else '-'}"
+        )
+    if alerts:
+        lines.append("")
+        lines.append(f"alert timeline ({len(alerts)} transition(s)):")
+        for ev in alerts:
+            rep = ev.get("replica") or "?"
+            if ev.get("type") == "drift_clear":
+                lines.append(f"  [{rep}] cleared")
+                continue
+            for a in ev.get("alerts") or []:
+                if "short_yield" in a:
+                    lines.append(
+                        f"  [{rep}] ALERT {a.get('channel') or '?'}: "
+                        f"yield {_or0(a.get('short_yield'))}/"
+                        f"{_or0(a.get('long_yield'))} "
+                        f"(collapse factor {a.get('threshold') or 0}) over "
+                        f"{a.get('window_s') or 0}s/"
+                        f"{a.get('long_window_s') or 0}s"
+                    )
+                    continue
+                lines.append(
+                    f"  [{rep}] ALERT {a.get('channel') or '?'}: "
+                    f"psi {a.get('short_psi') or 0}/{a.get('long_psi') or 0} "
+                    f">= {a.get('threshold') or 0} over "
+                    f"{a.get('window_s') or 0}s/{a.get('long_window_s') or 0}s"
+                )
+    elif windows:
+        lines.append("")
+        lines.append("no drift alerts fired")
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------------
 # serve-dash: poll the Prometheus exposition endpoint, render a terminal view
 # ---------------------------------------------------------------------------
@@ -413,6 +606,22 @@ def render_dash(rows: list[tuple[str, dict, float]]) -> str:
                 f"{w}s={fmt(get('splink_serve_slo_burn_rate', replica=rep, window_s=w), '{:.2f}')}"
                 for w in windows
             ))
+        has_ref = get("splink_serve_drift_reference", replica=rep)
+        if has_ref:
+            drift_channels = sorted({
+                ls.get("channel") for n, ls, _ in rows
+                if n == "splink_serve_drift_psi"
+                and ls.get("replica") == rep
+            })
+            alert = get("splink_serve_drift_alert", replica=rep)
+            lines.append(
+                "  drift psi: "
+                + ("  ".join(
+                    f"{ch}={fmt(get('splink_serve_drift_psi', replica=rep, channel=ch), '{:.3f}')}"
+                    for ch in drift_channels
+                ) if drift_channels else "(no traffic in window)")
+                + ("  [DRIFT ALERT]" if alert else "")
+            )
     if not replicas:
         lines.append("(no splink_serve_* series at this endpoint)")
     return "\n".join(lines)
@@ -464,6 +673,12 @@ def main(argv=None) -> int:
         help="decompose serve tail latency into request-trace phases",
     )
     p_att.add_argument("path", help="telemetry JSONL file")
+    p_drift = sub.add_parser(
+        "drift",
+        help="drift-observatory report: PSI trajectory vs the training "
+             "reference + alert timeline",
+    )
+    p_drift.add_argument("path", help="telemetry JSONL file")
     p_dash = sub.add_parser(
         "serve-dash",
         help="live terminal dashboard over a service's Prometheus endpoint",
@@ -493,6 +708,9 @@ def main(argv=None) -> int:
         return 0
     if args.command == "attribute":
         print(attribute_events(events))
+        return 0
+    if args.command == "drift":
+        print(drift_events_report(events))
         return 0
 
     trace = chrome_trace_from_events(events)

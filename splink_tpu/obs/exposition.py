@@ -43,6 +43,59 @@ class Sample:
     help: str = ""
 
 
+@dataclass
+class HistogramSample:
+    """One native Prometheus histogram family instance: renders as the
+    conventional ``<name>_bucket{le="..."}`` cumulative series (an
+    explicit ``+Inf`` bucket included), ``<name>_sum`` and
+    ``<name>_count``, under a single ``# TYPE <name> histogram`` header.
+
+    ``buckets`` is a list of ``(upper_bound, cumulative_count)`` pairs in
+    ascending bound order WITHOUT the +Inf bucket — the renderer appends
+    ``+Inf`` carrying ``count``. ``sum`` may be an approximation (e.g.
+    bin midpoints when only a binned histogram exists); say so in
+    ``help``."""
+
+    name: str
+    buckets: list
+    sum: float
+    count: float
+    labels: dict = field(default_factory=dict)
+    help: str = ""
+
+
+def histogram_from_counts(
+    name: str,
+    counts,
+    edges,
+    labels: dict | None = None,
+    help: str = "",
+) -> HistogramSample:
+    """Build a :class:`HistogramSample` from per-bin counts and the bins'
+    upper edges (len(edges) == len(counts)). The ``sum`` uses bin
+    midpoints (lower edge = previous upper edge, 0 before the first) —
+    an approximation inherent to pre-binned data."""
+    counts = [float(c) for c in counts]
+    edges = [float(e) for e in edges]
+    cum = 0.0
+    buckets = []
+    total_sum = 0.0
+    prev = 0.0
+    for c, e in zip(counts, edges):
+        cum += c
+        buckets.append((e, cum))
+        total_sum += c * (prev + e) / 2.0
+        prev = e
+    return HistogramSample(
+        name=name,
+        buckets=buckets,
+        sum=total_sum,
+        count=cum,
+        labels=dict(labels or {}),
+        help=help,
+    )
+
+
 def _escape_label(value) -> str:
     return (
         str(value)
@@ -52,11 +105,30 @@ def _escape_label(value) -> str:
     )
 
 
-def render_samples(samples: list[Sample]) -> str:
-    """Render samples as Prometheus text format, grouping rows into
-    families (one ``# HELP`` / ``# TYPE`` header per metric name, first
-    sample's metadata wins)."""
-    families: dict[str, list[Sample]] = {}
+def _fmt_value(value: float) -> str:
+    value = float(value)
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value)
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def render_samples(samples: list) -> str:
+    """Render samples (:class:`Sample` / :class:`HistogramSample`, freely
+    mixed) as Prometheus text format, grouping rows into families (one
+    ``# HELP`` / ``# TYPE`` header per metric name, first sample's
+    metadata wins). Histogram families emit the conventional
+    ``_bucket``/``_sum``/``_count`` series with cumulative ``le`` bounds
+    ending at ``+Inf``."""
+    families: dict[str, list] = {}
     for s in samples:
         families.setdefault(s.name, []).append(s)
     lines: list[str] = []
@@ -64,24 +136,35 @@ def render_samples(samples: list[Sample]) -> str:
         head = rows[0]
         if head.help:
             lines.append(f"# HELP {name} {head.help}")
+        if isinstance(head, HistogramSample):
+            lines.append(f"# TYPE {name} histogram")
+            for s in rows:
+                for bound, cum in s.buckets:
+                    labels = {**s.labels, "le": _fmt_value(bound)}
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(labels)} {_fmt_value(cum)}"
+                    )
+                labels = {**s.labels, "le": "+Inf"}
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(labels)} "
+                    f"{_fmt_value(s.count)}"
+                )
+                lines.append(
+                    f"{name}_sum{_fmt_labels(s.labels)} {_fmt_value(s.sum)}"
+                )
+                lines.append(
+                    f"{name}_count{_fmt_labels(s.labels)} "
+                    f"{_fmt_value(s.count)}"
+                )
+            continue
         mtype = head.type if head.type in _TYPES else "untyped"
         lines.append(f"# TYPE {name} {mtype}")
         for s in rows:
             if s.value is None:
                 continue
-            label_str = ""
-            if s.labels:
-                inner = ",".join(
-                    f'{k}="{_escape_label(v)}"'
-                    for k, v in sorted(s.labels.items())
-                )
-                label_str = "{" + inner + "}"
-            value = float(s.value)
-            if value == int(value) and abs(value) < 1e15:
-                rendered = str(int(value))
-            else:
-                rendered = repr(value)
-            lines.append(f"{name}{label_str} {rendered}")
+            lines.append(
+                f"{name}{_fmt_labels(s.labels)} {_fmt_value(s.value)}"
+            )
     return "\n".join(lines) + "\n"
 
 

@@ -57,6 +57,8 @@ TRANSITION_TYPES = (
     "degradation",
     "fault",
     "retry",
+    "drift_alert",
+    "drift_clear",
 )
 
 _RECORDERS: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
@@ -178,6 +180,11 @@ class FlightRecorder:
             return None
         if type == "serve_worker_restart":
             return "worker_restart"
+        if type == "drift_alert":
+            # the answers moved off the training reference: the ring
+            # around that moment (which queries, which health state, any
+            # swap that landed) is exactly the retraining post-mortem
+            return "drift_alert"
         if type == "degradation":
             to = fields.get("to")
             if to == "breaker_open":

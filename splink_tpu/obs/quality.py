@@ -1,0 +1,532 @@
+"""Training-reference quality profiles + offline EM diagnostics.
+
+The Fellegi-Sunter parameters frozen into a :class:`~..serve.index.
+LinkageIndex` are estimates of a *training-time* distribution: the m/u
+probabilities are per-comparison interpretable quantities (fastLink,
+Enamorado et al., APSR 2019), so drift in the comparison-level mix a
+deployed model actually sees is directly diagnosable — IF the training
+distribution was recorded. This module captures that record at
+``build_index`` time:
+
+  * **per-comparison gamma-level histograms** — for every comparison
+    column, how often each agreement level (and the null pseudo-level
+    gamma = -1) occurred across the training pairs;
+  * **match-probability histogram** — the score distribution over
+    ``drift_sketch_bins`` equal bins of [0, 1];
+  * **per-column null rates and vocabulary mass** — how null-ridden each
+    comparison column was, and how concentrated its token vocabulary is
+    (the share of non-null rows covered by the 16 most frequent tokens).
+
+The histograms come from a jitted profile kernel over the training gammas
+(registered as ``quality_profile`` in the jaxpr audit and
+``quality_profile_sharded`` in the shard audit): per chunk it folds the
+gamma matrix into int32 scatter-add histograms — the same
+``int32_histogram`` dtype protocol as the pattern kernels (partial counts
+stay below 2^31 per chunk and flush to host int64) — and scores the chunk
+with ``match_probability`` for the score histogram. Under the pattern-id
+regime the (tiny) pattern matrix is histogrammed host-side with the
+pattern counts as weights — identical totals, no kernel needed.
+
+The profile persists as fingerprint-covered arrays inside the
+``LinkageIndex`` artifact; the serve tier (:mod:`.drift`) compares rolling
+windows of served traffic against it with PSI / Jensen-Shannon scores.
+
+The second half is offline: :func:`em_diagnostics` inspects a trained
+model for *identifiability* problems — levels with ~zero support (their
+m/u are the prior renormalised, not an estimate) and levels where m ~= u
+(the level moves no posterior and only adds noise) — plus the
+per-iteration lambda/m/u trajectories, rendered by
+``python -m splink_tpu.obs summarize``.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+import numpy as np
+
+logger = logging.getLogger("splink_tpu")
+
+#: tokens counted into the "top mass" vocabulary-concentration statistic
+VOCAB_TOP_K = 16
+
+#: |log2(m/u)| below this marks a level as uninformative (m within ~10% of
+#: u — the level shifts the posterior by less than a tenth of a bit)
+UNINFORMATIVE_LOG2_BF = math.log2(1.1)
+
+#: a level whose training support is below this fraction of the pair count
+#: (or zero) is flagged unidentifiable
+LOW_SUPPORT_FRACTION = 1e-6
+
+#: the match-population conditioning threshold shared by the profile
+#: kernel and the serve sketch kernel. Serving returns top-k MATCHES, so
+#: comparing served pairs against the all-pairs training distribution
+#: (dominated by non-matches) bakes in a huge selection bias; both sides
+#: therefore also histogram the pairs with match probability >= this, and
+#: drift scores compare the match-conditioned pair (like with like).
+MATCH_PROBABILITY = 0.5
+
+_PROFILE_CHUNK = 1 << 20  # pairs per device profile-kernel dispatch
+
+
+class QualityProfile:
+    """The training-reference distribution captured at index build.
+
+    ``gamma_hist`` is (C, W) int64 with W = max(num_levels) + 1: row c bin
+    0 counts gamma = -1 (null), bin 1 + l counts level l; bins past a
+    column's own num_levels + 1 are always zero. ``score_hist`` is
+    (bins,) int64 over equal bins of [0, 1] (p == 1.0 lands in the last
+    bin). The ``*_matched`` twins hold the same histograms restricted to
+    pairs with match probability >= :data:`MATCH_PROBABILITY` — the
+    population serve-time top-k answers are drawn from, and therefore the
+    side drift scores compare against."""
+
+    def __init__(
+        self,
+        *,
+        columns: list[str],
+        num_levels: list[int],
+        gamma_hist: np.ndarray,
+        score_hist: np.ndarray,
+        gamma_hist_matched: np.ndarray,
+        score_hist_matched: np.ndarray,
+        null_rates: dict,
+        vocab_mass: dict,
+        n_pairs: int,
+        n_rows: int,
+    ):
+        self.columns = list(columns)
+        self.num_levels = [int(v) for v in num_levels]
+        self.gamma_hist = np.asarray(gamma_hist, np.int64)
+        self.score_hist = np.asarray(score_hist, np.int64)
+        self.gamma_hist_matched = np.asarray(gamma_hist_matched, np.int64)
+        self.score_hist_matched = np.asarray(score_hist_matched, np.int64)
+        self.null_rates = dict(null_rates)
+        self.vocab_mass = dict(vocab_mass)
+        self.n_pairs = int(n_pairs)
+        self.n_rows = int(n_rows)
+
+    @property
+    def bins(self) -> int:
+        return int(self.score_hist.shape[0])
+
+    @property
+    def n_matched_pairs(self) -> int:
+        """Training pairs above the match-conditioning threshold — the
+        reference mass the serve drift channels compare against (zero
+        means drift scoring has no reference population and goes dark)."""
+        return int(self.score_hist_matched.sum())
+
+    def gamma_counts(self, c: int) -> np.ndarray:
+        """Column c's (num_levels + 1,) counts: [null, level 0, ...]."""
+        return self.gamma_hist[c, : self.num_levels[c] + 1]
+
+    def gamma_counts_matched(self, c: int) -> np.ndarray:
+        """Column c's counts over the match-conditioned pairs."""
+        return self.gamma_hist_matched[c, : self.num_levels[c] + 1]
+
+    # -- persistence (arrays ride the LinkageIndex npz payload, so the
+    #    artifact's arrays_sha256 fingerprint covers them; meta carries
+    #    the JSON-able rest) ---------------------------------------------
+
+    def to_meta(self) -> dict:
+        return {
+            "columns": self.columns,
+            "num_levels": self.num_levels,
+            "bins": self.bins,
+            "null_rates": {k: float(v) for k, v in self.null_rates.items()},
+            "vocab_mass": self.vocab_mass,
+            "n_pairs": self.n_pairs,
+            "n_rows": self.n_rows,
+        }
+
+    @classmethod
+    def from_meta(
+        cls,
+        meta: dict,
+        gamma_hist,
+        score_hist,
+        gamma_hist_matched=None,
+        score_hist_matched=None,
+    ) -> "QualityProfile":
+        gamma_hist = np.asarray(gamma_hist, np.int64)
+        score_hist = np.asarray(score_hist, np.int64)
+        if gamma_hist_matched is None:
+            # artifact predates the match-conditioned twins: drift scoring
+            # has no reference population for its channels and goes dark
+            # (psi None), but the profile still loads and reports
+            gamma_hist_matched = np.zeros_like(gamma_hist)
+        if score_hist_matched is None:
+            score_hist_matched = np.zeros_like(score_hist)
+        return cls(
+            columns=list(meta["columns"]),
+            num_levels=list(meta["num_levels"]),
+            gamma_hist=gamma_hist,
+            score_hist=score_hist,
+            gamma_hist_matched=gamma_hist_matched,
+            score_hist_matched=score_hist_matched,
+            null_rates=dict(meta.get("null_rates") or {}),
+            vocab_mass=dict(meta.get("vocab_mass") or {}),
+            n_pairs=int(meta.get("n_pairs") or 0),
+            n_rows=int(meta.get("n_rows") or 0),
+        )
+
+    def summary(self) -> dict:
+        """The JSON-able ``quality_profile`` telemetry event payload."""
+        return {
+            "columns": self.columns,
+            "num_levels": self.num_levels,
+            "bins": self.bins,
+            "n_pairs": self.n_pairs,
+            "n_matched_pairs": self.n_matched_pairs,
+            "n_rows": self.n_rows,
+            "null_rates": {k: round(float(v), 6)
+                           for k, v in self.null_rates.items()},
+            "vocab_mass": self.vocab_mass,
+        }
+
+
+def make_profile_fn(num_levels: tuple, bins: int):
+    """The jitted training-profile kernel: ``(G, params) -> hist`` where
+    ``hist`` is a flat int32 vector of TWO half-blocks, each laid out as C
+    blocks of W = max(L) + 1 gamma bins followed by ``bins`` score bins:
+    the first half counts every pair, the second only the pairs whose
+    match probability reaches :data:`MATCH_PROBABILITY` (the population
+    serve-time top-k answers are drawn from — the serve sketch kernel
+    applies the identical conditioning, so drift scores compare like with
+    like). Gamma = -1 (null) lands in a column's bin 0; scores come from
+    the shared ``match_probability`` expression, binned over [0, 1].
+    Non-matched pairs route to an out-of-bounds sentinel in the matched
+    half and drop inside the scatter. int32 BY PROTOCOL (the
+    pattern-kernel discipline): one dispatch covers at most
+    ``_PROFILE_CHUNK`` pairs and the caller flushes to host int64 between
+    chunks. Registered as ``quality_profile`` / ``quality_profile_sharded``
+    in the audits — pair-sharded inputs reduce into the replicated
+    histogram with exactly the scatter-add psums the committed baseline
+    pins."""
+    import jax.numpy as jnp
+
+    from ..models.fellegi_sunter import match_probability
+
+    levels = tuple(int(v) for v in num_levels)
+    n_cols = len(levels)
+    width = max(levels) + 1
+    half = n_cols * width + bins
+    size = 2 * half
+
+    def profile(G, params):
+        hist = jnp.zeros(size, jnp.int32)
+        p = match_probability(G, params)
+        matched = p >= p.dtype.type(MATCH_PROBABILITY)
+        oob = jnp.int32(size)  # dropped by mode="drop"
+        for c in range(n_cols):
+            # -1 (null) -> bin 0; levels past the column's own L cannot
+            # occur by construction of the gamma kernels
+            g = G[:, c].astype(jnp.int32) + jnp.int32(1 + c * width)
+            hist = hist.at[g].add(1, mode="drop")
+            hist = hist.at[
+                jnp.where(matched, g + jnp.int32(half), oob)
+            ].add(1, mode="drop")
+        sbin = jnp.clip(
+            (p * bins).astype(jnp.int32), jnp.int32(0), jnp.int32(bins - 1)
+        ) + jnp.int32(n_cols * width)
+        hist = hist.at[sbin].add(1, mode="drop")
+        hist = hist.at[
+            jnp.where(matched, sbin + jnp.int32(half), oob)
+        ].add(1, mode="drop")
+        return hist
+
+    return profile
+
+
+def _column_table_stats(table, settings) -> tuple[dict, dict]:
+    """(null_rates, vocab_mass) over the encoded reference table for the
+    comparison input columns (host-side; one pass per column)."""
+    from ..gammas import _comparison_input_column
+
+    null_rates: dict = {}
+    vocab_mass: dict = {}
+    n = max(table.n_rows, 1)
+    seen: set = set()
+    for col in settings["comparison_columns"]:
+        name = _comparison_input_column(col)
+        if name is None or name in seen:
+            continue
+        seen.add(name)
+        if name in table.strings:
+            sc = table.strings[name]
+            null_rates[name] = float(sc.null_mask.mean()) if table.n_rows else 0.0
+            tids = sc.token_ids[sc.token_ids >= 0]
+            if len(tids):
+                counts = np.bincount(tids, minlength=max(sc.n_tokens, 1))
+                top = np.sort(counts)[::-1][:VOCAB_TOP_K]
+                vocab_mass[name] = {
+                    "n_tokens": int(sc.n_tokens),
+                    "top_mass": round(float(top.sum() / counts.sum()), 6),
+                }
+        elif name in table.numerics:
+            nc = table.numerics[name]
+            null_rates[name] = float(nc.null_mask.mean()) if table.n_rows else 0.0
+    return null_rates, vocab_mass
+
+
+def capture_profile(linker, table=None) -> QualityProfile | None:
+    """Capture the training-reference profile from a trained linker.
+
+    Uses whichever training gammas the linker still holds: the resident
+    gamma matrix (chunked through the jitted profile kernel) or the
+    pattern matrix + counts of the pattern-id regime (host-side weighted
+    histograms — the pattern matrix is small by construction). Returns
+    None when neither exists (an untrained linker, or one whose gamma
+    state was already released) — the caller decides whether that is a
+    warning."""
+    import jax.numpy as jnp
+
+    from ..models.fellegi_sunter import FSParams, match_probability
+
+    settings = linker.settings
+    bins = int(settings.get("drift_sketch_bins", 16) or 16)
+    cols = settings["comparison_columns"]
+    from ..settings import comparison_column_name
+
+    names = [comparison_column_name(c) for c in cols]
+    levels = [int(c["num_levels"]) for c in cols]
+    width = max(levels) + 1
+    n_cols = len(cols)
+
+    G = getattr(linker, "_G", None)
+    counts = None
+    if G is None:
+        pat_counts = getattr(linker, "_pattern_counts", None)
+        program = getattr(linker, "_pattern_program", None)
+        if pat_counts is not None and program is not None:
+            G = program.patterns_matrix()
+            counts = np.asarray(pat_counts, np.int64)
+    if G is None or len(G) == 0:
+        return None
+
+    dtype = linker._float_dtype
+    lam, m, u, _ = linker.params.to_arrays(dtype=dtype)
+    params = FSParams(
+        lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)
+    )
+
+    gamma_hist = np.zeros((n_cols, width), np.int64)
+    score_hist = np.zeros(bins, np.int64)
+    gamma_hist_m = np.zeros((n_cols, width), np.int64)
+    score_hist_m = np.zeros(bins, np.int64)
+    if counts is not None:
+        # pattern regime: weighted host histograms over the pattern matrix
+        seen = counts > 0
+        Gp = np.asarray(G)[seen]
+        w = counts[seen]
+        p = np.asarray(match_probability(jnp.asarray(Gp), params))
+        matched = p >= MATCH_PROBABILITY
+        sbin = np.clip((p * bins).astype(np.int64), 0, bins - 1)
+        for c in range(n_cols):
+            g = np.clip(Gp[:, c].astype(np.int64) + 1, 0, width - 1)
+            gamma_hist[c] += np.bincount(
+                g, weights=w, minlength=width
+            ).astype(np.int64)[:width]
+            gamma_hist_m[c] += np.bincount(
+                g[matched], weights=w[matched], minlength=width
+            ).astype(np.int64)[:width]
+        score_hist += np.bincount(
+            sbin, weights=w, minlength=bins
+        ).astype(np.int64)[:bins]
+        score_hist_m += np.bincount(
+            sbin[matched], weights=w[matched], minlength=bins
+        ).astype(np.int64)[:bins]
+        n_pairs = int(counts.sum())
+    else:
+        import jax
+
+        half = n_cols * width + bins
+        fn = jax.jit(make_profile_fn(tuple(levels), bins))
+        for s in range(0, len(G), _PROFILE_CHUNK):
+            chunk = np.asarray(
+                fn(jnp.asarray(G[s : s + _PROFILE_CHUNK]), params)
+            ).astype(np.int64)
+            gamma_hist += chunk[: n_cols * width].reshape(n_cols, width)
+            score_hist += chunk[n_cols * width : half]
+            gamma_hist_m += chunk[half : half + n_cols * width].reshape(
+                n_cols, width
+            )
+            score_hist_m += chunk[half + n_cols * width :]
+        n_pairs = int(len(G))
+
+    if table is None:
+        table = linker._ensure_encoded()
+    null_rates, vocab_mass = _column_table_stats(table, settings)
+    return QualityProfile(
+        columns=names,
+        num_levels=levels,
+        gamma_hist=gamma_hist,
+        score_hist=score_hist,
+        gamma_hist_matched=gamma_hist_m,
+        score_hist_matched=score_hist_m,
+        null_rates=null_rates,
+        vocab_mass=vocab_mass,
+        n_pairs=n_pairs,
+        n_rows=int(table.n_rows),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Offline EM diagnostics
+# ---------------------------------------------------------------------------
+
+
+def em_diagnostics(
+    params,
+    gamma_hist: dict | None = None,
+    max_trajectory: int = 30,
+) -> dict:
+    """Identifiability diagnostics over a trained :class:`~..params.Params`.
+
+    Per comparison column and level: the final m/u probabilities, the
+    log2 Bayes factor, the training support (from ``gamma_hist`` — the
+    per-column level-count dict ``linker._gamma_histograms`` produces —
+    when available) and a warnings list:
+
+      * ``~zero support`` — the level occurred in (essentially) no
+        training pair, so its m/u are the renormalised prior, not an
+        estimate: scoring a serve-time pair at that level applies an
+        arbitrary weight.
+      * ``m~=u`` — the level barely moves the posterior
+        (|log2(m/u)| < ~0.14); it adds variance without signal, usually a
+        threshold that splits no real mass.
+
+    ``trajectory`` carries the per-iteration lambda plus per-column
+    max |delta m| / |delta u| from the Params iteration history (and the
+    full per-level m/u paths when the model is small enough to keep the
+    event compact). The caller publishes the result as an
+    ``em_diagnostics`` telemetry event and logs the warnings."""
+    settings = params.settings
+    from ..settings import comparison_column_name
+
+    lam, m, u, mask = params.to_arrays(dtype=np.float64)
+    cols = settings["comparison_columns"]
+    history = _params_history_arrays(params)
+    n_pairs = None
+    if gamma_hist:
+        totals = [sum(v) for v in gamma_hist.values() if v]
+        n_pairs = max(totals) if totals else None
+    out_cols = []
+    all_warnings = []
+    for c, col in enumerate(cols):
+        name = comparison_column_name(col)
+        n_levels = int(col["num_levels"])
+        support = None
+        if gamma_hist and name in gamma_hist:
+            # histogram layout: [null, level 0, ..., level L-1]
+            support = [int(v) for v in gamma_hist[name][1 : n_levels + 1]]
+        warnings_c = []
+        log2_bf = []
+        for lv in range(n_levels):
+            mv, uv = float(m[c, lv]), float(u[c, lv])
+            bf = (
+                math.log2(mv / uv)
+                if mv > 0 and uv > 0
+                else (math.inf if mv > uv else -math.inf if uv > mv else 0.0)
+            )
+            log2_bf.append(round(bf, 4) if math.isfinite(bf) else None)
+            if support is not None:
+                thresh = max((n_pairs or 0) * LOW_SUPPORT_FRACTION, 0.0)
+                if support[lv] <= thresh:
+                    warnings_c.append(
+                        f"level {lv}: ~zero training support "
+                        f"({support[lv]} pair(s)) — m/u at this level are "
+                        "the prior, not an estimate"
+                    )
+                    continue  # m~=u on an unsupported level is redundant
+            if math.isfinite(bf) and abs(bf) < UNINFORMATIVE_LOG2_BF:
+                warnings_c.append(
+                    f"level {lv}: m~=u (m={mv:.4g}, u={uv:.4g}, "
+                    f"{2**bf:.3f}x) — the level is uninformative"
+                )
+        all_warnings.extend(f"{name}: {w}" for w in warnings_c)
+        out_cols.append(
+            {
+                "name": name,
+                "num_levels": n_levels,
+                "m": [round(float(m[c, lv]), 6) for lv in range(n_levels)],
+                "u": [round(float(u[c, lv]), 6) for lv in range(n_levels)],
+                "log2_bf": log2_bf,
+                "support": support,
+                "warnings": warnings_c,
+            }
+        )
+    diag = {
+        "columns": out_cols,
+        "n_iterations": len(history["lam"]),
+        "lam": round(float(lam), 6),
+        "warnings": all_warnings,
+    }
+    diag["trajectory"] = _trajectory_payload(history, cols, max_trajectory)
+    return diag
+
+
+def _params_history_arrays(params) -> dict:
+    """lam + per-column m/u per archived iteration, newest last. The
+    Params history stores the params BEFORE each update (reference
+    layout), so appending the current params yields the full path."""
+    states = list(params.param_history) + [params.params]
+    lam = [float(s.get("λ", 0.0)) for s in states]
+    per_iter_mu = []
+    for s in states:
+        cols_mu = []
+        for entry in s.get("π", {}).values():
+            nl = int(entry["num_levels"])
+            cols_mu.append(
+                (
+                    [entry["prob_dist_match"][f"level_{lv}"]["probability"]
+                     for lv in range(nl)],
+                    [entry["prob_dist_non_match"][f"level_{lv}"]["probability"]
+                     for lv in range(nl)],
+                )
+            )
+        per_iter_mu.append(cols_mu)
+    return {"lam": lam, "mu": per_iter_mu}
+
+
+def _trajectory_payload(history, cols, max_trajectory: int) -> dict:
+    """Compact per-iteration trajectory: lambda path + per-column max
+    parameter movement; full per-level m/u paths only when small (the
+    event must stay a few KB). Long runs subsample to ``max_trajectory``
+    evenly spaced iterations, endpoints kept."""
+    lam = history["lam"]
+    mu = history["mu"]
+    n_states = len(lam)
+    idx = list(range(n_states))
+    subsampled = n_states > max_trajectory + 1
+    if subsampled:
+        step = (n_states - 1) / max_trajectory
+        idx = sorted({0, n_states - 1}
+                     | {int(round(i * step)) for i in range(max_trajectory)})
+    moves_m, moves_u = [], []
+    for i in range(1, n_states):
+        dm = du = 0.0
+        for (m0, u0), (m1, u1) in zip(mu[i - 1], mu[i]):
+            if len(m0) == len(m1):
+                dm = max(dm, max(abs(a - b) for a, b in zip(m0, m1)))
+                du = max(du, max(abs(a - b) for a, b in zip(u0, u1)))
+        moves_m.append(round(dm, 8))
+        moves_u.append(round(du, 8))
+    payload = {
+        "lam": [round(lam[i], 6) for i in idx],
+        "iterations": idx,
+        "max_move_m": moves_m[-max_trajectory:],
+        "max_move_u": moves_u[-max_trajectory:],
+        "subsampled": subsampled,
+    }
+    n_values = sum(len(m0) for m0, _ in mu[0]) if mu else 0
+    if n_states * n_values * 2 <= 4096:
+        payload["m"] = [
+            [[round(v, 6) for v in m0] for m0, _ in mu[i]] for i in idx
+        ]
+        payload["u"] = [
+            [[round(v, 6) for v in u0] for _, u0 in mu[i]] for i in idx
+        ]
+    return payload

@@ -233,6 +233,17 @@ class RunContext:
         self._em_prev = (m, u)
         self._em_last_mono = now
 
+    # -- structured one-off events ----------------------------------------
+
+    @_never_raise
+    def emit_event(self, type: str, **fields) -> None:
+        """Emit one typed event into this run's record (no-op when
+        disabled). For structured payloads readers filter by type —
+        ``em_diagnostics`` rides this — as opposed to :meth:`record`,
+        whose payloads live inside the metrics snapshot."""
+        if self.enabled:
+            self.sink.emit(type, **fields)
+
     # -- metrics convenience (no-ops when disabled) ------------------------
 
     def count(self, name: str, n: float = 1) -> None:

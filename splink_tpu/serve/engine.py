@@ -314,7 +314,8 @@ class QueryEngine:
 
     def __init__(self, index, *, top_k: int | None = None, policy=None,
                  telemetry=None, brownout_top_k: int | None = None,
-                 fused: bool | None = None, aot_dir=None):
+                 fused: bool | None = None, aot_dir=None,
+                 sketch: bool | None = None):
         from .bucketing import BucketPolicy, bucket_for
 
         self.index = index
@@ -364,6 +365,26 @@ class QueryEngine:
             else None
         )
         self._obs = telemetry
+        # Serve-time drift sketch (obs/drift.py): device-side gamma/score
+        # histogram accumulation folded onto the fused-kernel outputs of
+        # every full-service batch. Requires BOTH the quality_profile
+        # setting and a profiled index — a legacy (profile-less) artifact
+        # serves unchanged and drift reporting states why it is dark.
+        # ``sketch=`` overrides the settings gate (like ``fused=``) so one
+        # profiled index can serve sketch-on and sketch-off engines
+        # side by side (the bench's interleaved overhead tier); it never
+        # conjures a sketch for a profile-less index.
+        self.sketch = None
+        self._sketch_override = sketch  # forwarded across swap_index
+        want_sketch = (
+            bool(settings.get("quality_profile"))
+            if sketch is None
+            else bool(sketch)
+        )
+        if want_sketch and index.profile is not None:
+            from ..obs.drift import ServeSketch
+
+            self.sketch = ServeSketch(index, index.profile)
         # kind ("full" | "brownout") -> jitted fused program (stable
         # identity; only used through .lower() for AOT-style compilation)
         self._jits: dict = {}
@@ -448,9 +469,11 @@ class QueryEngine:
             return top_p, top_rows, top_valid, n_cand
 
         # donate the per-request buffers (query rows + buckets); the
-        # CPU backend ignores donation with a warning, so gate it
+        # CPU backend ignores donation with a warning, so gate it — and
+        # the drift sketch re-reads the query upload AFTER the scoring
+        # kernel consumed it, so sketching keeps the buffers live
         donate = ()
-        if jax.default_backend() not in ("cpu",):
+        if jax.default_backend() not in ("cpu",) and self.sketch is None:
             donate = (1, 2)
         self._donate = donate
         return functools.partial(
@@ -548,6 +571,10 @@ class QueryEngine:
             "query_buckets": list(self.policy.query_buckets),
             "candidate_buckets": list(self.policy.candidate_buckets),
             "fused": self.fused,
+            # sketching flips buffer donation off, which changes the
+            # compiled executable — a sidecar saved either way must not
+            # serve the other configuration
+            "sketch": self.sketch is not None,
         }
 
     def _aot_ready_store(self):
@@ -651,6 +678,15 @@ class QueryEngine:
                     "brown-out tier is disabled (serve_brownout_top_k=0)"
                 )
             batch = self.encode(df)
+            if self.sketch is not None:
+                # host-side sketch counters from the already-encoded
+                # batch (OOV / null-key / approx-fallback rates) — no
+                # device work; brown-out batches only count as degraded
+                # (their reduced top-k would skew the histograms)
+                if degraded:
+                    self.sketch.note_degraded(batch.n)
+                else:
+                    self.sketch.note_batch(df, batch, len(self.index.rules))
             if approx_out is not None:
                 approx_out.append(
                     batch.approx_used
@@ -721,8 +757,9 @@ class QueryEngine:
         qb_pad = np.empty((len(index.gather_units), q_pad), np.int32)
         qb_pad[:, :n] = qb
         dev = index.device_state()
+        packed_dev = jnp.asarray(packed_pad)
         top_p, top_rows, top_valid, n_cand = kernel(
-            jnp.asarray(packed_pad),
+            packed_dev,
             jnp.asarray(qb_pad),
             np.int32(n),
             dev["starts"],
@@ -732,6 +769,14 @@ class QueryEngine:
             dev["packed"],
             dev["params"],
         )
+        if self.sketch is not None and not degraded:
+            # fold the batch into the device drift accumulator: an async
+            # dispatch over the already-device-resident outputs — nothing
+            # is fetched, the hot path gains no host sync (padding rows
+            # carry top_valid=False and drop inside the scatter)
+            self.sketch.update(
+                packed_dev, dev["packed"], top_rows, top_valid, top_p
+            )
         (self._warmed_brownout if degraded else self._warmed).add(
             (q_pad, capacity)
         )
@@ -840,6 +885,15 @@ class QueryEngine:
             ]
             for q_pad, capacity in brownout_combos:
                 self._warm_one(q_pad, capacity, degraded=True)
+        if self.sketch is not None:
+            # pre-compile the drift-sketch program for every query bucket
+            # (one dummy all-invalid dispatch per shape), so sketching
+            # adds zero steady-state recompiles. These compiles are ON
+            # TOP of the scoring combinations — sketch-on replicas show
+            # compiles > combinations here, never in steady state.
+            with self._swap_lock:
+                for q_pad in self.policy.query_buckets:
+                    self.sketch.warm(q_pad, self.top_k)
         s1 = compile_stats()
         stats = {
             "combinations": len(combos) + len(brownout_combos),
@@ -921,6 +975,24 @@ class QueryEngine:
     def generation(self) -> int:
         """How many hot-swaps this engine has committed."""
         return self._generation
+
+    # -- drift sketch drain ---------------------------------------------
+
+    def drift_drain_due(self, cadence_s: float) -> bool:
+        """Whether the drift accumulator is due a drain (no lock, no
+        device work — a cheap poll for the service worker/watchdog)."""
+        return self.sketch is not None and self.sketch.drain_due(cadence_s)
+
+    def drain_drift(self):
+        """Fetch + reset the drift accumulator into one window sketch
+        (:class:`~..obs.drift.WindowSketch`), or None when sketching is
+        off. The sketch's ONLY device fetch — called between batches by
+        the service worker or from the watchdog when idle, never inside a
+        dispatch."""
+        if self.sketch is None:
+            return None
+        with self._swap_lock:
+            return self.sketch.drain()
 
     # -- parity probes & index hot-swap ---------------------------------
 
@@ -1013,6 +1085,7 @@ class QueryEngine:
                 telemetry=self._obs,
                 brownout_top_k=self.brownout_top_k,
                 fused=self.fused,
+                sketch=self._sketch_override,
                 aot_dir=pending_aot,
             )
             warm = pending.warmup()
@@ -1047,6 +1120,9 @@ class QueryEngine:
             self._aot_store = pending._aot_store
             self._warmed = pending._warmed
             self._warmed_brownout = pending._warmed_brownout
+            # the drift sketch binds to the index's profile and device
+            # residency; the pending engine built (and warmed) its own
+            self.sketch = pending.sketch
             if new_probes is not None:
                 self._probes = new_probes
             elif self._probes is not probes:

@@ -301,6 +301,7 @@ class LinkageIndex:
         tf_tables: dict,
         state_hash: str,
         approx: ApproxServe | None = None,
+        profile=None,
     ):
         self.settings = settings
         self.dtype = dtype  # "float32" | "float64"
@@ -317,6 +318,13 @@ class LinkageIndex:
         self.tf_tables = tf_tables  # name -> (n_tokens,) int64 counts
         self.state_hash = state_hash
         self.approx = approx  # LSH fallback bucket path (None = exact only)
+        # training-reference quality profile (obs/quality.py) — None on
+        # profile-less artifacts (quality_profile off, or a legacy index):
+        # drift reporting goes dark with a reason, serving is unchanged.
+        # Deliberately NOT part of content_fingerprint(): the profile is
+        # observability data, no compiled executable reads it, so adding
+        # one must not invalidate an AOT sidecar.
+        self.profile = profile
         self._device = None  # memoised device-resident arrays
         self._vocab_maps: dict | None = None
         self._content_fp: str | None = None
@@ -640,6 +648,17 @@ class LinkageIndex:
                 arrays[f"approx{b}_row_bucket"] = band.row_bucket
         for name, counts in self.tf_tables.items():
             arrays[f"tf_{name}"] = counts
+        if self.profile is not None:
+            # inside the npz payload, so arrays_sha256 — the fingerprint
+            # load_index verifies — covers the profile arrays too
+            arrays["profile_gamma_hist"] = self.profile.gamma_hist
+            arrays["profile_score_hist"] = self.profile.score_hist
+            arrays["profile_gamma_hist_matched"] = (
+                self.profile.gamma_hist_matched
+            )
+            arrays["profile_score_hist_matched"] = (
+                self.profile.score_hist_matched
+            )
         if self.unique_id.dtype != object:
             arrays["unique_id"] = self.unique_id
         np.savez_compressed(buf, **arrays)
@@ -686,6 +705,9 @@ class LinkageIndex:
                         for band in self.approx.band_index
                     ],
                 }
+            ),
+            "profile": (
+                None if self.profile is None else self.profile.to_meta()
             ),
             "n_rows": self.n_rows,
             "unique_id_json": (
@@ -797,6 +819,27 @@ def load_index(directory: str | os.PathLike) -> LinkageIndex:
                 for b, bo in enumerate(am["bucket_of"])
             ],
         )
+    profile = None
+    pm = meta.get("profile")
+    if pm is not None:
+        from ..obs.quality import QualityProfile
+
+        files = set(npz.files)
+        profile = QualityProfile.from_meta(
+            pm,
+            npz["profile_gamma_hist"],
+            npz["profile_score_hist"],
+            (
+                npz["profile_gamma_hist_matched"]
+                if "profile_gamma_hist_matched" in files
+                else None
+            ),
+            (
+                npz["profile_score_hist_matched"]
+                if "profile_score_hist_matched" in files
+                else None
+            ),
+        )
     return LinkageIndex(
         settings=settings,
         dtype=meta["dtype"],
@@ -813,6 +856,7 @@ def load_index(directory: str | os.PathLike) -> LinkageIndex:
         tf_tables=tf_tables,
         state_hash=meta["state_hash"],
         approx=approx,
+        profile=profile,
     )._rebuild_layout()
 
 
@@ -899,6 +943,30 @@ def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
         if settings.get("approx_blocking"):
             approx = _build_approx_serve(table, settings)
 
+        # training-reference quality profile (obs/quality.py): the drift
+        # observatory's baseline, captured from whichever training gammas
+        # the linker still holds and published as a quality_profile event
+        profile = None
+        if settings.get("quality_profile"):
+            from ..obs.events import publish
+            from ..obs.quality import capture_profile
+
+            profile = capture_profile(linker, table)
+            if profile is None:
+                import warnings
+
+                warnings.warn(
+                    "quality_profile is on but the linker holds no "
+                    "training gammas (train with estimate_parameters / "
+                    "get_scored_comparisons in this process before "
+                    "export_index); the index ships WITHOUT a reference "
+                    "profile and serve-time drift reporting will be dark."
+                )
+            else:
+                publish("quality_profile", **profile.summary())
+                if getattr(linker, "_obs", None) is not None:
+                    linker._obs.record("quality_profile", profile.summary())
+
         from ..term_frequencies import term_frequency_columns
 
         tf_tables = {}
@@ -940,6 +1008,7 @@ def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
             tf_tables=tf_tables,
             state_hash=state_hash,
             approx=approx,
+            profile=profile,
         )
     finally:
         if clear_caches:

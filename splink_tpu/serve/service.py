@@ -240,6 +240,10 @@ class LinkageService:
 
             install_compile_monitor()  # the per-batch compile split
         self._slo = SLOTracker(objective=slo_objective)
+        # -- drift observatory (obs/drift.py): present only when the
+        # engine sketches (quality_profile on AND a profiled index) ------
+        self._drift_alert_active = False
+        self._drift = self._make_drift_monitor()
         self._exposition = None
         port = int(
             exposition_port
@@ -314,6 +318,9 @@ class LinkageService:
             self._inflight = []
         for entry in stragglers:
             self._resolve_shed(entry[1], "closed", entry[4])
+        # final drift drain: the tail window must not die in the device
+        # accumulator (short-lived services still report their drift)
+        self._drift_tick(force=True)
         if self._exposition is not None:
             self._exposition.close()
             self._exposition = None
@@ -479,6 +486,9 @@ class LinkageService:
                 if batch is None:
                     return
                 self._serve_batch(batch)
+                # drift drains ride BETWEEN batches (one bounded device
+                # fetch per drain cadence, never inside a dispatch)
+                self._drift_tick()
         except Exception:  # noqa: BLE001 - a dying worker must not spam stderr
             logger.exception(
                 "serve worker thread died; the watchdog will shed its "
@@ -850,6 +860,106 @@ class LinkageService:
                         )
         # 3. health evaluation from live signals
         self._maybe_evaluate_health()
+        # 4. drift windows advance even when traffic stops (an idle
+        # service must still age out its rolling drift windows)
+        self._drift_tick()
+
+    # -- drift observatory ----------------------------------------------
+
+    def _make_drift_monitor(self):
+        sketch = getattr(self.engine, "sketch", None)
+        if sketch is None:
+            return None
+        from ..obs.drift import DriftMonitor
+
+        s = self._settings
+        return DriftMonitor(
+            self.engine.index.profile,
+            window_s=float(s.get("drift_window_s", 60.0) or 60.0),
+            alert_psi=float(s.get("drift_alert_psi", 0.25) or 0.0),
+        )
+
+    def _drift_tick(self, force: bool = False) -> None:
+        """Drain the engine's drift accumulator when a window bucket is
+        due, score the rolling windows and drive the two-window alert
+        state machine. Never raises into the worker/watchdog."""
+        drift = self._drift
+        if drift is None:
+            return
+        try:
+            if not force and not self.engine.drift_drain_due(
+                drift.drain_cadence_s
+            ):
+                return
+            window = self.engine.drain_drift()
+            if window is None:
+                return
+            drift.observe(window)
+            from ..obs.events import publish
+
+            short = drift.window_drift(drift.window_s)
+            if short is not None:
+                publish(
+                    "drift_window",
+                    replica=self.name,
+                    window_s=short["window_s"],
+                    queries=short["queries"],
+                    pairs=short["pairs"],
+                    served_pairs=short["served_pairs"],
+                    match_yield=short["match_yield"],
+                    max_psi=short["max_psi"],
+                    channels={
+                        ch: v.get("psi")
+                        for ch, v in short["channels"].items()
+                    },
+                    oov_rate=short["oov_rate"],
+                    exact_miss_rate=short["exact_miss_rate"],
+                    approx_rate=short["approx_rate"],
+                )
+            self._evaluate_drift_alerts(drift, short=short)
+        except Exception as e:  # noqa: BLE001 - obs must not break serving
+            logger.warning("drift tick failed: %s", e)
+
+    def _evaluate_drift_alerts(self, drift, short=None) -> None:
+        """Alert transitions: entering publishes one ``drift_alert``
+        event (which also triggers a flight-recorder dump — the incident
+        artifact for "the answers changed"); leaving publishes
+        ``drift_clear``. Level-triggered state, edge-triggered events."""
+        from ..obs.events import publish
+
+        fired = drift.alerts(short=short)
+        if fired and not self._drift_alert_active:
+            self._drift_alert_active = True
+            publish("drift_alert", replica=self.name, alerts=fired)
+            logger.warning(
+                "serve drift alert: %s exceed PSI %.3g over both the "
+                "%.0fs and %.0fs windows — the served distribution has "
+                "moved off the training reference (retrain trigger)",
+                ", ".join(a["channel"] for a in fired),
+                drift.alert_psi, drift.window_s, drift.long_window_s,
+            )
+        elif not fired and self._drift_alert_active:
+            self._drift_alert_active = False
+            publish("drift_clear", replica=self.name)
+            logger.info("serve drift alert cleared (replica %s)", self.name)
+
+    def drift_snapshot(self) -> dict:
+        """The drift observatory's live report: per-channel PSI/JS over
+        the short and long rolling windows vs the training-reference
+        profile, serve-side OOV/approx/null rates, fired alerts. A
+        profile-less index (or quality_profile off) reports
+        ``reference: False`` with the reason — it never raises."""
+        from ..obs.drift import no_reference_snapshot
+
+        if self._drift is None:
+            if getattr(self.engine.index, "profile", None) is None:
+                return no_reference_snapshot()
+            return no_reference_snapshot(
+                "drift sketching disabled (quality_profile off)"
+            )
+        snap = self._drift.snapshot()
+        snap["alert_active"] = self._drift_alert_active
+        return snap
 
     # -- health ---------------------------------------------------------
 
@@ -944,12 +1054,21 @@ class LinkageService:
 
         self._swap_in_progress = True
         try:
-            return self.engine.swap_index(source, refresh_probes=refresh_probes)
+            stats = self.engine.swap_index(
+                source, refresh_probes=refresh_probes
+            )
         finally:
             self._swap_in_progress = False
             with self._signals_lock:
                 self._last_compile_s = compile_totals()[1]
                 self._stall_accum = 0.0
+        # the committed index may carry a different (or no) reference
+        # profile: rebind the drift observatory to the new engine state —
+        # old windows describe the old reference and must not score
+        # against the new one
+        self._drift = self._make_drift_monitor()
+        self._drift_alert_active = False
+        return stats
 
     # -- reporting ------------------------------------------------------
 
@@ -1094,5 +1213,65 @@ class LinkageService:
                     "splink_serve_traces_closed_total", n,
                     {**replica, "outcome": outcome}, "counter",
                     "Closed span trees by outcome",
+                ))
+        out.extend(self._drift_samples(replica))
+        return out
+
+    def _drift_samples(self, replica: dict) -> list:
+        """Drift-observatory series: reference presence, per-channel PSI
+        over the short window, serve-side rates, the alert gauge and the
+        served-score distribution as a NATIVE Prometheus histogram
+        (``_bucket``/``_sum``/``_count`` with cumulative ``le`` bounds)."""
+        from ..obs.exposition import Sample, histogram_from_counts
+
+        drift = self.drift_snapshot()
+        out = [Sample(
+            "splink_serve_drift_reference",
+            1.0 if drift.get("reference") else 0.0, replica, "gauge",
+            "Training-reference quality profile present and sketching on",
+        )]
+        if not drift.get("reference"):
+            return out
+        out.append(Sample(
+            "splink_serve_drift_alert",
+            1.0 if drift.get("alerts") else 0.0, replica, "gauge",
+            "Two-window PSI drift alert firing",
+        ))
+        short = drift.get("short") or {}
+        for channel, v in sorted((short.get("channels") or {}).items()):
+            if v.get("psi") is not None:
+                out.append(Sample(
+                    "splink_serve_drift_psi", v["psi"],
+                    {**replica, "channel": channel}, "gauge",
+                    "PSI of the rolling short window vs the training "
+                    "reference, per channel",
+                ))
+        for key, metric in (
+            ("oov_rate", "splink_serve_drift_oov_rate"),
+            ("exact_miss_rate", "splink_serve_drift_exact_miss_rate"),
+            ("approx_rate", "splink_serve_drift_approx_rate"),
+        ):
+            if short.get(key) is not None:
+                out.append(Sample(
+                    metric, short[key], replica, "gauge",
+                    "Serve-side rate over the short drift window",
+                ))
+        if short.get("match_yield") is not None:
+            out.append(Sample(
+                "splink_serve_drift_match_yield", short["match_yield"],
+                replica, "gauge",
+                "Matched top-k pairs / served top-k pairs over the short "
+                "drift window (collapse = catastrophic upstream drift)",
+            ))
+        monitor = self._drift
+        if monitor is not None and monitor.profile is not None:
+            counts = monitor.score_window_counts(monitor.window_s)
+            if counts is not None and counts.sum() > 0:
+                bins = monitor.profile.bins
+                edges = [(i + 1) / bins for i in range(bins)]
+                out.append(histogram_from_counts(
+                    "splink_serve_drift_score", counts, edges, replica,
+                    "Served match-probability distribution over the short "
+                    "drift window (sum approximated from bin midpoints)",
                 ))
         return out

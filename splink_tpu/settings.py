@@ -89,6 +89,10 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "serve_trace_sample_rate",
     "obs_exposition_port",
     "obs_flight_records",
+    "quality_profile",
+    "drift_sketch_bins",
+    "drift_window_s",
+    "drift_alert_psi",
 ]
 
 

@@ -1,0 +1,1038 @@
+"""Linkage quality observatory (obs/quality.py + obs/drift.py).
+
+Covers the full loop: training-reference profile capture (device kernel
+vs host oracle, matched-twin conditioning), LinkageIndex persistence
+(fingerprint-covered round-trip + legacy profile-less compatibility),
+the serve-time drift sketch (parity with the returned results, drained
+off the hot path, zero steady-state recompiles), PSI / Jensen-Shannon
+math, the two-window alert state machine (injected clock — PSI channels
+and the match-yield collapse catch-all), the service wiring (drift
+events, alert edge-triggering, flight-recorder dump on alert), the
+Prometheus exposition (native histogram series, scrape format), the CLI
+renderers' torn-record tolerance, EM identifiability diagnostics, and
+the falsifiability twins of the new audit-registry kernels.
+"""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu import Splink
+from splink_tpu.obs.cli import drift_events_report, summarize_events
+from splink_tpu.obs.drift import (
+    DriftMonitor,
+    ServeSketch,
+    WindowSketch,
+    js_divergence,
+    no_reference_snapshot,
+    psi,
+)
+from splink_tpu.obs.events import publish, register_ambient, unregister_ambient
+from splink_tpu.obs.exposition import (
+    HistogramSample,
+    Sample,
+    histogram_from_counts,
+    render_samples,
+)
+from splink_tpu.obs.flight import FlightRecorder
+from splink_tpu.obs.quality import (
+    MATCH_PROBABILITY,
+    QualityProfile,
+    em_diagnostics,
+    make_profile_fn,
+)
+from splink_tpu.serve import (
+    BucketPolicy,
+    IndexMismatchError,
+    LinkageService,
+    QueryEngine,
+    load_index,
+)
+
+
+def twin_df(n_base=200, seed=11):
+    """Base records + one noisy duplicate each: true-match structure. The
+    duplicate keeps dob/surname, mutates first_name 10% of the time and
+    city 30% of the time — so the matched population carries VARIANCE in
+    the city channel (a serve-time city drift shifts the matched gamma
+    mix without killing the matches, which is what makes a PSI channel
+    testable at all)."""
+    rng = np.random.default_rng(seed)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily",
+              "jack", "poppy", "harry"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    cities = ["london", "leeds", "york", "bath"]
+    rows = []
+    uid = 0
+    for _ in range(n_base):
+        fn = str(rng.choice(firsts))
+        sn = str(rng.choice(lasts))
+        dob = f"19{rng.integers(40, 99)}"
+        city = str(rng.choice(cities))
+        rows.append((uid, fn, sn, dob, city))
+        uid += 1
+        fn2 = fn if rng.random() < 0.9 else fn[:-1] + "x"
+        city2 = city if rng.random() < 0.7 else str(rng.choice(cities))
+        rows.append((uid, fn2, sn, dob, city2))
+        uid += 1
+    return pd.DataFrame(
+        rows, columns=["unique_id", "first_name", "surname", "dob", "city"]
+    )
+
+
+def drift_settings(**over):
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+            {
+                "col_name": "city",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob"],
+        "max_iterations": 10,
+        "quality_profile": True,
+        "drift_window_s": 0.5,
+        "drift_alert_psi": 0.25,
+    }
+    s.update(over)
+    return s
+
+
+@pytest.fixture(scope="module")
+def trained():
+    df = twin_df()
+    linker = Splink(drift_settings(), df=df)
+    linker.get_scored_comparisons()
+    index = linker.export_index()
+    return df, linker, index
+
+
+@pytest.fixture(scope="module")
+def engine(trained):
+    _, _, index = trained
+    eng = QueryEngine(
+        index, top_k=8, policy=BucketPolicy((16, 64), (64, 256))
+    )
+    eng.warmup()
+    return eng
+
+
+class _Capture:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, type, **fields):
+        self.events.append({"type": type, **fields})
+
+    def of(self, type):
+        return [e for e in self.events if e["type"] == type]
+
+
+@pytest.fixture()
+def capture():
+    cap = _Capture()
+    register_ambient(cap)
+    yield cap
+    unregister_ambient(cap)
+
+
+def _queries(df, n=64, state=3):
+    return (
+        df.sample(n, random_state=state)
+        .drop(columns=["unique_id"])
+        .reset_index(drop=True)
+    )
+
+
+def _drive(engine, mon, df, n_batches, mutate=None, seed=7, step=1.0,
+           clock=None):
+    """Run query batches through the engine, draining one sketch window
+    into the monitor per batch (the injected clock advances ``step``)."""
+    rng = np.random.default_rng(seed)
+    for i in range(n_batches):
+        q = _queries(df, state=int(rng.integers(1 << 30)))
+        if mutate is not None:
+            mutate(q, i)
+        engine.query(q)
+        if clock is not None:
+            clock[0] += step
+        mon.observe(engine.drain_drift())
+
+
+# ---------------------------------------------------------------------------
+# PSI / JS math
+# ---------------------------------------------------------------------------
+
+
+def test_psi_js_math():
+    a = np.array([50, 30, 20])
+    assert psi(a, a * 7) == pytest.approx(0.0, abs=1e-12)
+    assert js_divergence(a, a * 3) == pytest.approx(0.0, abs=1e-12)
+    b = np.array([20, 30, 50])
+    d = psi(a, b)
+    assert d is not None and d > 0
+    assert psi(b, a) == pytest.approx(d)  # PSI is symmetric in p<->q
+    j = js_divergence(a, b)
+    assert j is not None and 0 < j < 1
+    assert js_divergence(a, b) == pytest.approx(js_divergence(b, a))
+    # disjoint distributions: finite under smoothing, JS near its bound
+    c = np.array([0, 0, 100])
+    e = np.array([100, 0, 0])
+    assert np.isfinite(psi(c, e))
+    assert js_divergence(c, e) == pytest.approx(1.0, abs=0.05)
+    # either side empty -> None, never a crash or an infinity
+    assert psi(np.zeros(3), b) is None
+    assert psi(b, np.zeros(3)) is None
+    assert js_divergence(np.zeros(3), np.zeros(3)) is None
+
+
+# ---------------------------------------------------------------------------
+# two-window alert state machine (synthetic windows, injected clock)
+# ---------------------------------------------------------------------------
+
+
+def _profile_2col(bins=8):
+    gamma = np.array([[10, 60, 30, 0], [5, 45, 50, 0]], np.int64)
+    score = np.linspace(10, 80, bins).astype(np.int64)
+    return QualityProfile(
+        columns=["a", "b"],
+        num_levels=[3, 3],
+        gamma_hist=gamma * 4,
+        score_hist=score * 4,
+        gamma_hist_matched=gamma,
+        score_hist_matched=score,
+        null_rates={"a": 0.1},
+        vocab_mass={},
+        n_pairs=int(gamma[0].sum()) * 4,
+        n_rows=50,
+    )
+
+
+def _window(t, gamma, score, queries=10, score_all=None, **counters):
+    c = {"queries": queries, "oov": 0, "exact_miss": 0, "approx_served": 0,
+         "degraded": 0, "nulls": np.zeros(2, np.int64)}
+    c.update(counters)
+    return WindowSketch(t, np.asarray(gamma, np.int64),
+                        np.asarray(score, np.int64), c,
+                        None if score_all is None else
+                        np.asarray(score_all, np.int64))
+
+
+def test_two_window_alert_needs_both_windows():
+    """A short-window spike alone must NOT alert; the alert fires only
+    when the long window confirms, and clears when the drift stops."""
+    prof = _profile_2col()
+    clock = [0.0]
+    mon = DriftMonitor(prof, window_s=4.0, alert_psi=0.25,
+                       clock=lambda: clock[0])
+    ref_g = prof.gamma_hist_matched
+    ref_s = prof.score_hist_matched
+    drift_g = ref_g[:, ::-1].copy()  # reversed level mix: large PSI
+    # 16 reference-shaped windows fill the long window cleanly
+    for _ in range(16):
+        clock[0] += 1.0
+        mon.observe(_window(0, ref_g, ref_s, score_all=ref_s))
+    assert mon.alerts() == []
+    # 2 drifted windows: short window moves, long window still healthy
+    for _ in range(2):
+        clock[0] += 1.0
+        mon.observe(_window(0, drift_g, ref_s, score_all=ref_s))
+    short = mon.window_drift(mon.window_s)
+    assert short["channels"]["gamma:a"]["psi"] > 0.25
+    assert mon.alerts() == [], "short-only drift must not alert"
+    # keep drifting until the long window confirms
+    for _ in range(18):
+        clock[0] += 1.0
+        mon.observe(_window(0, drift_g, ref_s, score_all=ref_s))
+    fired = mon.alerts()
+    assert {a["channel"] for a in fired} >= {"gamma:a", "gamma:b"}
+    a = fired[0]
+    assert a["short_psi"] >= 0.25 and a["long_psi"] >= 0.25
+    assert a["window_s"] == 4.0 and a["long_window_s"] == 20.0
+    # windows age out after the traffic stops -> alerts clear
+    clock[0] += 100.0
+    mon.observe(_window(0, np.zeros_like(ref_g), np.zeros_like(ref_s)))
+    assert mon.alerts() == []
+
+
+def test_yield_collapse_alert_catches_dark_psi():
+    """Drift so severe the match population vanishes leaves every PSI
+    channel dark (nothing matched to histogram) — the match_yield
+    collapse alert is the catch-all that still fires."""
+    prof = _profile_2col()
+    clock = [0.0]
+    mon = DriftMonitor(prof, window_s=4.0, alert_psi=0.25,
+                       clock=lambda: clock[0])
+    ref_g = prof.gamma_hist_matched
+    ref_s = prof.score_hist_matched
+    zero_g = np.zeros_like(ref_g)
+    zero_s = np.zeros_like(ref_s)
+    for _ in range(16):
+        clock[0] += 1.0
+        mon.observe(_window(0, ref_g, ref_s, score_all=ref_s))
+    for _ in range(5):  # the short window fully collapses: served, 0 matched
+        clock[0] += 1.0
+        mon.observe(_window(0, zero_g, zero_s, score_all=ref_s))
+    short = mon.window_drift(mon.window_s)
+    assert short["channels"]["gamma:a"]["psi"] is None, "PSI went dark"
+    assert short["match_yield"] == 0.0
+    fired = mon.alerts()
+    assert [a["channel"] for a in fired] == ["match_yield"]
+    assert fired[0]["short_yield"] == 0.0 and fired[0]["long_yield"] > 0
+    # total OOV (nothing served at all, queries still arriving) also fires
+    mon2 = DriftMonitor(prof, window_s=4.0, alert_psi=0.25,
+                        clock=lambda: clock[0])
+    for _ in range(16):
+        clock[0] += 1.0
+        mon2.observe(_window(0, ref_g, ref_s, score_all=ref_s))
+    for _ in range(5):
+        clock[0] += 1.0
+        mon2.observe(_window(0, zero_g, zero_s, score_all=zero_s,
+                             queries=20, oov=20))
+    assert [a["channel"] for a in mon2.alerts()] == ["match_yield"]
+
+
+def test_no_reference_states_are_first_class():
+    mon = DriftMonitor(None)
+    snap = mon.snapshot()
+    assert snap["reference"] is False and "no reference profile" in snap["reason"]
+    assert mon.alerts() == [] and mon.window_drift(60.0) is None
+    assert no_reference_snapshot("because")["reason"] == "because"
+    # a profile whose matched twins are empty (legacy artifact without
+    # them): channels go dark instead of comparing against nothing
+    prof = _profile_2col()
+    legacy = QualityProfile.from_meta(
+        prof.to_meta(), prof.gamma_hist, prof.score_hist
+    )
+    assert legacy.n_matched_pairs == 0
+    clock = [0.0]
+    mon2 = DriftMonitor(legacy, window_s=4.0, clock=lambda: clock[0])
+    clock[0] += 1.0
+    mon2.observe(_window(0, prof.gamma_hist_matched,
+                         prof.score_hist_matched,
+                         score_all=prof.score_hist_matched))
+    short = mon2.window_drift(4.0)
+    assert all(v["psi"] is None for v in short["channels"].values())
+    assert mon2.alerts() == []
+
+
+# ---------------------------------------------------------------------------
+# training-reference profile
+# ---------------------------------------------------------------------------
+
+
+def test_profile_captured_with_matched_twins(trained):
+    _, linker, index = trained
+    prof = index.profile
+    assert prof is not None
+    assert prof.columns == ["first_name", "surname", "city"]
+    # every gamma row and the score histogram count every training pair
+    for c in range(3):
+        assert int(prof.gamma_hist[c].sum()) == prof.n_pairs
+    assert int(prof.score_hist.sum()) == prof.n_pairs
+    # the matched twins are a strict, non-empty subset
+    assert 0 < prof.n_matched_pairs < prof.n_pairs
+    for c in range(3):
+        assert int(prof.gamma_hist_matched[c].sum()) == prof.n_matched_pairs
+        assert (prof.gamma_hist_matched[c] <= prof.gamma_hist[c]).all()
+    # the fixture's design point: the matched population has city variance
+    city = prof.gamma_counts_matched(2)
+    assert city[1] > 0 and city[2] > 0
+    # column stats rode along
+    assert set(prof.null_rates) >= {"first_name", "surname", "city"}
+    assert prof.vocab_mass["first_name"]["n_tokens"] >= 10
+    assert prof.n_rows == 400
+
+
+def test_profile_kernel_matches_host_oracle():
+    """The jitted profile kernel's histograms equal a straight numpy
+    recomputation — all-pairs AND matched halves."""
+    import jax.numpy as jnp
+
+    from splink_tpu.models.fellegi_sunter import FSParams, match_probability
+
+    rng = np.random.default_rng(5)
+    G = rng.integers(-1, 3, size=(500, 2)).astype(np.int8)
+    params = FSParams(
+        lam=jnp.float32(0.3),
+        m=jnp.asarray(np.array([[0.1, 0.2, 0.7], [0.2, 0.3, 0.5]], np.float32)),
+        u=jnp.asarray(np.array([[0.7, 0.2, 0.1], [0.5, 0.3, 0.2]], np.float32)),
+    )
+    bins = 8
+    out = np.asarray(make_profile_fn((3, 3), bins)(jnp.asarray(G), params))
+    width, n_cols = 4, 2
+    half = n_cols * width + bins
+    p = np.asarray(match_probability(jnp.asarray(G), params))
+    matched = p >= MATCH_PROBABILITY
+    sbin = np.clip((p * bins).astype(np.int32), 0, bins - 1)
+    for c in range(n_cols):
+        g = G[:, c].astype(np.int64) + 1
+        want_all = np.bincount(g, minlength=width)
+        want_m = np.bincount(g[matched], minlength=width)
+        np.testing.assert_array_equal(
+            out[c * width : (c + 1) * width], want_all
+        )
+        np.testing.assert_array_equal(
+            out[half + c * width : half + (c + 1) * width], want_m
+        )
+    np.testing.assert_array_equal(
+        out[n_cols * width : half], np.bincount(sbin, minlength=bins)
+    )
+    np.testing.assert_array_equal(
+        out[half + n_cols * width :],
+        np.bincount(sbin[matched], minlength=bins),
+    )
+
+
+def test_profileless_build_when_quality_profile_off():
+    df = twin_df(n_base=30)
+    linker = Splink(drift_settings(quality_profile=False, max_iterations=2),
+                    df=df)
+    linker.get_scored_comparisons()
+    index = linker.export_index()
+    assert index.profile is None
+    eng = QueryEngine(index, top_k=4, policy=BucketPolicy((16,), (64,)))
+    assert eng.sketch is None
+    assert eng.drain_drift() is None and not eng.drift_drain_due(0.0)
+
+
+# ---------------------------------------------------------------------------
+# LinkageIndex persistence
+# ---------------------------------------------------------------------------
+
+
+def test_profiled_index_round_trip_fingerprint_covered(tmp_path, trained):
+    _, linker, index = trained
+    path = tmp_path / "idx"
+    linker.export_index(path)
+    index2 = load_index(path)
+    prof, prof2 = index.profile, index2.profile
+    assert prof2 is not None
+    np.testing.assert_array_equal(prof.gamma_hist, prof2.gamma_hist)
+    np.testing.assert_array_equal(prof.score_hist, prof2.score_hist)
+    np.testing.assert_array_equal(
+        prof.gamma_hist_matched, prof2.gamma_hist_matched
+    )
+    np.testing.assert_array_equal(
+        prof.score_hist_matched, prof2.score_hist_matched
+    )
+    assert prof2.to_meta() == prof.to_meta()
+    # the profile arrays live inside the npz payload, so the artifact's
+    # arrays fingerprint covers them: corrupt the arrays file -> rejected
+    (npz_path,) = path.glob("*.npz")
+    blob = bytearray(npz_path.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    npz_path.write_bytes(bytes(blob))
+    with pytest.raises(IndexMismatchError):
+        load_index(path)
+
+
+def test_legacy_profileless_index_loads_and_serves(tmp_path, trained):
+    """A profile-less artifact (the pre-observatory format) loads, serves
+    identical scores, and drift reporting states why it is dark instead
+    of crashing."""
+    df, linker, index = trained
+    path = tmp_path / "idx"
+    linker.export_index(path)
+    legacy = load_index(path)
+    legacy.profile = None  # what an old artifact deserialises to
+    legacy_dir = tmp_path / "legacy"
+    legacy.save(legacy_dir)
+    index3 = load_index(legacy_dir)
+    assert index3.profile is None
+    meta = json.loads((legacy_dir / "linkage_index.json").read_text())
+    assert meta["profile"] is None
+    eng = QueryEngine(index3, top_k=8,
+                      policy=BucketPolicy((16, 64), (64, 256)))
+    assert eng.sketch is None, "no profile -> no sketch, serving unchanged"
+    eng.warmup()
+    q = _queries(df, n=16)
+    base = QueryEngine(index, top_k=8,
+                       policy=BucketPolicy((16, 64), (64, 256)))
+    base.warmup()
+    a, b = base.query_arrays(q), eng.query_arrays(q)
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+    svc = LinkageService(eng, deadline_ms=2.0, flight_records=0)
+    try:
+        snap = svc.drift_snapshot()
+        assert snap["reference"] is False
+        assert snap["reason"] == "no reference profile"
+        assert snap["alerts"] == []
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# serve-time sketch
+# ---------------------------------------------------------------------------
+
+
+def test_sketch_parity_with_returned_results(trained, engine):
+    """The drained score histograms equal binning the probabilities the
+    engine actually returned: the all-served block over every valid
+    top-k slot, the matched block over the p >= 0.5 subset; each matched
+    gamma row carries exactly the matched count."""
+    df, _, index = trained
+    engine.drain_drift()  # reset any accumulation from other tests
+    q = _queries(df)
+    res = engine.query(q)
+    w = engine.drain_drift()
+    prof = index.profile
+    p = res["match_probability"].to_numpy()
+    bins = prof.bins
+    sbin = np.clip((p.astype(np.float32) * bins).astype(np.int64), 0,
+                   bins - 1)
+    np.testing.assert_array_equal(
+        w.score_all, np.bincount(sbin, minlength=bins)
+    )
+    matched = p.astype(np.float32) >= MATCH_PROBABILITY
+    np.testing.assert_array_equal(
+        w.score, np.bincount(sbin[matched], minlength=bins)
+    )
+    n_matched = int(matched.sum())
+    assert n_matched > 0
+    for c in range(len(prof.columns)):
+        assert int(w.gamma[c].sum()) == n_matched
+    # drained means drained: the next window starts empty
+    w2 = engine.drain_drift()
+    assert int(w2.gamma.sum()) == 0 and int(w2.score_all.sum()) == 0
+
+
+def test_sketch_counts_oov_and_null_queries(trained, engine):
+    df, _, index = trained
+    engine.drain_drift()
+    q = _queries(df, n=16)
+    q.loc[q.index[:4], "city"] = None  # null comparison column
+    q.loc[q.index[:2], "dob"] = "2099"  # unseen blocking key -> OOV
+    engine.query(q)
+    w = engine.drain_drift()
+    assert w.counters["queries"] == 16
+    assert w.counters["oov"] >= 2
+    assert w.counters["exact_miss"] >= 2
+    city_i = index.profile.columns.index("city")
+    assert w.counters["nulls"][city_i] == 4
+
+
+def test_sketch_steady_state_zero_recompiles(trained, engine):
+    """Sketching rides warmed shapes: steady-state traffic (all query
+    bucket shapes) triggers ZERO compile requests."""
+    from splink_tpu.obs.metrics import compile_requests
+
+    df, _, _ = trained
+    engine.query(_queries(df, n=8))   # both buckets already warmed
+    engine.query(_queries(df, n=40))
+    engine.drain_drift()
+    before = compile_requests()
+    engine.query(_queries(df, n=8, state=5))
+    engine.query(_queries(df, n=40, state=6))
+    engine.drain_drift()
+    assert compile_requests() == before
+
+
+def test_sketch_warm_covers_every_bucket(trained):
+    """warmup() pre-compiles the sketch program for every query bucket:
+    an all-invalid dummy dispatch leaves the accumulator empty."""
+    from splink_tpu.obs.metrics import compile_requests
+
+    _, _, index = trained
+    eng = QueryEngine(index, top_k=8, policy=BucketPolicy((16,), (64, 256)))
+    assert eng.sketch is not None
+    eng.warmup()
+    w = eng.drain_drift()
+    assert int(w.gamma.sum()) == 0, "dummy warm dispatches must not count"
+    df = twin_df(n_base=20)
+    before = compile_requests()
+    eng.query(_queries(df, n=8, state=2))
+    assert compile_requests() == before
+
+
+# ---------------------------------------------------------------------------
+# end-to-end drift scoring against live serve traffic
+# ---------------------------------------------------------------------------
+
+
+def test_clean_stream_stays_below_threshold(trained, engine):
+    df, _, index = trained
+    engine.drain_drift()
+    clock = [0.0]
+    mon = DriftMonitor(index.profile, window_s=10.0, alert_psi=0.25,
+                       clock=lambda: clock[0])
+    _drive(engine, mon, df, 12, clock=clock)
+    snap = mon.snapshot()
+    assert snap["reference"] is True
+    assert snap["short"]["max_psi"] < 0.25
+    assert snap["alerts"] == []
+    assert snap["short"]["match_yield"] > 0.1
+
+
+def test_city_drift_fires_psi_alert(trained, engine):
+    """An upstream pipeline break (every query ships city=None) shifts
+    the matched gamma mix: the city channel's PSI explodes while the
+    clean channels stay low, and the two-window alert fires."""
+    df, _, index = trained
+    engine.drain_drift()
+    clock = [0.0]
+    mon = DriftMonitor(index.profile, window_s=10.0, alert_psi=0.25,
+                       clock=lambda: clock[0])
+    _drive(engine, mon, df, 12, clock=clock,
+           mutate=lambda q, i: q.__setitem__("city", None))
+    snap = mon.snapshot()
+    ch = snap["short"]["channels"]
+    assert ch["gamma:city"]["psi"] > 2.5, "city drift must dominate"
+    assert ch["gamma:first_name"]["psi"] < 0.25, "clean channel stays low"
+    channels = {a["channel"] for a in snap["alerts"]}
+    assert "gamma:city" in channels
+    # the profile's null-rate channel sees it too
+    assert snap["short"]["null_rates"]["city"] == 1.0
+
+
+def test_catastrophic_drift_fires_yield_collapse(trained, engine):
+    df, _, index = trained
+    engine.drain_drift()
+    clock = [0.0]
+    mon = DriftMonitor(index.profile, window_s=4.0, alert_psi=0.25,
+                       clock=lambda: clock[0])
+
+    def garble(q, i):
+        if i >= 14:
+            q["first_name"] = "zz" + q["first_name"].str.slice(2)
+            q["surname"] = "qq" + q["surname"].str.slice(2)
+
+    _drive(engine, mon, df, 20, clock=clock, mutate=garble)
+    snap = mon.snapshot()
+    assert [a["channel"] for a in snap["alerts"]] == ["match_yield"]
+    assert snap["short"]["match_yield"] == 0.0
+    assert snap["long"]["match_yield"] > 0.2
+
+
+# ---------------------------------------------------------------------------
+# service wiring
+# ---------------------------------------------------------------------------
+
+
+def _service(engine, **over):
+    kw = dict(deadline_ms=2.0, watchdog_interval_s=0.02, flight_records=0)
+    kw.update(over)
+    return LinkageService(engine, **kw)
+
+
+def test_service_publishes_drift_windows_and_snapshot(
+    trained, engine, capture
+):
+    df, _, _ = trained
+    engine.drain_drift()
+    svc = _service(engine)
+    try:
+        for rec in df.head(24).to_dict(orient="records"):
+            rec.pop("unique_id")
+            svc.query(rec, timeout=10.0)
+        svc._drift_tick(force=True)
+        snap = svc.drift_snapshot()
+        assert snap["reference"] is True
+        assert snap["alert_active"] is False
+        assert snap["windows_observed"] >= 1
+    finally:
+        svc.close()
+    windows = capture.of("drift_window")
+    assert windows, "each drain publishes a drift_window event"
+    ev = windows[-1]
+    assert ev["queries"] >= 1 and "max_psi" in ev and "match_yield" in ev
+    # prometheus: reference gauge, alert gauge
+    samples = [s for s in svc.prometheus_samples()
+               if s.name.startswith("splink_serve_drift")]
+    by_name = {s.name for s in samples}
+    assert "splink_serve_drift_reference" in by_name
+    assert "splink_serve_drift_alert" in by_name
+
+
+def test_service_alert_edges_publish_and_dump_flight(
+    trained, engine, capture, tmp_path
+):
+    """Entering the alert state publishes ONE drift_alert (edge, not
+    level), triggers a flight dump, and leaving publishes drift_clear."""
+    df, _, index = trained
+    engine.drain_drift()
+    svc = _service(engine)
+    rec = FlightRecorder(16, dump_dir=str(tmp_path), name=svc.name)
+    register_ambient(rec)
+    try:
+        clock = [0.0]
+        mon = DriftMonitor(index.profile, window_s=4.0, alert_psi=0.25,
+                           clock=lambda: clock[0])
+        svc._drift = mon  # injected clock, same service alert machinery
+        _drive(engine, mon, df, 16, clock=clock)
+        svc._evaluate_drift_alerts(mon)
+        assert capture.of("drift_alert") == []
+        _drive(engine, mon, df, 5, clock=clock,
+               mutate=lambda q, i: (
+                   q.__setitem__("first_name", "zz" + q["first_name"].str.slice(2)),
+                   q.__setitem__("surname", "qq" + q["surname"].str.slice(2)),
+               ))
+        svc._evaluate_drift_alerts(mon)
+        svc._evaluate_drift_alerts(mon)  # still firing: no second event
+        alerts = capture.of("drift_alert")
+        assert len(alerts) == 1, "edge-triggered, not level-triggered"
+        assert alerts[0]["replica"] == svc.name
+        assert svc.drift_snapshot()["alert_active"] is True
+        assert len(rec.dumps) == 1, "drift alert dumps the flight recorder"
+        dumped = [json.loads(line) for line
+                  in open(rec.dumps[0], encoding="utf-8")]
+        assert any(e.get("type") == "drift_alert" for e in dumped)
+        # traffic ages out -> clear edge
+        clock[0] += 200.0
+        mon.observe(engine.drain_drift())
+        svc._evaluate_drift_alerts(mon)
+        clears = capture.of("drift_clear")
+        assert len(clears) == 1
+        assert svc.drift_snapshot()["alert_active"] is False
+    finally:
+        unregister_ambient(rec)
+        rec.close()
+        svc.close()
+
+
+def test_swap_rebinds_drift_monitor(trained, tmp_path):
+    """A hot-swap rebinds the observatory: old windows describe the old
+    reference and must not score against the new one."""
+    df, linker, index = trained
+    eng = QueryEngine(index, top_k=8, policy=BucketPolicy((16,), (64, 256)))
+    eng.warmup()
+    svc = _service(engine=eng)
+    try:
+        for rec in df.head(4).to_dict(orient="records"):
+            rec.pop("unique_id")
+            svc.query(rec, timeout=10.0)
+        svc._drift_tick(force=True)
+        old_mon = svc._drift
+        assert old_mon is not None and old_mon.windows_observed >= 1
+        path = tmp_path / "swap_idx"
+        linker.export_index(path)
+        svc.swap_index(str(path), refresh_probes=True)
+        assert svc._drift is not old_mon, "monitor rebound on swap"
+        assert svc._drift.windows_observed == 0
+        assert svc.drift_snapshot()["reference"] is True
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: native histogram + scrape format
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_from_counts_math():
+    h = histogram_from_counts(
+        "demo_hist", [2, 0, 3], [0.25, 0.5, 1.0], {"r": "a"}, "demo"
+    )
+    assert h.buckets == [(0.25, 2.0), (0.5, 2.0), (1.0, 5.0)]
+    assert h.count == 5.0
+    # midpoint sum: 2*0.125 + 3*0.75
+    assert h.sum == pytest.approx(2 * 0.125 + 3 * 0.75)
+
+
+def test_render_samples_histogram_scrape_format():
+    out = render_samples([
+        Sample("demo_gauge", 1.5, {}, "gauge", "a gauge"),
+        histogram_from_counts(
+            "demo_hist", [2, 0, 3], [0.25, 0.5, 1.0], {"replica": "a"},
+            "a histogram",
+        ),
+    ])
+    lines = out.splitlines()
+    assert "# HELP demo_hist a histogram" in lines
+    assert "# TYPE demo_hist histogram" in lines
+    assert 'demo_hist_bucket{le="0.25",replica="a"} 2' in lines
+    assert 'demo_hist_bucket{le="0.5",replica="a"} 2' in lines
+    assert 'demo_hist_bucket{le="1",replica="a"} 5' in lines
+    assert 'demo_hist_bucket{le="+Inf",replica="a"} 5' in lines
+    assert 'demo_hist_count{replica="a"} 5' in lines
+    assert any(line.startswith('demo_hist_sum{replica="a"} ')
+               for line in lines)
+    # plain families keep one header per name and typed rows
+    assert "# TYPE demo_gauge gauge" in lines
+    assert "demo_gauge 1.5" in lines
+    # bucket series stay under ONE family header
+    assert out.count("# TYPE demo_hist") == 1
+
+
+def test_service_exposes_drift_score_histogram(trained, engine):
+    df, _, _ = trained
+    engine.drain_drift()
+    svc = _service(engine)
+    try:
+        for rec in df.head(12).to_dict(orient="records"):
+            rec.pop("unique_id")
+            svc.query(rec, timeout=10.0)
+        svc._drift_tick(force=True)
+        text = render_samples(svc.prometheus_samples())
+    finally:
+        svc.close()
+    assert "# TYPE splink_serve_drift_score histogram" in text
+    assert 'splink_serve_drift_score_bucket{le="+Inf"' in text
+    assert "splink_serve_drift_psi{" in text
+    assert "splink_serve_drift_match_yield{" in text
+
+
+# ---------------------------------------------------------------------------
+# CLI renderers: torn-record tolerance (the summarize contract)
+# ---------------------------------------------------------------------------
+
+
+_TORN_EVENTS = [
+    {"type": "quality_profile"},  # fully torn: every field missing
+    {"type": "quality_profile", "columns": ["a"], "n_pairs": 10,
+     "n_rows": 5, "bins": 4, "null_rates": {"a": None}},
+    {"type": "drift_window", "replica": "r0"},  # no channels, no counts
+    {"type": "drift_window", "replica": "r0", "window_s": 5,
+     "queries": 7, "pairs": 3, "max_psi": 0.5,
+     "channels": {"gamma:a": 0.5, "score": None}, "oov_rate": None,
+     "match_yield": None},
+    {"type": "drift_alert"},  # no alerts list, no replica
+    {"type": "drift_alert", "replica": "r0", "alerts": [{}]},  # empty alert
+    {"type": "drift_alert", "replica": "r0",
+     "alerts": [{"channel": "gamma:a", "short_psi": 0.6, "long_psi": 0.5,
+                 "threshold": 0.25, "window_s": 5, "long_window_s": 25}]},
+    {"type": "drift_alert", "replica": "r0",
+     "alerts": [{"channel": "match_yield", "short_yield": 0.0,
+                 "long_yield": 0.8, "threshold": 4.0}]},
+    {"type": "drift_clear", "replica": "r0"},
+    {"type": "em_diagnostics"},  # fully torn
+    {"type": "em_diagnostics", "lam": None, "columns": [
+        {"name": "a", "num_levels": 2, "m": [0.5], "u": None,
+         "log2_bf": [None, 1.0], "support": None, "warnings": ["w"]}],
+     "warnings": ["a: w"]},
+]
+
+
+def test_summarize_renders_torn_drift_records():
+    out = summarize_events(list(_TORN_EVENTS))
+    assert "quality profile" in out
+    assert "drift:" in out
+    assert "ALERT gamma:a" in out
+    assert "ALERT match_yield" in out and "yield 0.0/0.8" in out
+    assert "alert cleared" in out
+    assert "EM diagnostics" in out
+
+
+def test_drift_report_renders_torn_records():
+    out = drift_events_report(list(_TORN_EVENTS))
+    assert "reference profile: 1 column(s)" in out
+    assert "replica r0" in out
+    assert "gamma:a" in out
+    assert "ALERT match_yield" in out
+    assert "cleared" in out
+    # an empty record states why it is empty
+    empty = drift_events_report([])
+    assert "no drift events" in empty
+
+
+def test_drift_report_on_real_service_record(trained, engine, tmp_path,
+                                             capture):
+    """The obs drift CLI renders a real captured stream end-to-end."""
+    df, _, _ = trained
+    engine.drain_drift()
+    svc = _service(engine)
+    try:
+        for rec in df.head(12).to_dict(orient="records"):
+            rec.pop("unique_id")
+            svc.query(rec, timeout=10.0)
+        svc._drift_tick(force=True)
+    finally:
+        svc.close()
+    events = [{"type": "quality_profile",
+               **trained[2].profile.summary()}] + capture.events
+    out = drift_events_report(events)
+    assert "reference profile: 3 column(s)" in out
+    assert "window report(s)" in out
+
+
+# ---------------------------------------------------------------------------
+# EM identifiability diagnostics
+# ---------------------------------------------------------------------------
+
+
+def test_em_diagnostics_structure_and_warnings(trained):
+    _, linker, _ = trained
+    # real params, fabricated support: level 1 of first_name unseen
+    hist = {
+        "first_name": [10, 500, 0, 300],
+        "surname": [5, 400, 405],
+        "city": [5, 400, 405],
+    }
+    diag = em_diagnostics(linker.params, hist)
+    assert [c["name"] for c in diag["columns"]] == [
+        "first_name", "surname", "city"
+    ]
+    first = diag["columns"][0]
+    assert first["support"] == [500, 0, 300]
+    assert any("~zero training support" in w for w in first["warnings"])
+    assert len(first["m"]) == 3 and len(first["log2_bf"]) == 3
+    traj = diag["trajectory"]
+    assert len(traj["lam"]) == diag["n_iterations"]
+    assert len(traj["max_move_m"]) == diag["n_iterations"] - 1
+    # the full m/u paths ride along for a model this small
+    assert "m" in traj and len(traj["m"][0]) == 3
+    # without support evidence the support warnings vanish, m~=u ones stay
+    diag2 = em_diagnostics(linker.params, None)
+    assert all("support" not in w for w in diag2["warnings"])
+
+
+def test_em_diagnostics_flags_uninformative_levels():
+    """m ~= u at a level -> the uninformative warning (synthetic params
+    via a tiny linker with no EM: the priors keep m != u, so force it)."""
+    df = twin_df(n_base=20)
+    linker = Splink(drift_settings(max_iterations=0, quality_profile=False),
+                    df=df)
+    linker.estimate_parameters()
+    p = linker.params
+    # force m == u at surname level 1
+    entry = p.params["π"]["gamma_surname"]
+    entry["prob_dist_match"]["level_1"]["probability"] = 0.5
+    entry["prob_dist_non_match"]["level_1"]["probability"] = 0.5
+    diag = em_diagnostics(p)
+    sur = [c for c in diag["columns"] if c["name"] == "surname"][0]
+    assert any("m~=u" in w for w in sur["warnings"])
+    assert any("uninformative" in w for w in diag["warnings"])
+
+
+def test_telemetry_record_carries_quality_events(tmp_path):
+    """With a telemetry sink, training + export publish em_diagnostics
+    and quality_profile events into the JSONL record, and summarize
+    renders both sections."""
+    from splink_tpu.obs.events import read_events
+
+    df = twin_df(n_base=40)
+    linker = Splink(
+        drift_settings(max_iterations=3, telemetry_dir=str(tmp_path)),
+        df=df,
+    )
+    linker.get_scored_comparisons()
+    linker.export_index()
+    linker.close_telemetry()
+    (record,) = tmp_path.glob("*.jsonl")
+    events = list(read_events(record))
+    diags = [e for e in events if e.get("type") == "em_diagnostics"]
+    assert diags, "EM diagnostics event missing from the record"
+    d = diags[-1]
+    assert d["columns"][0]["support"] is not None
+    assert "trajectory" in d and "run" in d
+    assert d["run"]["n_updates"] >= 1
+    profs = [e for e in events if e.get("type") == "quality_profile"]
+    assert profs and profs[-1]["n_pairs"] > 0
+    assert profs[-1]["n_matched_pairs"] > 0
+    out = summarize_events(events)
+    assert "EM diagnostics" in out and "quality profile" in out
+
+
+# ---------------------------------------------------------------------------
+# audit registry: the new kernels are gated and the gates are falsifiable
+# ---------------------------------------------------------------------------
+
+
+def test_quality_kernels_registered_and_clean():
+    from splink_tpu.analysis.trace_audit import run_audit
+
+    findings, audited = run_audit(["quality_profile", "serve_drift_sketch"])
+    assert audited == 2
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_quality_shard_kernels_registered_and_clean():
+    from splink_tpu.analysis.shard_audit import run_shard_audit
+
+    findings, audited = run_shard_audit(
+        ["quality_profile_sharded", "serve_drift_sketch_sharded"]
+    )
+    assert audited == 2
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_bad_profile_twin_trips_ta_dtype():
+    """A profile kernel whose accumulator derives its dtype from ambient
+    config (plain int) goes int64 under the forced-x64 trace — the leak
+    TA-DTYPE exists to catch."""
+    from splink_tpu.analysis.trace_audit import (
+        KernelSpec,
+        audit_kernel,
+        shared_fs_inputs,
+    )
+
+    def build():
+        import jax.numpy as jnp
+
+        from splink_tpu.models.fellegi_sunter import match_probability
+
+        def bad(G, params):
+            hist = jnp.zeros(8, int)  # unpinned: int64 under x64
+            p = match_probability(G, params)
+            sbin = jnp.clip((p * 8).astype(jnp.int32), 0, 7)
+            return hist.at[sbin].add(1, mode="drop")
+
+        return bad, shared_fs_inputs(), {}
+
+    spec = KernelSpec(name="bad_profile_dtype", build=build)
+    findings = audit_kernel(spec)
+    assert any(f.rule == "TA-DTYPE" for f in findings), [
+        f.format() for f in findings
+    ]
+
+
+def test_bad_sketch_shard_twin_trips_sa_coll():
+    """The sketch's histogram reduction is a DECLARED all-reduce; a twin
+    registered without declaring it makes the same psum an undeclared
+    collective — SA-COLL fires (the budget is exact, not advisory)."""
+    from splink_tpu.analysis.shard_audit import (
+        register_shard_kernel,
+        run_shard_audit,
+    )
+
+    registry: dict = {}
+
+    @register_shard_kernel(
+        "bad_sketch_undeclared_psum", n_pairs=64, registry=registry
+    )
+    def _build():
+        import jax
+
+        from splink_tpu.analysis.shard_audit import audit_mesh
+        from splink_tpu.analysis.trace_audit import shared_gamma_program
+        from splink_tpu.obs.drift import make_sketch_fn
+        from splink_tpu.parallel.mesh import pair_sharding, replicated
+
+        mesh = audit_mesh()
+        program = shared_gamma_program()
+        cols = program.settings["comparison_columns"]
+        width = max(int(c["num_levels"]) for c in cols) + 1
+        size = len(cols) * width + 2 * 8
+        fn = make_sketch_fn(program._layout, cols, 8)
+        shard, rep = pair_sharding(mesh), replicated(mesh)
+        acc = jax.device_put(np.zeros(size, np.int32), rep)
+        packed_q = jax.device_put(
+            np.zeros((64, program._packed.shape[1]), np.uint32), shard
+        )
+        packed_ref = jax.device_put(program._packed, rep)
+        top_rows = jax.device_put(np.zeros((64, 4), np.int32), shard)
+        top_valid = jax.device_put(np.zeros((64, 4), bool), shard)
+        top_p = jax.device_put(np.zeros((64, 4), np.float32), shard)
+        return (
+            fn,
+            (acc, packed_q, packed_ref, top_rows, top_valid, top_p),
+            {},
+        )
+
+    findings, audited = run_shard_audit(registry=registry, baselines={})
+    assert audited == 1
+    assert any(f.rule == "SA-COLL" for f in findings), [
+        f.format() for f in findings
+    ]

@@ -394,3 +394,44 @@ def test_approx_blocking_key_types_validated():
             approx_pair_budget=1024,
         )
     )
+
+
+def test_quality_observatory_defaults_filled():
+    """The drift-observatory keys complete from the schema: profile
+    capture OFF by default (legacy builds unchanged), 16 score bins, a
+    60 s short window, the standard 0.25 PSI action threshold."""
+    s = complete_settings_dict(_minimal())
+    assert s["quality_profile"] is False
+    assert s["drift_sketch_bins"] == 16
+    assert s["drift_window_s"] == 60
+    assert s["drift_alert_psi"] == 0.25
+
+
+def test_quality_observatory_key_types_validated():
+    """Type/bound violations on the drift-observatory keys are rejected
+    by the schema validator (the PR 5/7 key-validation pattern)."""
+    for bad in (
+        {"quality_profile": "yes"},
+        {"quality_profile": 1},
+        {"drift_sketch_bins": 1},
+        {"drift_sketch_bins": 257},
+        {"drift_sketch_bins": 8.5},
+        {"drift_sketch_bins": "fine"},
+        {"drift_window_s": 0},
+        {"drift_window_s": -5},
+        {"drift_window_s": "hour"},
+        {"drift_alert_psi": -0.1},
+        {"drift_alert_psi": "strict"},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    # valid values pass (window/threshold are numbers: floats allowed;
+    # drift_alert_psi=0 disables alerting but still validates)
+    validate_settings(
+        _minimal(
+            quality_profile=True,
+            drift_sketch_bins=32,
+            drift_window_s=2.5,
+            drift_alert_psi=0,
+        )
+    )
