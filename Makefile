@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -14,6 +14,7 @@ test:
 lint:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m splink_tpu.analysis splink_tpu/ --audit --shard-audit
+	JAX_PLATFORMS=cpu python -m splink_tpu.analysis --list-perf-kernels
 
 # Intentional refresh of the committed per-kernel cost/collective budgets
 # (splink_tpu/analysis/shard_baselines.json) after an accepted perf change
@@ -21,6 +22,14 @@ lint:
 shard-baselines:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python -m splink_tpu.analysis --shard-audit --update-baselines
+
+# Intentional refresh of the committed MEASURED per-(tier, kernel, shape)
+# runtime/memory budgets (splink_tpu/analysis/perf_baselines.json, layer 4)
+# after an accepted perf change or a new kernel. Only this tier's block is
+# rewritten (hardware tiers add their own); review the diff like a bench.
+perf-baselines:
+	JAX_PLATFORMS=cpu \
+		python -m splink_tpu.analysis --perf-audit --update-perf-baselines
 
 # Hardware smoke tier: real TPU lowering of Pallas kernels + pipeline.
 # Separate invocation because tests/conftest.py pins its process to CPU.
@@ -95,6 +104,16 @@ warmup-smoke:
 drift-smoke:
 	python scripts/drift_smoke.py
 
+# Performance-observatory smoke: the layer-4 measured audit passes against
+# the committed perf_baselines.json on this tier, steady-state traffic with
+# the serve-time KernelWatch on performs zero compile requests, a
+# monkeypatched slow engine trips the two-window perf alert (flight dump
+# with the window snapshot inside, edge-triggered clear on recovery), and
+# `obs summarize` + the Prometheus exposition render the perf series
+# (docs/observability.md#perf).
+perf-smoke:
+	python scripts/perf_smoke.py
+
 bench:
 	python bench.py
 
@@ -102,4 +121,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke drift-smoke bench
+all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke drift-smoke perf-smoke bench

@@ -1,0 +1,240 @@
+"""Perf smoke (`make perf-smoke`): the performance-observatory contract.
+
+Two halves, matching the observatory's architecture
+(docs/observability.md#perf):
+
+CI half — the measured layer-4 audit:
+
+  1. AUDIT CLEAN — `python -m splink_tpu.analysis --perf-audit` passes
+     against the COMMITTED ``perf_baselines.json`` on this tier: every
+     registered kernel still compiles, executes and fits its committed
+     compile/execute/memory bands (the one-sided bands + median-of-K
+     noise guard keep a loaded container from flapping this).
+
+Runtime half — the serve-time KernelWatch:
+
+  2. ZERO RECOMPILES — steady-state traffic with the watch enabled
+     performs zero compile requests (watching is host-side arithmetic on
+     signals the service already collects);
+  3. ALERTING — a monkeypatched slow engine (a deliberate execute-time
+     regression) trips the two-window ``perf_alert`` after the anchor
+     formed on clean traffic — and ONLY then (the clean phase must stay
+     quiet);
+  4. FLIGHT DUMP — the alert dumps the flight recorder with the
+     KernelWatch window snapshot inside, and clearing the regression
+     publishes the edge-triggered ``perf_clear``;
+  5. TOOLING — `obs summarize` renders the captured perf events and the
+     Prometheus exposition carries the perf gauges + per-phase native
+     histogram.
+
+Exits nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WAIT_S = 60
+ALERT_DEADLINE_S = 30
+CLEAR_DEADLINE_S = 30
+SLOW_S = 0.12  # injected per-batch regression (vs ~ms clean batches)
+
+
+def _settings():
+    return {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob"],
+        "max_iterations": 4,
+        "serve_top_k": 4,
+        "serve_query_buckets": [16],
+        "serve_candidate_buckets": [64, 256],
+        "serve_probe_queries": 0,
+        "perf_alert_ratio": 3.0,
+        # 2 s short window: the injected ~130 ms batches must fit the
+        # 8-sample short floor with margin (a 1 s window holds ~7.7)
+        "perf_window_s": 2.0,
+    }
+
+
+def _corpus(n=240, seed=7):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    return pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+
+
+def _wave(svc, df, rng, n=8):
+    q = df.sample(n, random_state=int(rng.integers(1 << 30)))
+    q = q.drop(columns=["unique_id"]).reset_index(drop=True)
+    futures = [svc.submit(dict(r)) for r in q.to_dict(orient="records")]
+    res = [f.result(timeout=WAIT_S) for f in futures]
+    assert not any(r.shed for r in res), "perf smoke traffic must serve"
+    return res
+
+
+def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
+    import warnings
+
+    import numpy as np
+
+    from splink_tpu import Splink
+    from splink_tpu.analysis.perf_audit import run_perf_audit
+    from splink_tpu.obs.cli import summarize_events
+    from splink_tpu.obs.events import EventSink, read_events, register_ambient
+    from splink_tpu.obs.kernelwatch import ANCHOR_SAMPLES, ANCHOR_SKIP
+    from splink_tpu.obs.metrics import (
+        compile_requests,
+        install_compile_monitor,
+    )
+    from splink_tpu.serve import BucketPolicy, LinkageService, QueryEngine
+    from splink_tpu.serve.index import build_index
+
+    install_compile_monitor()
+    warnings.simplefilter("ignore")
+
+    # ---- 1: the measured layer-4 audit against the COMMITTED baselines --
+    t0 = time.perf_counter()
+    findings, shapes = run_perf_audit()
+    audit_s = time.perf_counter() - t0
+    assert not findings, "perf audit must pass committed baselines:\n" + \
+        "\n".join(f.format() for f in findings)
+    print(f"perf 1 ok: audit clean — {shapes} (kernel, shape) cells "
+          f"measured against committed baselines in {audit_s:.1f}s")
+
+    tmp = tempfile.mkdtemp(prefix="splink_perf_")
+    events_path = os.path.join(tmp, "perf_events.jsonl")
+    sink = EventSink(events_path, run_id="perf-smoke")
+    register_ambient(sink)
+    rng = np.random.default_rng(3)
+
+    df = _corpus()
+    settings = _settings()
+    linker = Splink(settings, df=df)
+    linker.get_scored_comparisons()
+    index = build_index(linker)
+    engine = QueryEngine(index, policy=BucketPolicy((16,), (64, 256)))
+    engine.warmup()
+    svc = LinkageService(engine, watchdog_interval_s=0.05)
+    svc._flight.dump_dir = os.path.join(tmp, "flight")
+    assert svc._kwatch is not None
+
+    # ---- 2: clean traffic — anchor forms, zero recompiles, no alert ----
+    _wave(svc, df, rng)  # cover the steady-state shapes once post-warmup
+    c0 = compile_requests()
+    clean_batches = ANCHOR_SKIP + ANCHOR_SAMPLES + 4
+    for _ in range(clean_batches):
+        _wave(svc, df, rng, n=4)
+    c1 = compile_requests()
+    assert c1 - c0 == 0, (
+        f"the kernel watch added {c1 - c0} steady-state compile request(s)"
+    )
+    snap = svc.perf_snapshot()
+    assert snap["enabled"] and not snap["alert_active"], snap
+    anchor = (snap["phases"].get("batch") or {}).get("anchor_ms")
+    assert anchor is not None, f"anchor must form on clean traffic: {snap}"
+    print(f"perf 2 ok: {clean_batches + 1} clean waves, 0 recompiles with "
+          f"the watch on, batch anchor {anchor:.2f}ms, no alert")
+
+    # ---- 3+4: injected regression — alert, dump, then clear -------------
+    orig_query_arrays = engine.query_arrays
+
+    def slow_query_arrays(*args, **kwargs):
+        time.sleep(SLOW_S)
+        return orig_query_arrays(*args, **kwargs)
+
+    engine.query_arrays = slow_query_arrays
+    deadline = time.monotonic() + ALERT_DEADLINE_S
+    while time.monotonic() < deadline:
+        _wave(svc, df, rng, n=4)
+        if svc.perf_snapshot()["alert_active"]:
+            break
+    assert svc.perf_snapshot()["alert_active"], (
+        f"the injected regression never fired: {svc.perf_snapshot()}"
+    )
+    deadline = time.monotonic() + 10
+    while not svc._flight.dumps and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert svc._flight.dumps, "the perf alert must dump the flight recorder"
+    dump = read_events(svc._flight.dumps[0])
+    assert dump[0]["type"] == "flight_header", dump[0]
+    assert dump[0]["trigger"] == "perf_alert", dump[0]
+    alert_records = [e for e in dump if e.get("type") == "perf_alert"]
+    assert alert_records, "the dump must hold the perf_alert transition"
+    assert alert_records[0].get("snapshot", {}).get("phases"), (
+        "the dump's perf_alert must carry the KernelWatch window snapshot"
+    )
+    engine.query_arrays = orig_query_arrays
+    deadline = time.monotonic() + CLEAR_DEADLINE_S
+    while svc.perf_snapshot()["alert_active"] and time.monotonic() < deadline:
+        time.sleep(0.2)  # the watchdog ages the windows out
+    assert not svc.perf_snapshot()["alert_active"], (
+        "the alert must clear once the regression stops"
+    )
+    from splink_tpu.obs.exposition import render_samples
+
+    text = render_samples(svc.prometheus_samples())
+    svc.close()
+    print(f"perf 3 ok: {SLOW_S * 1e3:.0f}ms injected regression fired the "
+          f"two-window alert, dumped "
+          f"{os.path.basename(svc._flight.dumps[0])}, and cleared after "
+          "recovery")
+
+    # ---- 5: tooling over the captured record ----------------------------
+    events = read_events(events_path)
+    alerts = [e for e in events if e.get("type") == "perf_alert"]
+    clears = [e for e in events if e.get("type") == "perf_clear"]
+    assert len(alerts) == 1, f"edge-triggered: {len(alerts)} alert events"
+    assert len(clears) == 1, f"edge-triggered: {len(clears)} clear events"
+    assert [e for e in events if e.get("type") == "perf_window"]
+    report = summarize_events(events)
+    assert "kernel perf" in report, report
+    assert "ALERT batch" in report, report
+    assert "alert cleared" in report, report
+    assert "splink_serve_perf_anchor_ms" in text
+    assert "# TYPE splink_serve_phase_seconds histogram" in text
+    assert "process_resident_memory_bytes" in text
+    print("perf 4 ok: obs summarize renders the perf timeline, exposition "
+          "carries the perf gauges + native histogram + process gauges")
+
+    sink.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({
+        "metric": "perf_smoke",
+        "audit_shapes": shapes,
+        "audit_seconds": round(audit_s, 1),
+        "clean_anchor_ms": round(anchor, 3),
+        "steady_state_recompiles": c1 - c0,
+    }))
+    print("perf-smoke OK: audit clean on committed baselines, injected "
+          "regression alerted + dumped + cleared, zero steady-state "
+          "recompiles with the watch on")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
-"""Static-analysis + jaxpr/SPMD-audit framework gating CI.
+"""Static-analysis + jaxpr/SPMD-audit + measured-perf framework gating CI.
 
-Three layers, one finding model:
+Four layers, one finding model:
 
   * :mod:`.jaxlint` — AST lint pass over JAX hazard classes (host calls and
     syncs on traced values, Python branches on tracers, unpinned dtypes,
@@ -14,14 +14,20 @@ Three layers, one finding model:
   * :mod:`.shard_audit` — lowers the sharded kernels on a forced 8-device
     mesh and asserts SPMD partition safety (declared shardings, exact
     collective budgets, padding-weight threading, cost/memory baselines).
+  * :mod:`.perf_audit` — the measured layer: compiles AND executes every
+    registered kernel at 1-3 shapes and gates compile/execute wall +
+    memory against committed per-``(tier, kernel, shape)`` baselines
+    (``perf_baselines.json``; one-sided bands, median-of-K noise guard).
 
 CLI: ``python -m splink_tpu.analysis splink_tpu/ [--audit] [--shard-audit]
-[--json]``; ``make lint`` runs all three layers, and
-tests/test_codebase_clean.py gates tier-1 on a clean run.
+[--perf-audit] [--json]``; ``make lint`` runs the static layers (plus the
+perf-plan listing), ``make perf-smoke`` runs the measured layer, and
+tests/test_codebase_clean.py gates tier-1 on a clean static run.
 """
 
 from .findings import Finding, Report
 from .jaxlint import lint_paths, lint_source
+from .perf_audit import perf_plan, run_perf_audit
 from .rules import RULES, rule
 from .shard_audit import (
     SHARD_REGISTRY,
@@ -48,4 +54,6 @@ __all__ = [
     "register_shard_kernel",
     "run_shard_audit",
     "update_baselines",
+    "perf_plan",
+    "run_perf_audit",
 ]

@@ -77,11 +77,37 @@ def main(argv=None) -> int:
         "shard_baselines.json (implies --shard-audit)",
     )
     parser.add_argument(
+        "--perf-audit",
+        action="store_true",
+        help="also run the measured perf audit (layer 4): compile/execute "
+        "wall + memory vs the committed perf_baselines.json tier block",
+    )
+    parser.add_argument(
+        "--perf-kernels",
+        help="comma-separated kernel names to perf-audit (implies "
+        "--perf-audit)",
+    )
+    parser.add_argument(
+        "--update-perf-baselines",
+        action="store_true",
+        help="re-measure the perf plan and rewrite this tier's block of "
+        "perf_baselines.json (implies --perf-audit)",
+    )
+    parser.add_argument(
+        "--list-perf-kernels",
+        action="store_true",
+        help="print the perf-audit measurement plan (kernels, shapes, "
+        "exclusions) without measuring anything",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
     args = parser.parse_args(argv)
     shard_requested = (
         args.shard_audit or args.shard_kernels or args.update_baselines
+    )
+    perf_requested = (
+        args.perf_audit or args.perf_kernels or args.update_perf_baselines
     )
 
     if args.list_rules:
@@ -89,8 +115,14 @@ def main(argv=None) -> int:
             print(f"{spec.id}  {spec.title}\n       {spec.doc}")
         return 0
 
+    if args.list_perf_kernels:
+        from .perf_audit import format_plan, perf_plan
+
+        print(format_plan(perf_plan()))
+        return 0
+
     if not args.paths and not (
-        args.audit or args.audit_kernels or shard_requested
+        args.audit or args.audit_kernels or shard_requested or perf_requested
     ):
         parser.print_usage(sys.stderr)
         print(
@@ -151,6 +183,40 @@ def main(argv=None) -> int:
             return 2
         report.extend(shard_findings)
         report.shard_kernels_audited = shard_audited
+
+    if perf_requested:
+        from .perf_audit import current_tier, run_perf_audit
+        from .perf_audit import update_baselines as update_perf_baselines
+
+        perf_kernels = (
+            [k.strip() for k in args.perf_kernels.split(",") if k.strip()]
+            if args.perf_kernels
+            else None
+        )
+        try:
+            if args.update_perf_baselines:
+                new = update_perf_baselines(perf_kernels)
+                tier = current_tier()
+                print(
+                    f"wrote perf baselines for "
+                    f"{len(new['tiers'][tier]['kernels'])} kernel(s) "
+                    f"on tier '{tier}'",
+                    file=sys.stderr,
+                )
+                # the cells just measured ARE the new baselines — a
+                # second measurement pass would only compare the plan
+                # against numbers taken seconds ago (another ~30s on the
+                # CPU tier, plus a flap risk on a loaded container)
+                from .perf_audit import perf_plan
+
+                perf_findings, perf_shapes = [], len(perf_plan(perf_kernels))
+            else:
+                perf_findings, perf_shapes = run_perf_audit(perf_kernels)
+        except KeyError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        report.extend(perf_findings)
+        report.perf_shapes_audited = perf_shapes
 
     print(report.format_json() if args.json else report.format_text())
     return 0 if report.clean else 1

@@ -40,6 +40,7 @@ class Report:
     files_checked: int = 0
     kernels_audited: int = 0
     shard_kernels_audited: int = 0
+    perf_shapes_audited: int = 0
 
     def extend(self, findings) -> None:
         self.findings.extend(findings)
@@ -61,6 +62,10 @@ class Report:
         )
         if self.shard_kernels_audited:
             tail += f", {self.shard_kernels_audited} shard kernel(s) audited"
+        if self.perf_shapes_audited:
+            tail += (
+                f", {self.perf_shapes_audited} perf shape(s) measured"
+            )
         lines.append(tail)
         return "\n".join(lines)
 
@@ -71,6 +76,7 @@ class Report:
                 "files_checked": self.files_checked,
                 "kernels_audited": self.kernels_audited,
                 "shard_kernels_audited": self.shard_kernels_audited,
+                "perf_shapes_audited": self.perf_shapes_audited,
                 "clean": self.clean,
             },
             indent=2,

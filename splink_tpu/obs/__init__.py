@@ -34,8 +34,14 @@ Layers (each importable on its own, none imports jax at module scope):
   * :mod:`.drift`   — serve-time device drift sketches, PSI /
     Jensen-Shannon scoring of rolling windows vs the reference, and the
     two-window drift alerts (``drift_window_s`` / ``drift_alert_psi``).
+  * :mod:`.kernelwatch` — serve-time execute-latency regression monitor
+    (``perf_alert_ratio`` / ``perf_window_s``): post-warmup anchors,
+    two-window p95 alerts, EWMAs and native-histogram series over
+    signals the service already collects — the runtime half of the
+    performance observatory (:mod:`..analysis.perf_audit` is the CI
+    half).
   * :mod:`.cli`     — ``python -m splink_tpu.obs
-    summarize|export-trace|attribute|drift|serve-dash``.
+    summarize|export-trace|attribute|drift|bench-report|serve-dash``.
 
 Zero-cost contract: with no sink configured (``telemetry_dir`` empty) the
 linker adds NO host callbacks and compiled programs are unchanged — the
@@ -48,8 +54,14 @@ See docs/observability.md for the event schema and CLI usage.
 
 from .drift import DriftMonitor, js_divergence, psi
 from .events import EventSink, publish, read_events
-from .exposition import ExpositionServer, HistogramSample, Sample
+from .exposition import (
+    ExpositionServer,
+    HistogramSample,
+    Sample,
+    process_samples,
+)
 from .flight import FlightRecorder
+from .kernelwatch import KernelWatch
 from .quality import QualityProfile, em_diagnostics
 from .metrics import MetricsRegistry, compile_totals, install_compile_monitor
 from .reqtrace import PHASES, PhaseProfile, RequestTrace, ServeTracer
@@ -75,7 +87,9 @@ __all__ = [
     "ExpositionServer",
     "Sample",
     "HistogramSample",
+    "process_samples",
     "FlightRecorder",
+    "KernelWatch",
     "QualityProfile",
     "em_diagnostics",
     "DriftMonitor",

@@ -23,11 +23,16 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
+import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 logger = logging.getLogger("splink_tpu")
+
+#: uptime fallback anchor where /proc is unavailable (first obs import)
+_PROCESS_T0 = time.time()
 
 _TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
 
@@ -94,6 +99,90 @@ def histogram_from_counts(
         labels=dict(labels or {}),
         help=help,
     )
+
+
+def _process_start_time() -> float:
+    """Unix timestamp of process start: /proc starttime + boot time on
+    Linux, the first-obs-import anchor elsewhere."""
+    try:
+        with open("/proc/self/stat", "rb") as fh:
+            # field 22 (1-based) counts clock ticks since boot; the comm
+            # field may contain spaces, so split after the closing paren
+            fields = fh.read().rsplit(b")", 1)[1].split()
+        ticks = int(fields[19])
+        with open("/proc/stat", "rb") as fh:
+            btime = next(
+                int(line.split()[1])
+                for line in fh
+                if line.startswith(b"btime")
+            )
+        return btime + ticks / os.sysconf("SC_CLK_TCK")
+    except Exception:  # noqa: BLE001 - non-Linux / exotic procfs
+        return _PROCESS_T0
+
+
+def process_samples() -> list:
+    """Process-level health gauges in the conventional Prometheus names:
+    resident memory, cumulative user/system CPU seconds, open file
+    descriptors, start time and uptime. Pure stdlib (procfs + resource);
+    a metric the platform cannot answer is omitted rather than faked —
+    scrapers see the series they can trust. Served alongside the
+    per-replica serve series by ``LinkageService.prometheus_samples``."""
+    out: list[Sample] = []
+    rss = None
+    try:
+        with open("/proc/self/statm", "rb") as fh:
+            rss = int(fh.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001 - non-Linux
+        rss = None
+    if rss is not None:
+        out.append(Sample(
+            "process_resident_memory_bytes", float(rss), {}, "gauge",
+            "Resident set size in bytes",
+        ))
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        out.append(Sample(
+            "process_cpu_seconds_total", ru.ru_utime + ru.ru_stime, {},
+            "counter", "Total user+system CPU seconds",
+        ))
+        out.append(Sample(
+            "process_cpu_user_seconds_total", ru.ru_utime, {}, "counter",
+            "User-mode CPU seconds",
+        ))
+        out.append(Sample(
+            "process_cpu_system_seconds_total", ru.ru_stime, {}, "counter",
+            "Kernel-mode CPU seconds",
+        ))
+        if rss is None and ru.ru_maxrss:
+            # no procfs: report the rusage high-water mark, labelled so
+            out.append(Sample(
+                "process_resident_memory_bytes", float(ru.ru_maxrss * 1024),
+                {"kind": "peak"}, "gauge",
+                "Peak resident set size in bytes (ru_maxrss; live RSS "
+                "unavailable on this platform)",
+            ))
+    except Exception:  # noqa: BLE001 - resource module may be absent (windows)
+        pass
+    try:
+        out.append(Sample(
+            "process_open_fds", float(len(os.listdir("/proc/self/fd"))),
+            {}, "gauge", "Open file descriptors",
+        ))
+    except Exception:  # noqa: BLE001 - non-Linux
+        pass
+    start = _process_start_time()
+    out.append(Sample(
+        "process_start_time_seconds", start, {}, "gauge",
+        "Process start time (unix seconds)",
+    ))
+    out.append(Sample(
+        "process_uptime_seconds", max(time.time() - start, 0.0), {},
+        "gauge", "Seconds since process start",
+    ))
+    return out
 
 
 def _escape_label(value) -> str:

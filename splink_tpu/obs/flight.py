@@ -59,6 +59,9 @@ TRANSITION_TYPES = (
     "retry",
     "drift_alert",
     "drift_clear",
+    "perf_alert",
+    "perf_clear",
+    "perf_window",
 )
 
 _RECORDERS: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
@@ -185,6 +188,13 @@ class FlightRecorder:
             # around that moment (which queries, which health state, any
             # swap that landed) is exactly the retraining post-mortem
             return "drift_alert"
+        if type == "perf_alert":
+            # the serving kernels got slower: the event carries the
+            # KernelWatch window snapshot, so the dump holds both the
+            # regression numbers and the traffic around them (rate-
+            # limited like breaker-open — a sustained regression produces
+            # one artifact, not one per tick)
+            return "perf_alert"
         if type == "degradation":
             to = fields.get("to")
             if to == "breaker_open":

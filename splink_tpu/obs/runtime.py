@@ -69,6 +69,15 @@ class RunContext:
         self.memory_snapshots = memory_snapshots
         self.tracer = Tracer()
         self.metrics = MetricsRegistry()
+        # Per-stage execute-time EWMAs + windows (obs/kernelwatch.py):
+        # the offline half of the performance observatory — fed with each
+        # stage's execute split (wall minus compile), alerting disabled
+        # (offline stages have no steady state to anchor alerts on); the
+        # snapshot lands in the run record at finish() as the
+        # ``kernel_watch`` metrics record.
+        from .kernelwatch import KernelWatch
+
+        self.kernelwatch = KernelWatch(window_s=300.0, alert_ratio=0.0)
         self._t0 = time.monotonic()
         # EM stream state: parent span + previous params for the host-side
         # delta/max-movement computation (the io_callback hook hands us the
@@ -146,6 +155,7 @@ class RunContext:
         self.metrics.count("compile_count", c1_count - c0_count)
         self.metrics.count("compile_s", compile_s)
         self.metrics.count("execute_s", max(elapsed - compile_s, 0.0))
+        self.kernelwatch.observe(stage, max(elapsed - compile_s, 0.0))
         if self.memory_snapshots:
             devices = device_memory_snapshot()
             if devices:
@@ -273,6 +283,8 @@ class RunContext:
         record."""
         if not self.enabled:
             return
+        if self.kernelwatch.phases():
+            self.metrics.record("kernel_watch", self.kernelwatch.snapshot())
         self.sink.emit("metrics", **self.metrics.snapshot())
         span = self.tracer.emit_closed(
             "run", "run", self._t0, time.monotonic(), parent=None

@@ -140,6 +140,8 @@ class LinkageService:
         slo_objective: float = 0.999,
         flight_records: int | None = None,
         exposition_port: int | None = None,
+        perf_alert_ratio: float | None = None,
+        perf_window_s: float | None = None,
     ):
         settings = engine.index.settings
         self.engine = engine
@@ -244,6 +246,30 @@ class LinkageService:
         # engine sketches (quality_profile on AND a profiled index) ------
         self._drift_alert_active = False
         self._drift = self._make_drift_monitor()
+        # -- kernel performance watch (obs/kernelwatch.py): rolling-window
+        # execute-latency regression alerts over the batch wall and the
+        # PhaseProfile splits the engine already measures — host-side
+        # arithmetic only, zero new syncs on the hot path ----------------
+        self._perf_alert_active = False
+        self._last_perf_window = float("-inf")
+        self._last_perf_eval = float("-inf")
+        ratio = float(
+            perf_alert_ratio
+            if perf_alert_ratio is not None
+            else settings.get("perf_alert_ratio", 3.0) or 0.0
+        )
+        self._kwatch = None
+        if ratio > 0:
+            from ..obs.kernelwatch import KernelWatch
+
+            self._kwatch = KernelWatch(
+                window_s=float(
+                    perf_window_s
+                    if perf_window_s is not None
+                    else settings.get("perf_window_s", 30.0) or 30.0
+                ),
+                alert_ratio=ratio,
+            )
         self._exposition = None
         port = int(
             exposition_port
@@ -489,6 +515,7 @@ class LinkageService:
                 # drift drains ride BETWEEN batches (one bounded device
                 # fetch per drain cadence, never inside a dispatch)
                 self._drift_tick()
+                self._perf_tick()
         except Exception:  # noqa: BLE001 - a dying worker must not spam stderr
             logger.exception(
                 "serve worker thread died; the watchdog will shed its "
@@ -604,11 +631,13 @@ class LinkageService:
         futures = [e[1] for e in live]
         t_enq = [e[2] for e in live]
         traces = [e[4] for e in live]
-        # one batch-level phase profile when any request is traced: every
+        # one batch-level phase profile when any request is traced — every
         # request in the batch waited through the same engine window, so
-        # the batch splits ARE each request's attribution
+        # the batch splits ARE each request's attribution — or when the
+        # kernel watch wants the execute split (profiling divides the
+        # engine's single existing rendezvous; it adds no host sync)
         profile = None
-        if any(tr is not None for tr in traces):
+        if any(tr is not None for tr in traces) or self._kwatch is not None:
             from ..obs.reqtrace import PhaseProfile
 
             profile = PhaseProfile()
@@ -666,6 +695,15 @@ class LinkageService:
             publish("breaker", state="closed", reason="probe batch succeeded")
             logger.info("serve circuit breaker closed: probe batch succeeded")
         self._admission.observe(batch_ms)
+        if self._kwatch is not None and not degraded:
+            # compiling batches are warmup, not steady state — the watch
+            # anchors on (and alerts over) post-warmup execute only; the
+            # brown-out program's reduced shapes are likewise excluded
+            if profile is None or profile.compile_s <= 0.0:
+                self._kwatch.observe("batch", batch_ms / 1000.0)
+                if profile is not None:
+                    self._kwatch.observe("execute", profile.execute_s)
+                    self._kwatch.observe("transfer", profile.transfer_s)
         now = time.monotonic()
         generation = self.engine.generation
         for tr in traces:
@@ -861,8 +899,10 @@ class LinkageService:
         # 3. health evaluation from live signals
         self._maybe_evaluate_health()
         # 4. drift windows advance even when traffic stops (an idle
-        # service must still age out its rolling drift windows)
+        # service must still age out its rolling drift windows), and the
+        # perf-alert state machine ages out of alerting the same way
         self._drift_tick()
+        self._perf_tick()
 
     # -- drift observatory ----------------------------------------------
 
@@ -959,6 +999,89 @@ class LinkageService:
             )
         snap = self._drift.snapshot()
         snap["alert_active"] = self._drift_alert_active
+        return snap
+
+    # -- kernel performance watch ----------------------------------------
+
+    def _perf_tick(self, force: bool = False) -> None:
+        """Advance the perf-regression alert state machine (edge-triggered
+        ``perf_alert``/``perf_clear`` events — the alert carries the window
+        snapshot and dumps the flight recorder) and publish the periodic
+        ``perf_window`` report. Host-side only; never raises into the
+        worker/watchdog. Evaluation is rate-limited (the drift-tick
+        shape): a snapshot sorts every phase's windows, which is O(window)
+        work the per-batch path must not pay — ``observe`` stays the only
+        per-batch cost. ``force`` skips the cadence gate (tests)."""
+        kw = self._kwatch
+        if kw is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_perf_eval < min(
+            1.0, kw.window_s / 8.0
+        ):
+            return
+        self._last_perf_eval = now
+        try:
+            from ..obs.events import publish
+
+            snap = kw.snapshot()
+            fired = snap["alerts"]
+            if fired and not self._perf_alert_active:
+                self._perf_alert_active = True
+                publish(
+                    "perf_alert", replica=self.name, alerts=fired,
+                    snapshot=snap,
+                )
+                logger.warning(
+                    "serve perf alert: %s p95 regressed past %.3gx the "
+                    "post-warmup anchor over both the %.0fs and %.0fs "
+                    "windows — the serving kernels got slower",
+                    ", ".join(a["phase"] for a in fired),
+                    kw.alert_ratio, kw.window_s, kw.long_window_s,
+                )
+            elif not fired and self._perf_alert_active:
+                self._perf_alert_active = False
+                publish("perf_clear", replica=self.name)
+                logger.info("serve perf alert cleared (replica %s)",
+                            self.name)
+            now = time.monotonic()
+            if now - self._last_perf_window >= kw.window_s / 2.0:
+                phases = {
+                    name: {
+                        "anchor_ms": st["anchor_ms"],
+                        "ewma_ms": st["ewma_ms"],
+                        "p95_ms": st["short"]["p95_ms"],
+                        "n": st["short"]["n"],
+                    }
+                    for name, st in snap["phases"].items()
+                    if st is not None
+                }
+                if any(p["n"] for p in phases.values()):
+                    self._last_perf_window = now
+                    publish(
+                        "perf_window",
+                        replica=self.name,
+                        window_s=kw.window_s,
+                        phases=phases,
+                        alert_active=self._perf_alert_active,
+                    )
+        except Exception as e:  # noqa: BLE001 - obs must not break serving
+            logger.warning("perf tick failed: %s", e)
+
+    def perf_snapshot(self) -> dict:
+        """The kernel watch's live report: per-phase post-warmup anchor,
+        EWMA and short/long-window p95 plus fired alerts. A service
+        without the watch (``perf_alert_ratio`` 0) reports
+        ``enabled: False`` with the reason — it never raises."""
+        if self._kwatch is None:
+            return {
+                "enabled": False,
+                "reason": "kernel watch disabled (perf_alert_ratio 0)",
+                "alerts": [],
+            }
+        snap = self._kwatch.snapshot()
+        snap["enabled"] = True
+        snap["alert_active"] = self._perf_alert_active
         return snap
 
     # -- health ---------------------------------------------------------
@@ -1068,6 +1191,19 @@ class LinkageService:
         # against the new one
         self._drift = self._make_drift_monitor()
         self._drift_alert_active = False
+        # a new index changes the legitimate steady-state cost of every
+        # phase: re-anchor the kernel watch on post-swap traffic (a stale
+        # anchor would judge the new index against the old one's speed —
+        # false latched alerts after growing the index, masked
+        # regressions after shrinking it)
+        if self._kwatch is not None:
+            from ..obs.kernelwatch import KernelWatch
+
+            self._kwatch = KernelWatch(
+                window_s=self._kwatch.window_s,
+                alert_ratio=self._kwatch.alert_ratio,
+            )
+            self._perf_alert_active = False
         return stats
 
     # -- reporting ------------------------------------------------------
@@ -1215,6 +1351,75 @@ class LinkageService:
                     "Closed span trees by outcome",
                 ))
         out.extend(self._drift_samples(replica))
+        out.extend(self._perf_samples(replica))
+        from ..obs.exposition import process_samples
+
+        out.extend(process_samples())
+        return out
+
+    def _perf_samples(self, replica: dict) -> list:
+        """Kernel-watch series: watch presence, the alert gauge,
+        per-phase anchor/EWMA/window-p95 gauges and the per-phase
+        execute-time distribution as a NATIVE Prometheus histogram with
+        an exact ``_sum`` (the watch accumulates raw seconds)."""
+        from ..obs.exposition import HistogramSample, Sample
+
+        kw = self._kwatch
+        out = [Sample(
+            "splink_serve_perf_watch",
+            1.0 if kw is not None else 0.0, replica, "gauge",
+            "KernelWatch execute-latency regression monitor enabled",
+        )]
+        if kw is None:
+            return out
+        out.append(Sample(
+            "splink_serve_perf_alert",
+            1.0 if self._perf_alert_active else 0.0, replica, "gauge",
+            "Two-window execute-latency regression alert firing",
+        ))
+        for phase in kw.phases():
+            st = kw.phase_stats(phase)
+            if st is None:
+                continue
+            labels = {**replica, "phase": phase}
+            if st["anchor_ms"] is not None:
+                out.append(Sample(
+                    "splink_serve_perf_anchor_ms", st["anchor_ms"], labels,
+                    "gauge", "Post-warmup steady-state anchor (ms)",
+                ))
+            if st["ewma_ms"] is not None:
+                out.append(Sample(
+                    "splink_serve_perf_ewma_ms", st["ewma_ms"], labels,
+                    "gauge", "Smoothed execute-time trend (ms)",
+                ))
+            for window in ("short", "long"):
+                p95 = st[window]["p95_ms"]
+                if p95 is not None:
+                    out.append(Sample(
+                        "splink_serve_perf_p95_ms", p95,
+                        {**labels, "window": window}, "gauge",
+                        "Rolling-window p95 execute time (ms)",
+                    ))
+            hist = kw.histogram(phase)
+            if hist is not None:
+                # n can exceed sum(counts): past-last-edge observations
+                # live only in the +Inf bucket the renderer appends
+                counts, edges, total, n = hist
+                if n:
+                    cum = 0
+                    buckets = []
+                    for c, e in zip(counts, edges):
+                        cum += c
+                        buckets.append((e, cum))
+                    out.append(HistogramSample(
+                        name="splink_serve_phase_seconds",
+                        buckets=buckets,
+                        sum=total,
+                        count=n,
+                        labels=labels,
+                        help="Per-phase execute-time distribution "
+                             "(seconds; exact sum)",
+                    ))
         return out
 
     def _drift_samples(self, replica: dict) -> list:

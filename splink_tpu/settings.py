@@ -93,6 +93,8 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "drift_sketch_bins",
     "drift_window_s",
     "drift_alert_psi",
+    "perf_alert_ratio",
+    "perf_window_s",
 ]
 
 

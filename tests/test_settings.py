@@ -435,3 +435,28 @@ def test_quality_observatory_key_types_validated():
             drift_alert_psi=0,
         )
     )
+
+
+def test_perf_observatory_defaults_filled():
+    """The kernel-watch keys complete from the schema: the serve-time
+    regression alert is ON by default (host-side arithmetic only) at the
+    3x two-window ratio over a 30 s short window."""
+    s = complete_settings_dict(_minimal())
+    assert s["perf_alert_ratio"] == 3
+    assert s["perf_window_s"] == 30
+
+
+def test_perf_observatory_key_types_validated():
+    """Type/bound violations on the kernel-watch keys are rejected by the
+    schema validator (the established key-validation pattern)."""
+    for bad in (
+        {"perf_alert_ratio": -1},
+        {"perf_alert_ratio": "strict"},
+        {"perf_window_s": 0},
+        {"perf_window_s": -3},
+        {"perf_window_s": "minute"},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    # valid values pass (perf_alert_ratio=0 disables the watch entirely)
+    validate_settings(_minimal(perf_alert_ratio=0, perf_window_s=2.5))
