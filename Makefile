@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -114,6 +114,14 @@ drift-smoke:
 perf-smoke:
 	python scripts/perf_smoke.py
 
+# Term-frequency smoke: serve<->offline TF-adjusted parity bit-identical
+# (fused + unfused) on a TF-flagged model, a legacy TF-less artifact
+# round-trips and serves unchanged, and a FRESH process restores the TF
+# serve menu from the AOT sidecar with zero backend compiles and
+# bit-identical first-query answers (docs/serving.md#term-frequency).
+tf-smoke:
+	python scripts/tf_smoke.py
+
 bench:
 	python bench.py
 
@@ -121,4 +129,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke drift-smoke perf-smoke bench
+all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke bench

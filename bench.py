@@ -854,6 +854,201 @@ def bench_approx():
     print(json.dumps(out))
 
 
+def bench_tf():
+    """Term-frequency benchmark (`python bench.py tf`, round 14): the two
+    TF tiers of ISSUE 14 measured together.
+
+    Serving half: ONE index built from a TF-flagged model serves two
+    engines — the fused TF fold on (the new default) and off (the
+    previous behaviour) — INTERLEAVED best-of-N open bursts over the
+    same warmed shapes, so the shared-container drift hits both tiers
+    alike; the compile counter gates zero steady-state compile requests
+    with the fold on, and one query batch is parity-checked bit-exact
+    against the offline ``tf_match_probability`` column.
+
+    Blocking half: the round-11 typo corpus (every blocking key of every
+    twin corrupted) at the SAME 8n pair budget, recall measured with and
+    without ``approx_tf_weighting`` — the claim is recall-per-budget,
+    anchored against round 11's 89.1%."""
+    tier = _probe_device_init()
+    import jax
+    import pandas as pd
+
+    from splink_tpu import Splink
+    from splink_tpu.obs.metrics import (
+        compile_requests,
+        install_compile_monitor,
+    )
+    from splink_tpu.serve import LinkageService, QueryEngine
+
+    install_compile_monitor()
+    n_rows = int(os.environ.get("SPLINK_TPU_BENCH_TF_SERVE_ROWS", 200_000))
+    n_queries = int(os.environ.get("SPLINK_TPU_BENCH_TF_QUERIES", 2000))
+    repeats = int(os.environ.get("SPLINK_TPU_BENCH_TF_REPEATS", 5))
+    rng = np.random.default_rng(0)
+    df = _make_df(rng, n_rows)
+
+    settings = dict(SETTINGS)
+    settings["comparison_columns"] = [
+        dict(c) for c in SETTINGS["comparison_columns"]
+    ]
+    for c in settings["comparison_columns"]:
+        if c["col_name"] in ("first_name", "surname", "city"):
+            c["term_frequency_adjustments"] = True
+    settings["max_iterations"] = 5
+    settings["serve_top_k"] = 5
+    settings["serve_queue_depth"] = n_queries
+    linker = Splink(settings, df=df)
+    t0 = time.perf_counter()
+    linker.estimate_parameters()
+    train_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    index = linker.export_index()
+    build_s = time.perf_counter() - t0
+    assert index.tf_fold_columns(), "TF fold data missing from the index"
+
+    engines = {}
+    warm = {}
+    t0 = time.perf_counter()
+    for name, tf in (("tf_on", True), ("tf_off", False)):
+        eng = QueryEngine(index, tf_adjust=tf)
+        warm[name] = eng.warmup()
+        engines[name] = eng
+    warmup_s = time.perf_counter() - t0
+
+    records = df.sample(
+        n=min(n_queries, len(df)), replace=n_queries > len(df),
+        random_state=0,
+    ).to_dict(orient="records")
+    while len(records) < n_queries:
+        records.extend(records[: n_queries - len(records)])
+
+    # parity gates on the measured build (the tf-smoke holds the full
+    # serve<->offline gate): the fused TF program is bit-identical to the
+    # unfused oracle, and the fold actually moves scores vs TF-off
+    probe = df.iloc[:256].reset_index(drop=True)
+    p_on, rows_on, valid_on, _ = engines["tf_on"].query_arrays(probe)
+    oracle = QueryEngine(index, fused=False)
+    oracle.warmup()
+    p_or, rows_or, valid_or, _ = oracle.query_arrays(probe)
+    assert np.array_equal(p_on, p_or) and np.array_equal(rows_on, rows_or)
+    p_off_probe, _, valid_off, _ = engines["tf_off"].query_arrays(probe)
+    tf_moved = int(np.sum(valid_on & valid_off & (p_on != p_off_probe)))
+    # steady state starts HERE: warmup + parity probes are done
+    c_warm = compile_requests()
+
+    tiers = {
+        name: LinkageService(eng, deadline_ms=2.0)
+        for name, eng in engines.items()
+    }
+    best = {name: 0.0 for name in tiers}
+    for rep in range(repeats):
+        # alternate tier ORDER per repeat as well as interleaving: the
+        # 2-core container's burst throughput drifts ~3x run to run, and
+        # a fixed order systematically hands one tier the colder slot
+        order = tuple(tiers) if rep % 2 == 0 else tuple(reversed(tiers))
+        for name in order:
+            svc = tiers[name]
+            t0 = time.perf_counter()
+            futs = [svc.submit(dict(r)) for r in records]
+            for f in futs:
+                f.result()
+            best[name] = max(
+                best[name], n_queries / (time.perf_counter() - t0)
+            )
+    for svc in tiers.values():
+        svc.close()
+    c_end = compile_requests()
+
+    # ---- blocking half: the round-11 typo corpus at the 8n budget ----
+    from splink_tpu.approx.lsh import (
+        build_approx_plan,
+        generate_approx_candidates,
+    )
+    from splink_tpu.data import encode_table
+    from splink_tpu.settings import complete_settings_dict
+
+    n_base = int(os.environ.get("SPLINK_TPU_BENCH_TF_APPROX_ROWS", 20_000))
+    base = _make_df(np.random.default_rng(0), n_base)
+    base["first_name"] = base["first_name"].astype(str) + (
+        np.arange(n_base) % 1000
+    ).astype(str)
+    base["surname"] = base["surname"].astype(str) + (
+        np.arange(n_base) % 997
+    ).astype(str)
+    twins = base.copy()
+    twins["unique_id"] = twins["unique_id"] + n_base
+    crng = np.random.default_rng(1)
+
+    def corrupt(v):
+        k = int(crng.integers(0, len(v)))
+        return v[:k] + "#" + v[k + 1 :]
+
+    twins["first_name"] = [corrupt(v) for v in twins["first_name"]]
+    twins["surname"] = [corrupt(v) for v in twins["surname"]]
+    corpus = pd.concat([base, twins], ignore_index=True)
+    budget = 8 * n_base
+    true = set(zip(range(n_base), range(n_base, 2 * n_base)))
+
+    recalls = {}
+    approx_secs = {}
+    for key, weighting in (("tf", True), ("unweighted", False)):
+        s = complete_settings_dict(
+            {
+                **{k: v for k, v in SETTINGS.items()},
+                "blocking_rules": [
+                    "l.first_name = r.first_name",
+                    "l.surname = r.surname",
+                ],
+                "approx_blocking": True,
+                "approx_threshold": 0.2,
+                "approx_pair_budget": budget,
+                "approx_tf_weighting": weighting,
+            }
+        )
+        table = encode_table(corpus, s)
+        t0 = time.perf_counter()
+        plan = build_approx_plan(s, table)
+        ai, aj, coll, sim, stats = generate_approx_candidates(
+            s, table, plan=plan
+        )
+        approx_secs[key] = time.perf_counter() - t0
+        rank = np.lexsort((aj, ai, -coll, -sim))[:budget]
+        emitted = set(zip(ai[rank].tolist(), aj[rank].tolist()))
+        recalls[key] = len(true & emitted) / len(true)
+
+    qps_on, qps_off = best["tf_on"], best["tf_off"]
+    print(json.dumps({
+        "metric": "serve_tf_queries_per_sec",
+        "value": round(qps_on, 1),
+        "unit": "queries/sec",
+        "n_reference_rows": n_rows,
+        "n_queries": n_queries,
+        "repeats": repeats,
+        "train_seconds": round(train_s, 3),
+        "index_build_seconds": round(build_s, 3),
+        "warmup_seconds": round(warmup_s, 3),
+        "warmup_compiles_tf_on": warm["tf_on"]["compiles"],
+        "warmup_compiles_tf_off": warm["tf_off"]["compiles"],
+        "qps_tf_on": round(qps_on, 1),
+        "qps_tf_off": round(qps_off, 1),
+        "tf_overhead_pct": round(100 * (1 - qps_on / qps_off), 2),
+        "steady_state_compile_requests": c_end - c_warm,
+        "tf_fold_columns": len(index.tf_fold_columns()),
+        "tf_fused_unfused_parity": True,  # asserted above, bit-exact
+        "tf_scores_moved_on_probe": tf_moved,
+        "n_typo_rows": 2 * n_base,
+        "approx_budget": budget,
+        "recall_at_budget_tf": round(recalls["tf"], 4),
+        "recall_at_budget_unweighted": round(recalls["unweighted"], 4),
+        "recall_at_budget_r11_anchor": 0.891,
+        "approx_seconds_tf": round(approx_secs["tf"], 3),
+        "approx_seconds_unweighted": round(approx_secs["unweighted"], 3),
+        "device": str(jax.devices()[0]),
+        **tier,
+    }))
+
+
 def bench_drift():
     """Drift-sketch overhead benchmark (`python bench.py drift`): the
     quality observatory's serve-hot-path cost. Trains a model with
@@ -1352,6 +1547,8 @@ if __name__ == "__main__":
         bench_approx()
     elif "drift" in sys.argv[1:]:
         bench_drift()
+    elif "tf" in sys.argv[1:]:
+        bench_tf()
     elif "perf" in sys.argv[1:]:
         bench_perf()
     else:

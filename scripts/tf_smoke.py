@@ -1,0 +1,226 @@
+"""Term-frequency serving smoke (`make tf-smoke`): the ISSUE 14 fold
+contracts end to end, across a REAL process boundary.
+
+Process A trains a TF-flagged model, asserts the serve<->offline
+TF-adjusted parity gate IN PROCESS (every served score bit-identical to
+the offline frame's ``tf_match_probability`` for the same pair, fused and
+unfused), exports the index + AOT sidecar and records its answers. It
+also runs the legacy leg: a TF-LESS model's artifact round-trips and
+serves bit-identically to its (unadjusted) offline scores with
+``tf_active`` False — the fold never touches models that didn't opt in.
+
+Process B — a fresh interpreter, no shared jit caches, no persistent
+compile cache — restores the TF menu from the sidecar and asserts ZERO
+backend compiles, zero cache reads, and first-query answers bit-identical
+to process A's.
+
+Exits nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUERY_HEAD = 80
+
+
+def fixture_corpus(tf: bool = True):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(7)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith"] * 6 + ["jones", "taylor", "zorn"]
+    n = 200
+    df = pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+    settings = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {
+                "col_name": "first_name",
+                "num_levels": 3,
+                "term_frequency_adjustments": tf,
+            },
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+                "term_frequency_adjustments": tf,
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.surname = r.surname"],
+        "max_iterations": 5,
+        # top_k must exceed the largest candidate block (the dominant
+        # "smith" bucket) so the parity check covers EVERY offline pair
+        "serve_top_k": 160,
+        "serve_query_buckets": [16, 128],
+        "serve_candidate_buckets": [64, 256],
+    }
+    return df, settings
+
+
+def _assert_parity(df, df_e, index, engine, col):
+    import numpy as np
+
+    offline = {
+        (r["unique_id_l"], r["unique_id_r"]): r[col]
+        for _, r in df_e.iterrows()
+    }
+    top_p, top_rows, top_valid, _ = engine.query_arrays(df)
+    served = set()
+    checked = 0
+    for q in range(len(df)):
+        for r in range(top_p.shape[1]):
+            if not top_valid[q, r]:
+                continue
+            m = int(index.unique_id[top_rows[q, r]])
+            if m == q:
+                continue
+            key = (min(q, m), max(q, m))
+            assert key in offline, f"served pair {key} missing offline"
+            assert np.float32(offline[key]) == top_p[q, r], (
+                f"serve<->offline {col} parity broke at {key}: "
+                f"{offline[key]!r} != {top_p[q, r]!r}"
+            )
+            served.add(key)
+            checked += 1
+    assert served == set(offline), "serve must cover every offline pair"
+    return checked
+
+
+def phase_build(workdir: str) -> int:
+    import numpy as np
+
+    from splink_tpu import Splink
+    from splink_tpu.serve import QueryEngine, load_index
+
+    # ---- TF leg: fold parity, fused + unfused ----
+    df, settings = fixture_corpus(tf=True)
+    linker = Splink(settings, df=df)
+    df_e = linker.get_scored_comparisons()
+    assert "tf_match_probability" in df_e.columns
+    index_dir = os.path.join(workdir, "index")
+    linker.export_index(index_dir)
+    index = load_index(index_dir)
+    assert index.tf_fold_columns(), "fold data missing from the artifact"
+    aot_dir = os.path.join(index_dir, "aot")
+    engine = QueryEngine(index, aot_dir=aot_dir)
+    assert engine.tf_active and engine._aot_binding()["tf"] is True
+    engine.warmup()
+    checked = _assert_parity(df, df_e, index, engine, "tf_match_probability")
+    oracle = QueryEngine(index, fused=False)
+    checked_or = _assert_parity(
+        df, df_e, index, oracle, "tf_match_probability"
+    )
+    engine.save_aot()
+    top_p, top_rows, top_valid, n_cand = engine.query_arrays(
+        df.head(QUERY_HEAD)
+    )
+    np.savez(
+        os.path.join(workdir, "answers.npz"),
+        top_p=top_p, top_rows=top_rows, top_valid=top_valid, n_cand=n_cand,
+    )
+
+    # ---- legacy leg: a TF-less artifact round-trips and serves as ever ----
+    df2, settings2 = fixture_corpus(tf=False)
+    linker2 = Splink(settings2, df=df2)
+    df_e2 = linker2.get_scored_comparisons()
+    assert "tf_match_probability" not in df_e2.columns
+    legacy_dir = os.path.join(workdir, "legacy_index")
+    linker2.export_index(legacy_dir)
+    legacy = load_index(legacy_dir)
+    assert not legacy.tf_fold_columns() and not legacy.tf_tids
+    eng2 = QueryEngine(legacy)
+    assert not eng2.tf_active and eng2._aot_binding()["tf"] is False
+    eng2.warmup()
+    checked2 = _assert_parity(df2, df_e2, legacy, eng2, "match_probability")
+
+    with open(os.path.join(workdir, "build.json"), "w") as fh:
+        json.dump({"checked": checked}, fh)
+    print(
+        f"tf-smoke[A] OK: TF serve<->offline parity bit-identical over "
+        f"{checked} fused + {checked_or} unfused served pairs, legacy "
+        f"TF-less round-trip bit-identical over {checked2} pairs, TF "
+        "sidecar committed"
+    )
+    return 0
+
+
+def phase_serve(workdir: str) -> int:
+    import numpy as np
+
+    from splink_tpu.obs.metrics import compile_stats, install_compile_monitor
+    from splink_tpu.serve import QueryEngine, load_index
+
+    install_compile_monitor()
+    df, _settings = fixture_corpus(tf=True)
+    index_dir = os.path.join(workdir, "index")
+    engine = QueryEngine(
+        load_index(index_dir), aot_dir=os.path.join(index_dir, "aot")
+    )
+    assert engine.tf_active, "restored engine must fold (settings default)"
+    warm = engine.warmup()
+    assert warm["compiles"] == 0, (
+        f"TF-menu AOT restore performed {warm['compiles']} backend "
+        f"compiles (expected 0): {warm}"
+    )
+    assert warm["cache_hits"] == 0, warm
+    assert warm["aot_restored"] == warm["combinations"] > 0, warm
+    got = engine.query_arrays(df.head(QUERY_HEAD))
+    stats = compile_stats()
+    assert stats["compiles"] == 0 and stats["requests"] == 0, stats
+    ref = np.load(os.path.join(workdir, "answers.npz"))
+    for name, g in zip(("top_p", "top_rows", "top_valid", "n_cand"), got):
+        e = ref[name]
+        assert e.dtype == g.dtype and e.shape == g.shape, name
+        assert np.array_equal(e, g), (
+            f"restored TF engine's {name} differs from process A "
+            "(bit-identity required)"
+        )
+    print(
+        "tf-smoke[B] OK: "
+        f"{warm['aot_restored']}/{warm['combinations']} TF executables "
+        "AOT-restored with 0 backend compiles and 0 cache reads, "
+        f"{QUERY_HEAD} first-query TF-adjusted scores bit-identical to "
+        "process A"
+    )
+    return 0
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
+        phase, workdir = sys.argv[2], sys.argv[3]
+        return phase_build(workdir) if phase == "build" else phase_serve(workdir)
+    with tempfile.TemporaryDirectory(prefix="tf_smoke_") as workdir:
+        env = dict(os.environ)
+        # hermetic: phase B asserts cache_hits == 0, so neither phase may
+        # touch the user's persistent compile cache
+        env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(workdir, "xla_cache")
+        for phase in ("build", "serve"):
+            rc = subprocess.call(
+                [sys.executable, os.path.abspath(__file__),
+                 "--phase", phase, workdir],
+                env=env, cwd=REPO,
+            )
+            if rc != 0:
+                print(f"tf-smoke FAILED in phase {phase} (rc={rc})")
+                return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

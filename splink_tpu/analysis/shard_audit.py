@@ -878,6 +878,46 @@ def _ensure_default_registry() -> None:
         params = jax.device_put(params_small, rep)
         return fn, (packed_q, packed_ref, cand, valid, params), {}
 
+    # The TF-fold variant of the fused megakernel: query-side token ids
+    # shard with the query axis (they are per-query data like packed_q),
+    # the reference token ids and log-frequency tables replicate with the
+    # reference table, and the fold's gathers read replicated operands
+    # with sharded indices — ZERO collectives, the serving contract
+    # unchanged by the adjustment.
+    @register_shard_kernel("serve_score_fused_tf_sharded", n_pairs=64)
+    def _build_serve_score_fused_tf_sharded():
+        import jax
+        import numpy as np
+
+        from ..parallel.mesh import pair_sharding, replicated
+        from ..serve.engine import make_score_fused_fn
+
+        mesh = audit_mesh()
+        program = shared_gamma_program()
+        _, params_small = shared_fs_inputs()
+        fn = make_score_fused_fn(
+            program._layout, program.settings["comparison_columns"], k=4,
+            tf_spec=((1, "city", 1),),
+        )
+        shard, rep = pair_sharding(mesh), replicated(mesh)
+        packed_q = jax.device_put(
+            np.zeros((64, program._packed.shape[1]), np.uint32), shard
+        )
+        packed_ref = jax.device_put(program._packed, rep)
+        cand = jax.device_put(np.zeros((64, 8), np.int32), shard)
+        valid = jax.device_put(np.zeros((64, 8), bool), shard)
+        params = jax.device_put(params_small, rep)
+        n_ref = program._packed.shape[0]
+        tf_q = (jax.device_put(np.zeros(64, np.int32), shard),)
+        tf_tid = (jax.device_put(np.zeros(n_ref, np.int32), rep),)
+        tf_log = (jax.device_put(np.full(4, -1.0, np.float32), rep),)
+        return (
+            fn,
+            (packed_q, packed_ref, cand, valid, params,
+             tf_q, tf_tid, tf_log),
+            {},
+        )
+
     # Device-blocking emission decode+mask body sharded over the pair-
     # POSITION axis (the blocking analogue of the pair axis): the unit
     # tables, ranks, codes and meta replicate, each shard decodes and
@@ -981,6 +1021,76 @@ def _ensure_default_registry() -> None:
         mask = jax.device_put(np.zeros((16, 1), np.uint32), rep)
         count = jax.device_put(np.full(16, 7, np.int32), rep)
         return fn, (i, j, band_codes, bytes_, lens, mask, count), {}
+
+    # The TF-WEIGHTED minhash sampler: record-sharded like the unweighted
+    # kernel, with the IDF table replicated beside the hash parameters —
+    # the per-gram IDF gather reads a replicated operand with sharded
+    # indices, so the weighted tier stays embarrassingly parallel (zero
+    # collectives).
+    @register_shard_kernel("approx_minhash_weighted_sharded", n_pairs=64)
+    def _build_approx_minhash_weighted_sharded():
+        import jax
+        import numpy as np
+
+        from ..approx.minhash import (
+            DF_TABLE_SIZE,
+            column_salts,
+            hash_params,
+            make_minhash_fn,
+        )
+        from ..parallel.mesh import pair_sharding, replicated
+
+        mesh = audit_mesh()
+        shard, rep = pair_sharding(mesh), replicated(mesh)
+        fn = make_minhash_fn(2, 4, 2, ((12, "ascii"),), weighted=True)
+        rng = np.random.default_rng(0)
+        bytes_ = jax.device_put(
+            rng.integers(97, 123, size=(64, 12)).astype(np.uint8), shard
+        )
+        lens = jax.device_put(np.full(64, 8, np.int32), shard)
+        a, b = hash_params(8)
+        salts = column_salts(1)
+        idf = jax.device_put(np.ones(DF_TABLE_SIZE, np.float32), rep)
+        return (
+            fn,
+            (bytes_, lens, jax.device_put(a, rep), jax.device_put(b, rep),
+             jax.device_put(salts, rep), idf),
+            {},
+        )
+
+    # The TF-WEIGHTED verify kernel: pair-sharded like the unweighted
+    # verifier, IDF table replicated with the byte/aux tables — each
+    # shard weighs its own pairs, zero collectives.
+    @register_shard_kernel("approx_verify_weighted_sharded", n_pairs=64)
+    def _build_approx_verify_weighted_sharded():
+        import jax
+        import numpy as np
+
+        from ..approx.lsh import make_verify_fn
+        from ..approx.minhash import DF_TABLE_SIZE
+        from ..parallel.mesh import pair_sharding, replicated
+
+        mesh = audit_mesh()
+        shard, rep = pair_sharding(mesh), replicated(mesh)
+        fn = make_verify_fn(2, 4, ((12, "ascii"),), True, weighted=True)
+        rng = np.random.default_rng(0)
+        i = jax.device_put(np.zeros(64, np.int32), shard)
+        j = jax.device_put(np.ones(64, np.int32), shard)
+        band_codes = jax.device_put(
+            rng.integers(-1, 4, size=(4, 16)).astype(np.int32), rep
+        )
+        bytes_ = jax.device_put(
+            rng.integers(97, 123, size=(16, 12)).astype(np.uint8), rep
+        )
+        lens = jax.device_put(np.full(16, 8, np.int32), rep)
+        mask = jax.device_put(np.zeros((16, 1), np.uint32), rep)
+        count = jax.device_put(np.full(16, 7, np.int32), rep)
+        idf = jax.device_put(np.ones(DF_TABLE_SIZE, np.float32), rep)
+        return (
+            fn,
+            (i, j, band_codes, bytes_, lens, mask, count, idf),
+            {},
+        )
 
     # String similarity is per-pair elementwise: zero collectives, output
     # sharded.

@@ -668,6 +668,41 @@ def _ensure_default_registry() -> None:
         valid = jnp.asarray(np.zeros((16, 8), bool))
         return fn, (packed_q, program._packed, cand, valid, params), {}
 
+    # the TF-fold variant of the fused megakernel (serve_tf_adjust): the
+    # default serving path for TF-flagged models — one extra reference-
+    # token-id gather + log-table lookup per TF column folds the
+    # u-probability adjustment into the running log-Bayes-factor. Gated
+    # exactly like the base fused kernel (it runs per request) — the
+    # forced-x64 tier catches any unpinned dtype in the fold arithmetic.
+    @register_kernel("serve_score_fused_tf")
+    def _build_serve_score_fused_tf():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..serve.engine import make_score_fused_fn
+
+        program = _gamma_program()
+        _, params = _fs_inputs()
+        # fold the exact "city" comparison (index 1, 2 levels -> top 1)
+        fn = make_score_fused_fn(
+            program._layout, program.settings["comparison_columns"], k=4,
+            tf_spec=((1, "city", 1),),
+        )
+        packed_q = jnp.asarray(np.zeros((16, program._packed.shape[1]),
+                                        np.uint32))
+        cand = jnp.asarray(np.zeros((16, 8), np.int32))
+        valid = jnp.asarray(np.zeros((16, 8), bool))
+        n_ref = program._packed.shape[0]
+        tf_q = (jnp.asarray(np.zeros(16, np.int32)),)
+        tf_tid = (jnp.asarray(np.zeros(n_ref, np.int32)),)
+        tf_log = (jnp.asarray(np.full(4, -1.0, np.float32)),)
+        return (
+            fn,
+            (packed_q, program._packed, cand, valid, params,
+             tf_q, tf_tid, tf_log),
+            {},
+        )
+
     # ----- device-native blocking (splink_tpu/blocking_device.py) -----
     # These kernels sit on the TRAINING-time hot path (candidate
     # generation for every materialised-pair run), so they are gated like
@@ -794,6 +829,70 @@ def _ensure_default_registry() -> None:
         mask = jnp.asarray(np.zeros((16, 1), np.uint32))
         count = jnp.asarray(np.full(16, 7, np.int32))
         return fn, (i, j, band_codes, bytes_, lens, mask, count), {}
+
+    # the TF-WEIGHTED minhash sampler (approx_tf_weighting): exponential-
+    # race weighted sampling — one IDF gather per gram, f32 race values,
+    # winning-gram identity as the signature lane. Same gating as the
+    # unweighted kernel (it runs over every record and per serve
+    # fallback batch).
+    @register_kernel("approx_minhash_weighted")
+    def _build_approx_minhash_weighted():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..approx.minhash import (
+            DF_TABLE_SIZE,
+            column_salts,
+            hash_params,
+            make_minhash_fn,
+        )
+
+        fn = make_minhash_fn(2, 4, 2, ((12, "ascii"),), weighted=True)
+        rng = np.random.default_rng(0)
+        bytes_ = jnp.asarray(
+            rng.integers(97, 123, size=(16, 12)).astype(np.uint8)
+        )
+        lens = jnp.asarray(np.full(16, 8, np.int32))
+        a, b = hash_params(8)
+        salts = column_salts(1)
+        idf = jnp.asarray(np.ones(DF_TABLE_SIZE, np.float32))
+        return (
+            fn,
+            (bytes_, lens, jnp.asarray(a), jnp.asarray(b),
+             jnp.asarray(salts), idf),
+            {},
+        )
+
+    # the TF-WEIGHTED verify kernel (approx_tf_weighting + threshold):
+    # IDF-weighted q-gram Jaccard — sum of gram weights over the
+    # intersection / union of the distinct-gram sets, weights gathered at
+    # the shared gram hash. Ranks the progressive emission, so it runs
+    # over every surviving candidate pair.
+    @register_kernel("approx_verify_weighted")
+    def _build_approx_verify_weighted():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..approx.lsh import make_verify_fn
+        from ..approx.minhash import DF_TABLE_SIZE
+
+        fn = make_verify_fn(2, 4, ((12, "ascii"),), True, weighted=True)
+        rng = np.random.default_rng(0)
+        i = jnp.asarray(np.zeros(32, np.int32))
+        j = jnp.asarray(np.ones(32, np.int32))
+        band_codes = jnp.asarray(
+            rng.integers(-1, 4, size=(4, 16)).astype(np.int32)
+        )
+        bytes_ = jnp.asarray(
+            rng.integers(97, 123, size=(16, 12)).astype(np.uint8)
+        )
+        lens = jnp.asarray(np.full(16, 8, np.int32))
+        mask = jnp.asarray(np.zeros((16, 1), np.uint32))
+        count = jnp.asarray(np.full(16, 7, np.int32))
+        idf = jnp.asarray(np.ones(DF_TABLE_SIZE, np.float32))
+        return (
+            fn, (i, j, band_codes, bytes_, lens, mask, count, idf), {}
+        )
 
     # the brown-out tier's budgeted twin (engine kind="brownout"): same
     # factory, reduced top-k over a small candidate capacity — the shape

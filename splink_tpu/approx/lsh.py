@@ -153,6 +153,9 @@ class ApproxConfig:
     rows_per_band: int
     threshold: float
     budget: int
+    # TF-weighted tier (approx_tf_weighting): IDF-weighted minhash
+    # sampling + TF-weighted Jaccard verification/ranking
+    tf_weighting: bool = False
 
     @classmethod
     def from_settings(
@@ -187,6 +190,7 @@ class ApproxConfig:
         return cls(
             cols=tuple(cols), q=q, bands=bands, rows_per_band=rpb,
             threshold=thr, budget=budget,
+            tf_weighting=bool(settings.get("approx_tf_weighting")),
         )
 
 
@@ -209,12 +213,13 @@ def column_arrays(
 
 
 @functools.lru_cache(maxsize=64)
-def make_verify_fn(q: int, bands: int, col_shapes: tuple, with_jaccard: bool):
+def make_verify_fn(q: int, bands: int, col_shapes: tuple, with_jaccard: bool,
+                   weighted: bool = False):
     """Jitted per-pair estimator: band-collision count and (optionally) the
     mean exact q-gram Jaccard over the approx columns.
 
-    fn(i, j, band_codes, *[bytes_c, len_c, mask_c, count_c per column])
-        -> (collisions (n,) int32, sim (n,) float32)
+    fn(i, j, band_codes, *[bytes_c, len_c, mask_c, count_c per column]
+       [, idf]) -> (collisions (n,) int32, sim (n,) float32)
 
     ``band_codes`` is the (bands, n_rows) int32 code matrix (code -1 never
     collides). The Jaccard reuses ``ops.qgram.qgram_jaccard_masked_single``
@@ -223,16 +228,56 @@ def make_verify_fn(q: int, bands: int, col_shapes: tuple, with_jaccard: bool):
     runs per pair; a column null on either side contributes Jaccard 0 (its
     union is empty). ``sim`` is the plain mean over the static column
     count: deterministic, order-free.
+
+    ``weighted=True`` is the TF-WEIGHTED Jaccard (approx_tf_weighting):
+    per column ``sum_{g in A∩B} idf(g) / sum_{g in A∪B} idf(g)`` over the
+    distinct grams, with ``idf`` gathered at each gram's
+    :func:`~.minhash._fold_gram_hash` top bits (the same IDF table the
+    weighted sampler draws from). A shared rare gram now certifies a pair
+    far more strongly than a shared common one, which is what lets the
+    progressive best-first emission put true typo twins ahead of
+    common-suffix near-duplicates at a fixed budget.
     """
     import jax
     import jax.numpy as jnp
 
-    from ..ops.qgram import qgram_jaccard_masked_single
+    from ..ops.qgram import _gram_codes, qgram_jaccard_masked_single
+    from .minhash import DF_TABLE_BITS, _fold_gram_hash, column_salts
 
     n_cols = len(col_shapes)
+    salts = column_salts(n_cols)
+
+    def _wjac_single(s1, s2, l1, l2, m1, m2, salt, idf):
+        w1, v1 = _gram_codes(s1, l1, q)
+        w2, v2 = _gram_codes(s2, l2, q)
+        eq12 = jnp.all(w1[:, None, :] == w2[None, :, :], axis=-1) & (
+            v1[:, None] & v2[None, :]
+        )
+        shift = jnp.uint32(32 - DF_TABLE_BITS)
+        h1 = _fold_gram_hash(w1, salt)
+        h2 = _fold_gram_hash(w2, salt)
+        g1 = idf[(h1 >> shift).astype(jnp.int32)]
+        g2 = idf[(h2 >> shift).astype(jnp.int32)]
+        idx1 = jnp.arange(v1.shape[0], dtype=jnp.int32)
+        idx2 = jnp.arange(v2.shape[0], dtype=jnp.int32)
+        first1 = (
+            (m1[idx1 // 32] >> (idx1 % 32).astype(jnp.uint32)) & 1
+        ) == 1
+        first2 = (
+            (m2[idx2 // 32] >> (idx2 % 32).astype(jnp.uint32)) & 1
+        ) == 1
+        zero = jnp.float32(0.0)
+        inter = jnp.sum(jnp.where(first1 & eq12.any(axis=1), g1, zero))
+        u1 = jnp.sum(jnp.where(first1, g1, zero))
+        u2 = jnp.sum(jnp.where(first2, g2, zero))
+        union = u1 + u2 - inter
+        return jnp.where(union > 0, inter / union, 0.0).astype(jnp.float32)
 
     @jax.jit
     def fn(i, j, band_codes, *colarrs):
+        if weighted:
+            idf = colarrs[-1]
+            colarrs = colarrs[:-1]
         coll = jnp.zeros(i.shape[0], jnp.int32)
         for b in range(bands):
             cb = band_codes[b]
@@ -242,14 +287,26 @@ def make_verify_fn(q: int, bands: int, col_shapes: tuple, with_jaccard: bool):
         sims = jnp.zeros(i.shape[0], jnp.float32)
         for c in range(n_cols):
             bytes_, lens, mask, cnt = colarrs[4 * c : 4 * c + 4]
-            jac = jax.vmap(
-                lambda s1, s2, l1, l2, m1, n1, n2: qgram_jaccard_masked_single(
-                    s1, s2, l1, l2, m1, n1, n2, q
+            if weighted:
+                salt = jnp.uint32(salts[c])
+                jac = jax.vmap(
+                    lambda s1, s2, l1, l2, m1, m2: _wjac_single(
+                        s1, s2, l1, l2, m1, m2, salt, idf  # noqa: B023
+                    )
+                )(
+                    bytes_[i], bytes_[j], lens[i], lens[j],
+                    mask[i], mask[j],
                 )
-            )(
-                bytes_[i], bytes_[j], lens[i], lens[j],
-                mask[i], cnt[i], cnt[j],
-            )
+            else:
+                jac = jax.vmap(
+                    lambda s1, s2, l1, l2, m1, n1, n2:
+                    qgram_jaccard_masked_single(
+                        s1, s2, l1, l2, m1, n1, n2, q
+                    )
+                )(
+                    bytes_[i], bytes_[j], lens[i], lens[j],
+                    mask[i], cnt[i], cnt[j],
+                )
             sims = sims + jac
         return coll, sims / jnp.float32(n_cols)
 
@@ -288,6 +345,7 @@ class ApproxPlan:
     device_plan: object  # blocking_device.DeviceBlockPlan over the bands
     oversize_buckets: int  # degenerate LSH buckets dropped from the join
     band_uniq_keys: list = field(default_factory=list)  # per-band uint32 keys
+    idf: np.ndarray | None = None  # TF-weighting IDF table (minhash.idf_weights)
 
     @property
     def n_candidates(self) -> int:
@@ -295,14 +353,25 @@ class ApproxPlan:
 
 
 def compute_band_codes(
-    table: EncodedTable, cfg: ApproxConfig
-) -> tuple[np.ndarray, list[np.ndarray]]:
+    table: EncodedTable, cfg: ApproxConfig, idf: np.ndarray | None = None
+) -> tuple[np.ndarray, list[np.ndarray], np.ndarray | None]:
     """(bands, n_rows) int32 band codes + the per-band ascending unique
-    key arrays (the serve bucket dictionaries key on them)."""
+    key arrays (the serve bucket dictionaries key on them) + the IDF
+    table when TF weighting is on (built from the corpus's hashed gram
+    DF sketch unless the caller supplies one — the serve index stores it
+    so query-side signatures share the exact weights)."""
+    from .minhash import gram_df_table, idf_weights
+
+    columns = column_arrays(table, cfg.cols)
+    if cfg.tf_weighting and idf is None:
+        df_counts, n_records = gram_df_table(columns, cfg.q)
+        idf = idf_weights(df_counts, n_records)
     keys, has = band_key_arrays(
-        column_arrays(table, cfg.cols), cfg.q, cfg.bands, cfg.rows_per_band
+        columns, cfg.q, cfg.bands, cfg.rows_per_band,
+        idf=idf if cfg.tf_weighting else None,
     )
-    return factorise_band_codes(keys, has)
+    codes, uniqs = factorise_band_codes(keys, has)
+    return codes, uniqs, idf if cfg.tf_weighting else None
 
 
 def build_approx_plan(
@@ -327,7 +396,7 @@ def build_approx_plan(
     chunk = chunk or CHUNK
     link_type = settings["link_type"]
     n = table.n_rows
-    band_codes, uniq_keys = compute_band_codes(table, cfg)
+    band_codes, uniq_keys, idf = compute_band_codes(table, cfg)
     # degenerate (near-constant-signature) buckets null their codes so
     # they neither emit NOR mask later bands' pairs (docstring of
     # _null_oversize_buckets); counted, never silent
@@ -418,6 +487,7 @@ def build_approx_plan(
         device_plan=device_plan,
         oversize_buckets=oversize,
         band_uniq_keys=uniq_keys,
+        idf=idf,
     )
 
 
@@ -484,7 +554,10 @@ def generate_approx_candidates(
          "ascii" if table.strings[c].bytes_.dtype == np.uint8 else "wide")
         for c in cfg.cols
     )
-    vfn = make_verify_fn(cfg.q, cfg.bands, col_shapes, with_jaccard)
+    weighted = bool(cfg.tf_weighting and with_jaccard and plan.idf is not None)
+    vfn = make_verify_fn(
+        cfg.q, cfg.bands, col_shapes, with_jaccard, weighted=weighted
+    )
     bc_dev = jnp.asarray(plan.band_codes)
     aux_dev = []
     if with_jaccard:
@@ -493,6 +566,8 @@ def generate_approx_candidates(
                 [jnp.asarray(bytes_), jnp.asarray(lengths),
                  jnp.asarray(mask), jnp.asarray(count)]
             )
+        if weighted:
+            aux_dev.append(jnp.asarray(plan.idf, jnp.float32))
 
     chunk_cap = int(settings.get("blocking_chunk_pairs") or 0) or (1 << 22)
     # the budget shapes nothing in the plan (bands/threshold do), so read
@@ -586,6 +661,7 @@ def generate_approx_candidates(
         "candidates": raw,
         "exact_overlap_removed": int(overlap_removed),
         "verified": with_jaccard,
+        "tf_weighted": weighted,
         "survivors": survivors,
         "oversize_buckets_dropped": plan.oversize_buckets,
     }

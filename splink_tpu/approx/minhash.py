@@ -41,8 +41,34 @@ APPROX_SEED = 0x0A99B10C
 # (chunk, n_windows, n_hashes) uint32 intermediate to a few tens of MB.
 SIG_CHUNK = 1 << 13
 
+# Hashed gram document-frequency sketch: grams histogram into
+# 2^DF_TABLE_BITS buckets by the top bits of their salted fold hash. An
+# occurrence-count approximation (bucket collisions and within-record
+# repeats both inflate a bucket), good enough for IDF *weighting* — the
+# signal is orders-of-magnitude rarity, not exact counts.
+DF_TABLE_BITS = 16
+DF_TABLE_SIZE = 1 << DF_TABLE_BITS
+
+# IDF floor: even the most common gram keeps a positive sampling weight
+# (a zero weight would delete it from the weighted-Jaccard universe).
+IDF_MIN = np.float32(0.05)
+
 _U32 = np.uint32
 _NO_SIG = np.uint32(0xFFFFFFFF)
+
+
+def _fold_gram_hash(words, salt):
+    """Salted uint32 fold of a gram's packed code words — the ONE gram
+    identity hash shared by the minhash kernel, the DF-sketch kernel and
+    the TF-weighted verify kernel (their IDF lookups must address the
+    same buckets)."""
+    import jax.numpy as jnp
+
+    h = jnp.broadcast_to(salt, (words.shape[0],))
+    for w in range(words.shape[1]):
+        h = (h ^ words[:, w]) * jnp.uint32(0x9E3779B1)
+        h = h ^ (h >> 15)
+    return h
 
 
 def hash_params(n_hashes: int) -> tuple[np.ndarray, np.ndarray]:
@@ -62,7 +88,8 @@ def column_salts(n_cols: int) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=64)
-def make_minhash_fn(q: int, bands: int, rows_per_band: int, col_shapes: tuple):
+def make_minhash_fn(q: int, bands: int, rows_per_band: int, col_shapes: tuple,
+                    weighted: bool = False):
     """Jitted minhash-signature + LSH-band kernel for one static column
     layout.
 
@@ -70,7 +97,7 @@ def make_minhash_fn(q: int, bands: int, rows_per_band: int, col_shapes: tuple):
     ``"ascii"`` or ``"wide"`` — it fixes the bytes dtype the caller
     uploads, and with it the bits-per-char of the gram packing).
 
-    fn(bytes_0, .., bytes_{C-1}, len_0, .., len_{C-1}, a, b, salts)
+    fn(bytes_0, .., bytes_{C-1}, len_0, .., len_{C-1}, a, b, salts[, idf])
         -> (band_keys (n, bands) uint32, has_sig (n,) bool)
 
     Per record: every valid q-gram window of every column packs to its
@@ -81,6 +108,18 @@ def make_minhash_fn(q: int, bands: int, rows_per_band: int, col_shapes: tuple):
     ``has_sig`` is False when no column contributes a single valid window
     (null / shorter-than-q values) — such records are unreachable by the
     approx tier, exactly as a null key never joins in exact blocking.
+
+    ``weighted=True`` is the TF-weighted sampler (approx_tf_weighting):
+    each gram draws an exponential race value ``-log(u) / w`` where ``u``
+    derives from the gram's per-hash uniform hash and ``w`` is its IDF
+    weight (``idf`` gathered at the gram hash's top
+    :data:`DF_TABLE_BITS` bits — the one extra gather), and the signature
+    lane takes the WINNING GRAM'S identity hash. Two records agree on a
+    lane with probability equal to their IDF-weighted Jaccard (the
+    exponential-race construction): rare grams — the ones that identify a
+    record — win proportionally more lanes, the ShallowBlocker
+    rarity-weighting (arXiv:2312.15835). ``weighted=False`` traces the
+    EXACT kernel previous rounds shipped, bit for bit.
     """
     import jax
     import jax.numpy as jnp
@@ -90,16 +129,18 @@ def make_minhash_fn(q: int, bands: int, rows_per_band: int, col_shapes: tuple):
     n_cols = len(col_shapes)
     n_hashes = bands * rows_per_band
 
-    def record_sig(cols, lens, a, b, salts):
+    def record_sig(cols, lens, a, b, salts, idf):
         sig = jnp.full((n_hashes,), _NO_SIG, jnp.uint32)
+        best_e = (
+            jnp.full((n_hashes,), jnp.float32(np.inf), jnp.float32)
+            if weighted
+            else None
+        )
         has = jnp.zeros((), bool)
         for c in range(n_cols):
             words, valid = _gram_codes(cols[c], lens[c], q)
             # fold the gram's code words into one salted uint32 value
-            h = jnp.broadcast_to(salts[c], (words.shape[0],))
-            for w in range(words.shape[1]):
-                h = (h ^ words[:, w]) * jnp.uint32(0x9E3779B1)
-                h = h ^ (h >> 15)
+            h = _fold_gram_hash(words, salts[c])
             # per-hash-function value: multiply/add then a murmur-style
             # finalisation (a is odd, so h -> h*a is a bijection and the
             # min over grams is a faithful minhash of the gram set)
@@ -107,8 +148,37 @@ def make_minhash_fn(q: int, bands: int, rows_per_band: int, col_shapes: tuple):
             hk = hk ^ (hk >> 13)
             hk = hk * jnp.uint32(0x85EBCA6B)
             hk = hk ^ (hk >> 16)
-            hk = jnp.where(valid[:, None], hk, _NO_SIG)
-            sig = jnp.minimum(sig, jnp.min(hk, axis=0))
+            if not weighted:
+                hk = jnp.where(valid[:, None], hk, _NO_SIG)
+                sig = jnp.minimum(sig, jnp.min(hk, axis=0))
+            else:
+                # exponential race: e = -log(u) / w, u in (0, 1) from the
+                # per-hash uniform, w the gram's IDF — min over grams
+                # samples gram g with probability w_g / sum(w); the lane
+                # carries the WINNER'S identity so two records agree iff
+                # the same gram wins in both
+                w = idf[(h >> jnp.uint32(32 - DF_TABLE_BITS)).astype(
+                    jnp.int32
+                )]
+                u = (hk.astype(jnp.float32) + jnp.float32(0.5)) * jnp.float32(
+                    2.0 ** -32
+                )
+                e = -jnp.log(u) / w[:, None]
+                e = jnp.where(valid[:, None], e, jnp.float32(np.inf))
+                col_min = jnp.min(e, axis=0)  # (n_hashes,)
+                col_id = jnp.min(
+                    jnp.where(
+                        (e == col_min[None, :]) & valid[:, None],
+                        h[:, None],
+                        _NO_SIG,
+                    ),
+                    axis=0,
+                )
+                take = (col_min < best_e) | (
+                    (col_min == best_e) & (col_id < sig)
+                )
+                best_e = jnp.where(take, col_min, best_e)
+                sig = jnp.where(take, col_id, sig)
             has = has | jnp.any(valid)
         # band keys: FNV-fold the band's signature lanes + a band salt
         bk = sig.reshape(bands, rows_per_band)
@@ -126,12 +196,119 @@ def make_minhash_fn(q: int, bands: int, rows_per_band: int, col_shapes: tuple):
     def fn(*args):
         cols = args[:n_cols]
         lens = args[n_cols : 2 * n_cols]
-        a, b, salts = args[2 * n_cols :]
+        if weighted:
+            a, b, salts, idf = args[2 * n_cols :]
+        else:
+            a, b, salts = args[2 * n_cols :]
+            idf = None
         return jax.vmap(
-            lambda *rec: record_sig(rec[:n_cols], rec[n_cols:], a, b, salts)
+            lambda *rec: record_sig(
+                rec[:n_cols], rec[n_cols:], a, b, salts, idf
+            )
         )(*cols, *lens)
 
     return fn
+
+
+@functools.lru_cache(maxsize=64)
+def make_gram_df_fn(q: int, col_shapes: tuple):
+    """Jitted hashed gram document-frequency accumulation for one static
+    column layout: ``fn(acc, bytes.., len.., salts) -> acc`` scatter-adds
+    every valid gram of every column into the (DF_TABLE_SIZE,) int32
+    table at the top :data:`DF_TABLE_BITS` bits of its
+    :func:`_fold_gram_hash` — the same address the weighted sampler and
+    the weighted verifier gather their IDF weights from."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.qgram import _gram_codes
+
+    n_cols = len(col_shapes)
+
+    @jax.jit
+    def fn(acc, *args):
+        cols = args[:n_cols]
+        lens = args[n_cols : 2 * n_cols]
+        salts = args[2 * n_cols]
+        # per column: vmapped (n, windows) slot matrix, then ONE shared
+        # scatter-add — never a per-record histogram (a vmapped
+        # (chunk, DF_TABLE_SIZE) intermediate would be ~2 GiB per
+        # dispatch for a 256 KB output)
+        for c in range(n_cols):
+            salt = salts[c]
+
+            def rec_slots(s, length, salt=salt):
+                words, valid = _gram_codes(s, length, q)
+                h = _fold_gram_hash(words, salt)
+                return jnp.where(
+                    valid,
+                    (h >> jnp.uint32(32 - DF_TABLE_BITS)).astype(
+                        jnp.int32
+                    ),
+                    jnp.int32(DF_TABLE_SIZE),  # dropped by mode="drop"
+                )
+
+            slots = jax.vmap(rec_slots)(cols[c], lens[c]).reshape(-1)
+            acc = acc.at[slots].add(1, mode="drop")
+        return acc
+
+    return fn
+
+
+def gram_df_table(
+    columns: list[tuple[np.ndarray, np.ndarray]],
+    q: int,
+    chunk: int = SIG_CHUNK,
+) -> tuple[np.ndarray, int]:
+    """(DF_TABLE_SIZE,) int64 hashed gram occurrence counts over the
+    corpus plus the record count — the raw material of
+    :func:`idf_weights`. Streams power-of-two bucketed chunks like
+    :func:`band_key_arrays` (zero steady-state recompiles)."""
+    import jax.numpy as jnp
+
+    if not columns:
+        raise ValueError("gram DF table needs at least one column")
+    n = len(columns[0][1])
+    col_shapes = tuple(
+        (int(b.shape[1]), "ascii" if b.dtype == np.uint8 else "wide")
+        for b, _ in columns
+    )
+    fn = make_gram_df_fn(q, col_shapes)
+    s_dev = jnp.asarray(column_salts(len(columns)))
+    out = np.zeros(DF_TABLE_SIZE, np.int64)
+    acc = jnp.zeros(DF_TABLE_SIZE, jnp.int32)
+    flushed = 0
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        m = _pow2(max(e - s, 1))
+        args = []
+        for bytes_, _ in columns:
+            buf = np.zeros((m, bytes_.shape[1]), bytes_.dtype)
+            buf[: e - s] = bytes_[s:e]
+            args.append(jnp.asarray(buf))
+        for _, lengths in columns:
+            lbuf = np.zeros(m, np.int32)
+            lbuf[: e - s] = lengths[s:e]
+            args.append(jnp.asarray(lbuf))
+        acc = fn(acc, *args, s_dev)
+        flushed += m
+        if flushed >= (1 << 22):  # int32 headroom: flush to host int64
+            out += np.asarray(acc, np.int64)
+            acc = jnp.zeros(DF_TABLE_SIZE, jnp.int32)
+            flushed = 0
+    out += np.asarray(acc, np.int64)
+    return out, n
+
+
+def idf_weights(df_counts: np.ndarray, n_records: int) -> np.ndarray:
+    """(DF_TABLE_SIZE,) float32 IDF weights from the hashed DF sketch:
+    ``max(log((n + 1) / (df + 1)), IDF_MIN)`` — strictly positive (every
+    gram stays in the weighted universe), monotone in rarity, computed
+    ONCE host-side so index build and serve-side query signatures gather
+    identical weights."""
+    df = np.asarray(df_counts, np.float64)
+    w = np.log((float(n_records) + 1.0) / (df + 1.0))
+    return np.maximum(w, float(IDF_MIN)).astype(np.float32)
 
 
 def band_key_arrays(
@@ -140,6 +317,7 @@ def band_key_arrays(
     bands: int,
     rows_per_band: int,
     chunk: int = SIG_CHUNK,
+    idf: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Host driver: LSH band keys for every record.
 
@@ -149,6 +327,10 @@ def band_key_arrays(
     jitted kernel (at most two distinct shapes per call: the full chunk
     and one padded tail), so repeated runs perform zero steady-state
     recompiles.
+
+    ``idf`` (the :func:`idf_weights` table) selects the TF-weighted
+    sampler — the caller passes the SAME table on the index-build and
+    query sides so their band keys agree for shared values.
 
     Returns ``(keys (n, bands) uint32, has_sig (n,) bool)``.
     """
@@ -161,12 +343,15 @@ def band_key_arrays(
         (int(b.shape[1]), "ascii" if b.dtype == np.uint8 else "wide")
         for b, _ in columns
     )
-    fn = make_minhash_fn(q, bands, rows_per_band, col_shapes)
+    fn = make_minhash_fn(
+        q, bands, rows_per_band, col_shapes, weighted=idf is not None
+    )
     a, b_par = hash_params(bands * rows_per_band)
     salts = column_salts(len(columns))
     a_dev = jnp.asarray(a)
     b_dev = jnp.asarray(b_par)
     s_dev = jnp.asarray(salts)
+    extra = () if idf is None else (jnp.asarray(idf, jnp.float32),)
     keys = np.empty((n, bands), _U32)
     has = np.empty(n, bool)
     for s in range(0, n, chunk):
@@ -181,7 +366,7 @@ def band_key_arrays(
             lbuf = np.zeros(m, np.int32)
             lbuf[: e - s] = lengths[s:e]
             args.append(jnp.asarray(lbuf))
-        k, h = fn(*args, a_dev, b_dev, s_dev)
+        k, h = fn(*args, a_dev, b_dev, s_dev, *extra)
         keys[s:e] = np.asarray(k)[: e - s]
         has[s:e] = np.asarray(h)[: e - s]
     return keys, has

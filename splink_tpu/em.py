@@ -487,3 +487,28 @@ def score_pairs_with_intermediates(G, params: FSParams):
     prob_m = gamma_prob_lookup(G, params.m)
     prob_u = gamma_prob_lookup(G, params.u)
     return p, prob_m, prob_u
+
+
+@jax.jit
+def score_pairs_with_logits(G, params: FSParams):
+    """(p, fold_logit) — the logit is what the term-frequency fold adds
+    its per-pair delta to (term_frequencies.make_tf_fold_fn). ``p`` stays
+    the canonical ``match_probability`` (byte-identical to
+    :func:`score_pairs`); the logit carries the FUSED serve kernel's
+    left-to-right accumulation order, which is the TF parity anchor
+    (fellegi_sunter.fold_logit docstring)."""
+    from .models.fellegi_sunter import fold_logit
+
+    return match_probability(G, params), fold_logit(G, params)
+
+
+@jax.jit
+def score_pairs_with_intermediates_logits(G, params: FSParams):
+    """score_pairs_with_intermediates plus the fold logit (TF-fold jobs
+    that also retain intermediate columns)."""
+    from .models.fellegi_sunter import fold_logit, gamma_prob_lookup
+
+    p = match_probability(G, params)
+    prob_m = gamma_prob_lookup(G, params.m)
+    prob_u = gamma_prob_lookup(G, params.u)
+    return p, prob_m, prob_u, fold_logit(G, params)

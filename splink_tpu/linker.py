@@ -29,6 +29,8 @@ from .em import (
     run_em_checkpointed,
     score_pairs,
     score_pairs_with_intermediates,
+    score_pairs_with_intermediates_logits,
+    score_pairs_with_logits,
 )
 from .gammas import GammaProgram, register_comparison  # noqa: F401 (re-export)
 from .models.fellegi_sunter import FSParams
@@ -241,6 +243,9 @@ class Splink:
         # last EMResult replayed into Params (EM diagnostics attach its
         # trimmed trajectory: per-iteration ll lives only device-side)
         self._last_em_result = None
+        # memoised TF u-probability fold context (term_frequencies
+        # docstring): (spec, token ids, log tables) or False = inactive
+        self._tf_fold_cache = None
         # checkpoint/resume state for the current estimate_parameters call
         # (argument overrides; the settings keys are the fallback)
         self._ckpt_dir_arg: str | None = None
@@ -753,10 +758,71 @@ class Splink:
                 )
         return self._P, self._pattern_counts, self._pattern_program
 
+    def _tf_fold_ctx(self):
+        """The offline TF u-probability fold context, memoised:
+        ``(spec, tids, log_tables)`` — term_frequencies.tf_fold_spec
+        entries restricted to the encoded string columns, each column's
+        (n_rows,) token ids and its float64 log relative-frequency table
+        (term_frequencies.tf_log_table, the SAME values the serve index
+        gathers from). None when ``serve_tf_adjust`` is off or no flagged
+        comparison has a token column — scored frames then carry no
+        ``tf_match_probability`` column, exactly as before."""
+        if self._tf_fold_cache is None:
+            self._tf_fold_cache = False
+            if self.settings.get("serve_tf_adjust", True):
+                from .term_frequencies import tf_fold_spec, tf_log_table
+
+                table = self._ensure_encoded()
+                spec, tids, logs = [], [], []
+                for ci, name, top in tf_fold_spec(self.settings):
+                    sc = table.strings.get(name)
+                    if sc is None or not sc.n_tokens:
+                        continue
+                    tid = sc.token_ids
+                    counts = np.bincount(
+                        tid[tid >= 0], minlength=sc.n_tokens
+                    )
+                    spec.append((ci, name, top))
+                    tids.append(tid.astype(np.int32))
+                    logs.append(tf_log_table(counts))
+                if spec:
+                    self._tf_fold_cache = (tuple(spec), tids, logs)
+        return self._tf_fold_cache or None
+
+    def _tf_fold_pairs(self, z, il, ir, ctx) -> np.ndarray:
+        """TF-adjusted match probabilities for pairs (il, ir) from their
+        match logits ``z`` — the offline half of the serve parity
+        contract, evaluated by the SAME jitted fold expression the serve
+        megakernel runs (term_frequencies.make_tf_fold_fn). Chunked like
+        every other per-pair device pass."""
+        from .term_frequencies import make_tf_fold_fn
+
+        spec, tids, logs = ctx
+        dtype = self._float_dtype
+        fold = make_tf_fold_fn(spec)
+        lam, m, u, _ = self.params.to_arrays(dtype=dtype)
+        u_dev = jnp.asarray(u)
+        logs_dev = [jnp.asarray(t.astype(dtype)) for t in logs]
+        n = len(z)
+        batch = min(int(self.settings["pair_batch_size"]), max(n, 1))
+        out = np.empty(n, dtype)
+        for s in range(0, n, batch):
+            e = min(s + batch, n)
+            args = [jnp.asarray(tid[il[s:e]]) for tid in tids]
+            args += [jnp.asarray(tid[ir[s:e]]) for tid in tids]
+            out[s:e] = np.asarray(
+                fold(jnp.asarray(z[s:e]), u_dev, *args, *logs_dev)
+            )
+        return out
+
     def _pattern_score_luts(self):
         """Per-pattern lookup tables (host): match probability and, when
-        intermediates are retained, per-column prob_m/prob_u. Reuses the
-        batched scoring path, which bounds HBM at any pattern count."""
+        intermediates are retained, per-column prob_m/prob_u — plus the
+        match-logit LUT when the TF fold is active (the per-pair fold
+        adds its delta to the pattern's logit; a pattern LUT of folded
+        probabilities is impossible because the delta is a property of
+        the PAIR's tokens, not its gamma pattern). Reuses the batched
+        scoring path, which bounds HBM at any pattern count."""
         program = self._ensure_pattern_program()
         PM = program.patterns_matrix()
         dtype = self._float_dtype
@@ -764,15 +830,17 @@ class Splink:
         params_dev = FSParams(
             lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)
         )
-        p, pm, pu = self._score_batched(PM, params_dev)
-        return PM, p, pm, pu
+        p, pm, pu, z = self._score_batched(
+            PM, params_dev, want_z=self._tf_fold_ctx() is not None
+        )
+        return PM, p, pm, pu, z
 
     def _stream_pattern_chunks(self):
         """Yield scored chunks from the pattern-id pipeline: one LUT gather
         + frame assembly per (il, ir, pattern-ids) chunk. The chunk source
         (stored virtual ids / virtual recompute / materialised pairs) is
         _iter_pattern_triples — the single definition of the pair stream."""
-        PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
+        PM, p_lut, pm_lut, pu_lut, z_lut = self._pattern_score_luts()
         with self._stage("score_patterns"):
             for il, ir, Pk in self._iter_pattern_triples():
                 yield self._assemble_df_e(
@@ -782,6 +850,7 @@ class Splink:
                     p_lut[Pk],
                     pm_lut[Pk] if pm_lut is not None else None,
                     pu_lut[Pk] if pu_lut is not None else None,
+                    z=z_lut[Pk] if z_lut is not None else None,
                 )
 
     def _iter_pattern_triples(self):
@@ -903,7 +972,7 @@ class Splink:
                     f"term-frequency column {name!r} is not an encoded "
                     "column; skipped in the streaming TF pass."
                 )
-            PM, p_lut, pm_lut, pu_lut = self._pattern_score_luts()
+            PM, p_lut, pm_lut, pu_lut, z_lut = self._pattern_score_luts()
             base_lambda = float(self.params.params["λ"])
             sums = {n: np.zeros(nt + 1) for n, (_, nt) in cols.items()}
             counts = {n: np.zeros(nt + 1) for n, (_, nt) in cols.items()}
@@ -932,6 +1001,7 @@ class Splink:
                         p_lut[Pk],
                         pm_lut[Pk] if pm_lut is not None else None,
                         pu_lut[Pk] if pu_lut is not None else None,
+                        z=z_lut[Pk] if z_lut is not None else None,
                     )
                     adj_arrays = []
                     for name, (tid, _nt) in cols.items():
@@ -1643,14 +1713,17 @@ class Splink:
     # Output assembly
     # ------------------------------------------------------------------
 
-    def _score_batched(self, G: np.ndarray, params_dev: FSParams):
+    def _score_batched(self, G: np.ndarray, params_dev: FSParams,
+                       want_z: bool = False):
         """Score in pair_batch_size device batches (padded to one compiled
         shape), so output assembly never pushes more than a batch of the
         gamma matrix plus its (n, C) float intermediates into HBM.
 
         The per-column prob_m/prob_u intermediates are only computed and
         transferred when retain_intermediate_calculation_columns is set —
-        the default path downloads just the (n,) probabilities. Batches are
+        the default path downloads just the (n,) probabilities; ``want_z``
+        additionally downloads the match logits (the TF fold's input;
+        sigmoid of the logit is the probability bit for bit). Batches are
         double-buffered: batch k+1 dispatches before batch k's download."""
         n = len(G)
         batch = min(int(self.settings["pair_batch_size"]), max(n, 1))
@@ -1660,6 +1733,7 @@ class Splink:
         # Device copy is reusable only when scoring the exact same full matrix
         src_dev = self._G_dev if self._G_dev is not None and G is self._G else None
         p = np.empty(n, out_dtype)
+        z = np.empty(n, out_dtype) if want_z else None
         if want_inter:
             prob_m = np.empty((n, n_cols), out_dtype)
             prob_u = np.empty((n, n_cols), out_dtype)
@@ -1673,25 +1747,32 @@ class Splink:
                 Gb = jnp.concatenate(
                     [Gb, jnp.zeros((batch - (stop - s), n_cols), Gb.dtype)]
                 )
-            if want_inter:
+            if want_inter and want_z:
+                res = score_pairs_with_intermediates_logits(Gb, params_dev)
+            elif want_inter:
                 res = score_pairs_with_intermediates(Gb, params_dev)
+            elif want_z:
+                res = score_pairs_with_logits(Gb, params_dev)
             else:
                 res = (score_pairs(Gb, params_dev),)
             res = tuple(r[: stop - s] for r in res)
             if pending is not None:
-                self._drain_score_batch(pending, p, prob_m, prob_u)
+                self._drain_score_batch(pending, p, prob_m, prob_u, z)
             pending = (s, stop, res)
         if pending is not None:
-            self._drain_score_batch(pending, p, prob_m, prob_u)
-        return p, prob_m, prob_u
+            self._drain_score_batch(pending, p, prob_m, prob_u, z)
+        return p, prob_m, prob_u, z
 
     @staticmethod
-    def _drain_score_batch(pending, p, prob_m, prob_u):
+    def _drain_score_batch(pending, p, prob_m, prob_u, z):
         s, stop, res = pending
         p[s:stop] = np.asarray(res[0])
         if prob_m is not None:
             prob_m[s:stop] = np.asarray(res[1])
             prob_u[s:stop] = np.asarray(res[2])
+        if z is not None:
+            # the logit rides last in every variant that computes it
+            z[s:stop] = np.asarray(res[-1])
 
     def _build_df_e(self, G: np.ndarray, rows: slice | None = None):
         """Assemble the scored comparisons DataFrame with the reference's
@@ -1709,16 +1790,30 @@ class Splink:
             lam=jnp.asarray(lam), m=jnp.asarray(m), u=jnp.asarray(u)
         )
         with self._stage("score"):
-            p, prob_m, prob_u = self._score_batched(G, params_dev)
-        return self._assemble_df_e(G, il, ir, p, prob_m, prob_u)
+            p, prob_m, prob_u, z = self._score_batched(
+                G, params_dev, want_z=self._tf_fold_ctx() is not None
+            )
+        return self._assemble_df_e(G, il, ir, p, prob_m, prob_u, z=z)
 
-    def _assemble_df_e(self, G, il, ir, p, prob_m, prob_u):
+    def _assemble_df_e(self, G, il, ir, p, prob_m, prob_u, z=None):
         """Column assembly shared by the device-scoring and pattern-LUT
-        paths; all inputs are host arrays aligned with (il, ir)."""
+        paths; all inputs are host arrays aligned with (il, ir). With the
+        TF u-probability fold active (``_tf_fold_ctx``) and the pairs'
+        match logits in ``z``, the frame carries a
+        ``tf_match_probability`` column — the first-class TF-adjusted
+        score, bit-identical to what the serve megakernel returns for the
+        same pairs."""
         table = self._ensure_encoded()
         settings = self.settings
         uid = settings["unique_id_column_name"]
         cols: dict[str, np.ndarray] = {"match_probability": p}
+        ctx = self._tf_fold_ctx()
+        if ctx is not None:
+            cols["tf_match_probability"] = (
+                self._tf_fold_pairs(z, il, ir, ctx)
+                if z is not None and len(p)
+                else np.zeros(len(p), self._float_dtype)
+            )
 
         def add_lr(name, values):
             cols.setdefault(f"{name}_l", values[il])

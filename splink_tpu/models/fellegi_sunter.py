@@ -93,11 +93,59 @@ def log_bayes_factor(G, params: FSParams):
     )
 
 
-def match_probability(G, params: FSParams):
-    """E-step: P(match | gamma vector) = sigmoid(logit(lambda) + log BF)."""
+def match_logit(G, params: FSParams):
+    """(n,) pre-sigmoid match evidence: logit(lambda) + log Bayes factor.
+
+    The quantity the term-frequency fold adds its per-pair delta to
+    (term_frequencies.make_tf_fold_fn): serve and offline both compute
+    ``sigmoid(match_logit + tf_sum)`` with the same association order,
+    which is what keeps the TF-adjusted scores bit-identical across
+    paths."""
     lam = params.lam
     prior_logit = _safe_log(lam) - _safe_log(1.0 - lam)
-    return jax.nn.sigmoid(prior_logit + log_bayes_factor(G, params))
+    return prior_logit + log_bayes_factor(G, params)
+
+
+def match_probability(G, params: FSParams):
+    """E-step: P(match | gamma vector) = sigmoid(logit(lambda) + log BF)."""
+    return jax.nn.sigmoid(match_logit(G, params))
+
+
+def fold_logit(G, params: FSParams):
+    """:func:`match_logit` with the log-Bayes-factor accumulated COLUMN BY
+    COLUMN, left to right — the exact expression tree of the fused serve
+    megakernel (serve/engine.make_score_fused_fn), per-column masked
+    level lookups included.
+
+    Mathematically identical to ``match_logit``; bitwise it can differ in
+    the last ulp past ~2 comparison columns, because ``jnp.sum``'s
+    reduction tree is not the sequential order the fused kernel's running
+    accumulator uses. The TF fold therefore anchors on THIS logit on
+    every path (fused serve, unfused serve oracle, offline fold kernel) —
+    that shared order is what makes the TF-adjusted scores bit-identical
+    across all of them at any column count. The unadjusted score keeps
+    ``match_probability`` unchanged."""
+    lam = params.lam
+    prior_logit = _safe_log(lam) - _safe_log(1.0 - lam)
+    log_m = _safe_log(params.m)
+    log_u = _safe_log(params.u)
+    n_levels = log_m.shape[1]
+    log_bf = jnp.zeros(G.shape[0], log_m.dtype)
+    for ci in range(G.shape[1]):
+        g = G[:, ci]
+        lp_m = jnp.zeros(g.shape, log_m.dtype)
+        lp_u = jnp.zeros(g.shape, log_u.dtype)
+        for lv in range(n_levels):
+            hit = g == lv
+            zero = jnp.zeros((), log_m.dtype)
+            lp_m = lp_m + jnp.where(hit, log_m[ci, lv], zero)
+            lp_u = lp_u + jnp.where(hit, log_u[ci, lv], zero)
+        null = g >= 0
+        zero = jnp.zeros((), log_m.dtype)
+        log_bf = log_bf + (
+            jnp.where(null, lp_m, zero) - jnp.where(null, lp_u, zero)
+        )
+    return prior_logit + log_bf
 
 
 def gamma_prob_lookup(G, probs):

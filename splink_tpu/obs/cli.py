@@ -571,6 +571,9 @@ _LOWER_IS_BETTER = (
     "p50", "p95", "p99", "compiles", "recompile", "shed",
 )
 _HIGHER_IS_BETTER = (
+    # "recall" also covers the recall-per-budget family (rounds 11/14:
+    # recall_at_budget, recall_at_budget_tf) — pinned by the direction
+    # test beside the bench-report tests
     "per_sec", "qps", "recall", "hit_rate", "throughput", "speedup",
     "pairs_per",
 )

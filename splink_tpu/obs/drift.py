@@ -369,8 +369,17 @@ class DriftMonitor:
         alert_psi: float = 0.25,
         long_factor: int = LONG_WINDOW_FACTOR,
         clock=time.monotonic,
+        score_reference: bool = True,
     ):
         self.profile = profile
+        # False = the profile's score histograms are NOT comparable to the
+        # served score distribution (a TF-adjusted engine over a legacy
+        # profile captured from UNADJUSTED scores): the score channel
+        # reports psi None with a reason instead of firing a spurious
+        # drift alert the moment adjusted traffic lands — the swap
+        # re-anchor discipline the KernelWatch fix established in the
+        # perf observatory. Gamma channels are fold-invariant, they stay.
+        self.score_reference = bool(score_reference)
         self.window_s = float(window_s)
         self.alert_psi = float(alert_psi)
         self.long_window_s = self.window_s * long_factor
@@ -447,10 +456,17 @@ class DriftMonitor:
                 "psi": _round(psi(ref, gamma[c, :w])),
                 "js": _round(js_divergence(ref, gamma[c, :w])),
             }
-        channels["score"] = {
-            "psi": _round(psi(prof.score_hist_matched, score)),
-            "js": _round(js_divergence(prof.score_hist_matched, score)),
-        }
+        if self.score_reference:
+            channels["score"] = {
+                "psi": _round(psi(prof.score_hist_matched, score)),
+                "js": _round(js_divergence(prof.score_hist_matched, score)),
+            }
+        else:
+            channels["score"] = {
+                "psi": None,
+                "js": None,
+                "reason": "reference_scores_unadjusted",
+            }
         psis = [v["psi"] for v in channels.values() if v["psi"] is not None]
         queries = counters["queries"]
         null_rates = {}

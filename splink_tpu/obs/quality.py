@@ -95,6 +95,7 @@ class QualityProfile:
         vocab_mass: dict,
         n_pairs: int,
         n_rows: int,
+        tf_adjusted: bool = False,
     ):
         self.columns = list(columns)
         self.num_levels = [int(v) for v in num_levels]
@@ -106,6 +107,13 @@ class QualityProfile:
         self.vocab_mass = dict(vocab_mass)
         self.n_pairs = int(n_pairs)
         self.n_rows = int(n_rows)
+        # whether the score histograms were captured from TF-ADJUSTED
+        # match probabilities (the serve-time score distribution of a TF
+        # model). False on legacy artifacts: a TF-serving engine over
+        # such a profile must NOT score-drift-compare adjusted traffic
+        # against an unadjusted reference (obs/drift.DriftMonitor goes
+        # dark on the score channel with a reason instead).
+        self.tf_adjusted = bool(tf_adjusted)
 
     @property
     def bins(self) -> int:
@@ -139,6 +147,7 @@ class QualityProfile:
             "vocab_mass": self.vocab_mass,
             "n_pairs": self.n_pairs,
             "n_rows": self.n_rows,
+            "tf_adjusted": self.tf_adjusted,
         }
 
     @classmethod
@@ -170,6 +179,8 @@ class QualityProfile:
             vocab_mass=dict(meta.get("vocab_mass") or {}),
             n_pairs=int(meta.get("n_pairs") or 0),
             n_rows=int(meta.get("n_rows") or 0),
+            # absent on artifacts built before the TF fold = unadjusted
+            tf_adjusted=bool(meta.get("tf_adjusted", False)),
         )
 
     def summary(self) -> dict:
@@ -184,6 +195,7 @@ class QualityProfile:
             "null_rates": {k: round(float(v), 6)
                            for k, v in self.null_rates.items()},
             "vocab_mass": self.vocab_mass,
+            "tf_adjusted": self.tf_adjusted,
         }
 
 
@@ -306,6 +318,16 @@ def capture_profile(linker, table=None) -> QualityProfile | None:
     if G is None or len(G) == 0:
         return None
 
+    # TF models capture their score histograms from TF-ADJUSTED scores —
+    # the distribution a TF-serving engine actually produces (satellite of
+    # the fold: an unadjusted reference would make every adjusted serve
+    # window look drifted). Gamma histograms are fold-invariant.
+    tf_ctx = None
+    try:
+        tf_ctx = linker._tf_fold_ctx()
+    except Exception as e:  # noqa: BLE001 - profile capture is best-effort
+        logger.warning("TF fold context unavailable for profile: %s", e)
+
     dtype = linker._float_dtype
     lam, m, u, _ = linker.params.to_arrays(dtype=dtype)
     params = FSParams(
@@ -316,7 +338,57 @@ def capture_profile(linker, table=None) -> QualityProfile | None:
     score_hist = np.zeros(bins, np.int64)
     gamma_hist_m = np.zeros((n_cols, width), np.int64)
     score_hist_m = np.zeros(bins, np.int64)
-    if counts is not None:
+    if tf_ctx is not None and counts is None:
+        pairs = getattr(linker, "_pairs", None)
+        if pairs is None or pairs.n_pairs != len(G):
+            # the resident gammas no longer align with a pair index (so
+            # no token ids): fall back to the unadjusted capture rather
+            # than fabricating a fold
+            logger.warning(
+                "TF fold active but the gamma matrix has no aligned pair "
+                "index; profile score histograms are UNADJUSTED"
+            )
+            tf_ctx = None
+    if tf_ctx is not None:
+        # per-PAIR capture: the fold delta is a property of the pair's
+        # tokens, not its gamma pattern, so both regimes stream pairs and
+        # histogram host-side (one extra pass, build-time only)
+        def _pair_chunks():
+            if counts is not None:
+                PM2, _p, _pm, _pu, z_lut = linker._pattern_score_luts()
+                for il, ir, Pk in linker._iter_pattern_triples():
+                    yield PM2[Pk], z_lut[Pk], il, ir
+            else:
+                from ..em import score_pairs_with_logits
+
+                pr = linker._pairs
+                for s in range(0, len(G), _PROFILE_CHUNK):
+                    e = min(s + _PROFILE_CHUNK, len(G))
+                    z = np.asarray(
+                        score_pairs_with_logits(
+                            jnp.asarray(G[s:e]), params
+                        )[1]
+                    )
+                    yield G[s:e], z, pr.idx_l[s:e], pr.idx_r[s:e]
+
+        n_pairs = 0
+        for Gc, z, il, ir in _pair_chunks():
+            p = linker._tf_fold_pairs(z, il, ir, tf_ctx)
+            matched = p >= MATCH_PROBABILITY
+            sbin = np.clip((p * bins).astype(np.int64), 0, bins - 1)
+            Gc = np.asarray(Gc)
+            for c in range(n_cols):
+                g = np.clip(Gc[:, c].astype(np.int64) + 1, 0, width - 1)
+                gamma_hist[c] += np.bincount(g, minlength=width)[:width]
+                gamma_hist_m[c] += np.bincount(
+                    g[matched], minlength=width
+                )[:width]
+            score_hist += np.bincount(sbin, minlength=bins)[:bins]
+            score_hist_m += np.bincount(
+                sbin[matched], minlength=bins
+            )[:bins]
+            n_pairs += len(p)
+    elif counts is not None:
         # pattern regime: weighted host histograms over the pattern matrix
         seen = counts > 0
         Gp = np.asarray(G)[seen]
@@ -370,6 +442,7 @@ def capture_profile(linker, table=None) -> QualityProfile | None:
         vocab_mass=vocab_mass,
         n_pairs=n_pairs,
         n_rows=int(table.n_rows),
+        tf_adjusted=tf_ctx is not None,
     )
 
 

@@ -172,22 +172,35 @@ def _finish_topk(p, cand, valid, k: int):
     return top_p, top_rows, top_valid
 
 
-def make_score_topk_fn(layout: dict, comparison_columns, k: int):
-    """(packed_q, packed_ref, cand, valid, params) -> (top_p, top_rows,
-    top_valid): gammas via the shared comparison dispatch (exact bodies),
-    Fellegi-Sunter match probabilities, masked top-k per query. The
-    UNFUSED scoring path — it materialises the full (Q*C, n_comparisons)
-    gamma matrix and hands it to ``match_probability`` wholesale. Retained
-    as the parity oracle for :func:`make_score_fused_fn`, which is the
-    default serving path."""
+def make_score_topk_fn(layout: dict, comparison_columns, k: int,
+                       tf_spec: tuple = ()):
+    """(packed_q, packed_ref, cand, valid, params[, tf_q, tf_tid, tf_log])
+    -> (top_p, top_rows, top_valid): gammas via the shared comparison
+    dispatch (exact bodies), Fellegi-Sunter match probabilities, masked
+    top-k per query. The UNFUSED scoring path — it materialises the full
+    (Q*C, n_comparisons) gamma matrix and hands it to
+    ``match_probability`` wholesale. Retained as the parity oracle for
+    :func:`make_score_fused_fn`, which is the default serving path.
+
+    ``tf_spec`` (term_frequencies.tf_fold_spec entries restricted to the
+    index's fold columns) arms the term-frequency u-probability fold:
+    per TF column one (Q,) query-token-id vector (``tf_q``), the
+    (n_rows,) reference token ids (``tf_tid``) and the log relative-
+    frequency table (``tf_log``, term_frequencies.tf_log_table values in
+    the compute dtype) — pairs that agree on a token swap the top
+    level's average u for the token's own collision probability."""
+    import jax
     import jax.numpy as jnp
 
     from ..gammas import PairContext, _spec_gamma
-    from ..models.fellegi_sunter import match_probability
+    from ..models.fellegi_sunter import fold_logit, match_probability
+    from ..term_frequencies import tf_fold_delta
 
     cols = tuple(comparison_columns)
+    tf_spec = tuple(tf_spec)
 
-    def score_topk(packed_q, packed_ref, cand, valid, params):
+    def score_topk(packed_q, packed_ref, cand, valid, params,
+                   tf_q=(), tf_tid=(), tf_log=()):
         q_n, capacity = cand.shape
         # query side: static repeat (broadcast + reshape), NOT an index
         # gather — same row order as packed_q[repeat(arange(Q), C)] but
@@ -199,13 +212,33 @@ def make_score_topk_fn(layout: dict, comparison_columns, k: int):
         rows_r = packed_ref[rflat]
         ctx = PairContext(layout, rows_l, rows_r, None)
         G = jnp.stack([_spec_gamma(c, ctx) for c in cols], axis=1)
-        p = match_probability(G, params)
+        if not tf_spec:
+            p = match_probability(G, params)
+        else:
+            # the TF fold: same delta expression, accumulation order and
+            # association as the fused kernel and the offline fold —
+            # fold_logit IS the fused kernel's left-to-right log-BF
+            # accumulation, the anchor that keeps TF-adjusted parity
+            # exact at any column count (its docstring has the ulp story)
+            from ..models.fellegi_sunter import _safe_log
+
+            z = fold_logit(G, params)
+            log_u = _safe_log(params.u)
+            tf_sum = jnp.zeros(z.shape, z.dtype)
+            for t, (ci, _name, top) in enumerate(tf_spec):
+                tql = jnp.repeat(tf_q[t], capacity)
+                trf = tf_tid[t][rflat]
+                tf_sum = tf_sum + tf_fold_delta(
+                    tql, trf, tf_log[t], log_u[ci, top], z.dtype
+                )
+            p = jax.nn.sigmoid(z + tf_sum)
         return _finish_topk(p, cand, valid, k)
 
     return score_topk
 
 
-def make_score_fused_fn(layout: dict, comparison_columns, k: int):
+def make_score_fused_fn(layout: dict, comparison_columns, k: int,
+                        tf_spec: tuple = ()):
     """The fused gamma→score→top-k megakernel: same signature and
     BIT-identical results as :func:`make_score_topk_fn`, without ever
     materialising the (Q*C, n_comparisons) gamma matrix.
@@ -223,16 +256,28 @@ def make_score_fused_fn(layout: dict, comparison_columns, k: int):
     the same left-to-right comparison accumulation order ``jnp.sum``
     applies along the stacked axis — which is what makes the fused path
     bit-identical, not merely close (gated by the parity tests and the
-    ``make warmup-smoke`` oracle comparison)."""
+    ``make warmup-smoke`` oracle comparison).
+
+    With ``tf_spec`` the term-frequency u-probability fold rides the same
+    fusion: per TF column ONE extra device gather (the reference token ids
+    at the candidate rows; the query side is a static repeat like the
+    packed rows) plus a log-table lookup, and the per-pair delta
+    accumulates into a separate running sum added to the log-Bayes-factor
+    before the sigmoid — the identical expression the unfused oracle and
+    the offline fold kernel evaluate (term_frequencies module docstring),
+    so TF-adjusted parity stays exact."""
     import jax
     import jax.numpy as jnp
 
     from ..gammas import PairContext, _spec_gamma
     from ..models.fellegi_sunter import _safe_log
+    from ..term_frequencies import tf_fold_delta
 
     cols = tuple(comparison_columns)
+    tf_spec = tuple(tf_spec)
 
-    def score_fused(packed_q, packed_ref, cand, valid, params):
+    def score_fused(packed_q, packed_ref, cand, valid, params,
+                    tf_q=(), tf_tid=(), tf_log=()):
         # identical row materialisation to the unfused path (static
         # broadcast on the query side, one reference gather) — the fusion
         # target is the scoring chain, not the row reads
@@ -264,7 +309,22 @@ def make_score_fused_fn(layout: dict, comparison_columns, k: int):
             )
         lam = params.lam
         prior_logit = _safe_log(lam) - _safe_log(1.0 - lam)
-        p = jax.nn.sigmoid(prior_logit + log_bf)
+        if not tf_spec:
+            p = jax.nn.sigmoid(prior_logit + log_bf)
+        else:
+            # TF u-probability fold: a separate running delta sum added
+            # AFTER the comparison accumulation — `(prior + log_bf) +
+            # tf_sum` is the association the offline fold kernel's
+            # `z + tf_sum` reproduces (z = prior + log_bf), keeping the
+            # adjusted scores bit-identical across every path
+            tf_sum = jnp.zeros(log_bf.shape, log_bf.dtype)
+            for t, (ci, _name, top) in enumerate(tf_spec):
+                tql = jnp.repeat(tf_q[t], capacity)
+                trf = tf_tid[t][rflat]
+                tf_sum = tf_sum + tf_fold_delta(
+                    tql, trf, tf_log[t], log_u[ci, top], log_bf.dtype
+                )
+            p = jax.nn.sigmoid(prior_logit + log_bf + tf_sum)
         return _finish_topk(p, cand, valid, k)
 
     return score_fused
@@ -315,7 +375,7 @@ class QueryEngine:
     def __init__(self, index, *, top_k: int | None = None, policy=None,
                  telemetry=None, brownout_top_k: int | None = None,
                  fused: bool | None = None, aot_dir=None,
-                 sketch: bool | None = None):
+                 sketch: bool | None = None, tf_adjust: bool | None = None):
         from .bucketing import BucketPolicy, bucket_for
 
         self.index = index
@@ -326,6 +386,29 @@ class QueryEngine:
         self.fused = bool(
             settings.get("serve_fused", True) if fused is None else fused
         )
+        # Term-frequency u-probability fold (term_frequencies module
+        # docstring): default on whenever the index carries fold data
+        # (serve_tf_adjust settings gate). ``tf_adjust=`` overrides the
+        # gate like ``fused=`` so one index can serve TF-on and TF-off
+        # engines side by side (the bench's interleaved tier); it never
+        # conjures a fold for an index without the data.
+        self._tf_override = tf_adjust  # forwarded across swap_index
+        want_tf = bool(
+            settings.get("serve_tf_adjust", True)
+            if tf_adjust is None
+            else tf_adjust
+        )
+        self.tf_spec = tuple(index.tf_fold_columns()) if want_tf else ()
+        if want_tf and not self.tf_spec and index.tf_tables:
+            # a TF-flagged model whose artifact predates the fold data
+            # (counts only, no per-row token ids): serve exactly as
+            # before this build — unadjusted — and say so once
+            logger.warning(
+                "index carries TF count tables but no per-row token ids "
+                "(artifact built before the TF fold); serving UNADJUSTED "
+                "scores — re-export the index to enable serve-time TF "
+                "adjustment"
+            )
         # AOT executable sidecar (serve/aot.py): when set, warmup restores
         # every valid serialized executable instead of compiling, and
         # save_aot() persists the compiled menu for the next process.
@@ -452,11 +535,12 @@ class QueryEngine:
         make_score = (
             make_score_fused_fn if self.fused else make_score_topk_fn
         )
-        score = make_score(layout, cols, k)
+        score = make_score(layout, cols, k, tf_spec=self.tf_spec)
 
         def fused(
             capacity, packed_q, qbuckets, valid,
             starts, sizes, rows, row_bucket, packed_ref, params,
+            tf_q=(), tf_tid=(), tf_log=(),
         ):
             gather = make_candidate_gather_fn(n_rules, capacity)
             packed_q, qbuckets = encode(packed_q, qbuckets, valid)
@@ -464,7 +548,8 @@ class QueryEngine:
                 qbuckets, starts, sizes, rows, row_bucket
             )
             top_p, top_rows, top_valid = score(
-                packed_q, packed_ref, cand, cvalid, params
+                packed_q, packed_ref, cand, cvalid, params,
+                tf_q, tf_tid, tf_log,
             )
             return top_p, top_rows, top_valid, n_cand
 
@@ -504,7 +589,7 @@ class QueryEngine:
         dt = index.float_dtype
         i32, u32 = np.int32, np.uint32
         units = index.gather_units
-        return (
+        structs = (
             S((q_pad, index.n_lanes), u32),
             S((len(units), q_pad), i32),
             S((), i32),
@@ -514,6 +599,17 @@ class QueryEngine:
             tuple(S(r.row_bucket.shape, i32) for r in units),
             S(index.packed.shape, u32),
             _params_structs(index.m.shape, dt),
+        )
+        if not self.tf_spec:
+            # legacy / TF-off: the exact argument tree of today's
+            # executables (byte-identical serving, unchanged sidecars
+            # modulo the binding's tf flag)
+            return structs
+        tf_dev = index.tf_device_state()
+        return structs + (
+            tuple(S((q_pad,), i32) for _ in self.tf_spec),
+            tuple(S(a.shape, i32) for a in tf_dev["tid"]),
+            tuple(S(a.shape, dt) for a in tf_dev["log"]),
         )
 
     def _ensure_exec(self, kind: str, q_pad: int, capacity: int):
@@ -575,6 +671,10 @@ class QueryEngine:
             # compiled executable — a sidecar saved either way must not
             # serve the other configuration
             "sketch": self.sketch is not None,
+            # the TF fold changes the compiled scoring tail (extra gather
+            # + delta accumulation), so a sidecar saved either way must
+            # not serve the other configuration
+            "tf": bool(self.tf_spec),
         }
 
     def _aot_ready_store(self):
@@ -758,6 +858,17 @@ class QueryEngine:
         qb_pad[:, :n] = qb
         dev = index.device_state()
         packed_dev = jnp.asarray(packed_pad)
+        tf_args = ()
+        if self.tf_spec:
+            # padding rows carry token id -1 (never agrees), so the fold
+            # is inert on them like the encode kernel's zeroed rows
+            tf_q = []
+            for t in range(len(self.tf_spec)):
+                buf = np.full(q_pad, -1, np.int32)
+                buf[:n] = batch.tf_tids[t, start:stop]
+                tf_q.append(jnp.asarray(buf))
+            tf_dev = index.tf_device_state()
+            tf_args = (tuple(tf_q), tf_dev["tid"], tf_dev["log"])
         top_p, top_rows, top_valid, n_cand = kernel(
             packed_dev,
             jnp.asarray(qb_pad),
@@ -768,6 +879,7 @@ class QueryEngine:
             dev["row_bucket"],
             dev["packed"],
             dev["params"],
+            *tf_args,
         )
         if self.sketch is not None and not degraded:
             # fold the batch into the device drift accumulator: an async
@@ -935,6 +1047,17 @@ class QueryEngine:
                 self._aot_exec_probed = True
             packed = np.zeros((q_pad, index.n_lanes), np.uint32)
             qb = np.full((len(index.gather_units), q_pad), -1, np.int32)
+            tf_args = ()
+            if self.tf_spec:
+                tf_dev = index.tf_device_state()
+                tf_args = (
+                    tuple(
+                        jnp.asarray(np.full(q_pad, -1, np.int32))
+                        for _ in self.tf_spec
+                    ),
+                    tf_dev["tid"],
+                    tf_dev["log"],
+                )
             out = kernel(
                 jnp.asarray(packed),
                 jnp.asarray(qb),
@@ -945,6 +1068,7 @@ class QueryEngine:
                 dev["row_bucket"],
                 dev["packed"],
                 dev["params"],
+                *tf_args,
             )
             np.asarray(out[0])  # execute fully
             (self._warmed_brownout if degraded else self._warmed).add(
@@ -975,6 +1099,13 @@ class QueryEngine:
     def generation(self) -> int:
         """How many hot-swaps this engine has committed."""
         return self._generation
+
+    @property
+    def tf_active(self) -> bool:
+        """Whether this engine folds the term-frequency u-probability
+        adjustment into its served scores (settings gate on AND the index
+        carries the fold data)."""
+        return bool(self.tf_spec)
 
     # -- drift sketch drain ---------------------------------------------
 
@@ -1086,6 +1217,7 @@ class QueryEngine:
                 brownout_top_k=self.brownout_top_k,
                 fused=self.fused,
                 sketch=self._sketch_override,
+                tf_adjust=self._tf_override,
                 aot_dir=pending_aot,
             )
             warm = pending.warmup()
@@ -1111,6 +1243,7 @@ class QueryEngine:
             ) from e
         with self._swap_lock:
             self.index = pending.index
+            self.tf_spec = pending.tf_spec
             self._jits = pending._jits
             self._execs = pending._execs
             self._exec_source = pending._exec_source

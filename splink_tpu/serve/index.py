@@ -261,6 +261,11 @@ class ApproxServe:
     bands: int
     rows_per_band: int
     band_index: list[ApproxBand] = field(default_factory=list)
+    # TF-weighting IDF table (approx_tf_weighting; minhash.idf_weights):
+    # query-side fallback signatures MUST draw from the same weights the
+    # index build drew from, so the table rides in the artifact. None =
+    # unweighted tier.
+    idf: np.ndarray | None = None
 
 
 @dataclass
@@ -278,6 +283,11 @@ class QueryBatch:
     n: int
     unique_id: np.ndarray  # (n,) query ids (positional when absent)
     approx_used: np.ndarray | None = None  # (n,) bool, None = no approx tier
+    # (n_tf_fold, n) int32 query token ids for the TF fold columns (the
+    # reference-vocabulary ids _pin_string_column resolved — an unseen
+    # query value takes a fresh id past the vocabulary, which can never
+    # agree with a reference row); None when the index has no fold data
+    tf_tids: np.ndarray | None = None
 
 
 class LinkageIndex:
@@ -302,6 +312,7 @@ class LinkageIndex:
         state_hash: str,
         approx: ApproxServe | None = None,
         profile=None,
+        tf_tids: dict | None = None,
     ):
         self.settings = settings
         self.dtype = dtype  # "float32" | "float64"
@@ -316,6 +327,11 @@ class LinkageIndex:
         self.rules = rules
         self.unique_id = unique_id
         self.tf_tables = tf_tables  # name -> (n_tokens,) int64 counts
+        # name -> (n_rows,) int32 reference token ids for the TF fold
+        # (term_frequencies.tf_fold_spec columns). Empty on artifacts
+        # built before the fold existed — such indexes serve UNADJUSTED
+        # exactly as they always did (engine warns once).
+        self.tf_tids = dict(tf_tids or {})
         self.state_hash = state_hash
         self.approx = approx  # LSH fallback bucket path (None = exact only)
         # training-reference quality profile (obs/quality.py) — None on
@@ -326,6 +342,7 @@ class LinkageIndex:
         # one must not invalidate an AOT sidecar.
         self.profile = profile
         self._device = None  # memoised device-resident arrays
+        self._tf_device = None  # memoised TF-fold device arrays
         self._vocab_maps: dict | None = None
         self._content_fp: str | None = None
 
@@ -356,6 +373,46 @@ class LinkageIndex:
             units.extend(self.approx.band_index)
         return units
 
+    def tf_fold_columns(self) -> list:
+        """The TF u-probability fold menu this index can serve:
+        ``term_frequencies.tf_fold_spec`` entries whose column has BOTH a
+        count table and per-row reference token ids in the artifact.
+        Empty for TF-less models and for legacy artifacts that predate
+        the fold data (those serve unadjusted, as before)."""
+        from ..term_frequencies import tf_fold_spec
+
+        return [
+            (ci, name, top)
+            for ci, name, top in tf_fold_spec(self.settings)
+            if name in self.tf_tables and name in self.tf_tids
+        ]
+
+    def tf_device_state(self):
+        """Memoised TF-fold device arrays for :meth:`tf_fold_columns`, in
+        spec order: ``tid`` (per column (n_rows,) int32 reference token
+        ids) and ``log`` (the :func:`~..term_frequencies.tf_log_table`
+        values cast to the index's compute dtype). Uploaded once, shared
+        by every query batch — only built when an engine actually folds."""
+        if self._tf_device is None:
+            import jax.numpy as jnp
+
+            from ..term_frequencies import tf_log_table
+
+            dt = self.float_dtype
+            cols = self.tf_fold_columns()
+            self._tf_device = {
+                "tid": tuple(
+                    jnp.asarray(self.tf_tids[name]) for _, name, _t in cols
+                ),
+                "log": tuple(
+                    jnp.asarray(
+                        tf_log_table(self.tf_tables[name]).astype(dt)
+                    )
+                    for _, name, _t in cols
+                ),
+            }
+        return self._tf_device
+
     def content_fingerprint(self) -> str:
         """sha256 over every array a serve executable's answers depend on
         (packed matrix, per-rule CSR, trained parameters, dtype, settings
@@ -380,10 +437,28 @@ class LinkageIndex:
                     f"approx:{ap.q}:{ap.bands}:{ap.rows_per_band}:"
                     f"{','.join(ap.cols)}".encode()
                 )
+                if ap.idf is not None:
+                    # the IDF table shapes query-side fallback band keys
+                    h.update(np.ascontiguousarray(ap.idf).tobytes())
                 for band in ap.band_index:
                     for a in (band.rows_sorted, band.starts, band.sizes,
                               band.row_bucket):
                         h.update(np.ascontiguousarray(a).tobytes())
+            if self.tf_tids:
+                # the fold data changes what a TF-serving executable
+                # answers, so it joins the executable-binding identity; a
+                # fold-less index (TF-less OR legacy) hashes exactly as
+                # before
+                for name in sorted(self.tf_tids):
+                    h.update(f"tf:{name}".encode())
+                    h.update(
+                        np.ascontiguousarray(self.tf_tids[name]).tobytes()
+                    )
+                    h.update(
+                        np.ascontiguousarray(
+                            self.tf_tables[name]
+                        ).tobytes()
+                    )
             h.update(np.float64(self.lam).tobytes())
             h.update(np.ascontiguousarray(self.m, np.float64).tobytes())
             h.update(np.ascontiguousarray(self.u, np.float64).tobytes())
@@ -537,12 +612,23 @@ class LinkageIndex:
                                 int(keys[k, b]), -1
                             )
                 approx_used = missed & (qbuckets[n_rules:] >= 0).any(axis=0)
+        tf_tids = None
+        fold_cols = self.tf_fold_columns()
+        if fold_cols:
+            # fold-column token ids from the PINNED columns: a query value
+            # present in the reference vocabulary carries its reference id
+            # (agreement is id equality on device), an unseen value a
+            # fresh id past it (never agrees), null -1
+            tf_tids = np.stack(
+                [qtable.strings[name].token_ids for _, name, _t in fold_cols]
+            ).astype(np.int32)
         return QueryBatch(
             packed=packed_q,
             qbuckets=qbuckets,
             n=len(df),
             unique_id=np.asarray(pd.Series(df[uid_col]).to_numpy()),
             approx_used=approx_used,
+            tf_tids=tf_tids,
         )
 
     def _query_band_keys(self, qtable: EncodedTable, rows: np.ndarray):
@@ -568,7 +654,9 @@ class LinkageIndex:
                     sc, int(meta["width"]), meta["kind"], rows
                 )
             )
-        return band_key_arrays(columns, ap.q, ap.bands, ap.rows_per_band)
+        return band_key_arrays(
+            columns, ap.q, ap.bands, ap.rows_per_band, idf=ap.idf
+        )
 
     def _pin_string_column(
         self, sc: EncodedStringColumn, meta: dict
@@ -646,8 +734,12 @@ class LinkageIndex:
                 arrays[f"approx{b}_starts"] = band.starts
                 arrays[f"approx{b}_sizes"] = band.sizes
                 arrays[f"approx{b}_row_bucket"] = band.row_bucket
+            if self.approx.idf is not None:
+                arrays["approx_idf"] = self.approx.idf
         for name, counts in self.tf_tables.items():
             arrays[f"tf_{name}"] = counts
+        for name, tids in self.tf_tids.items():
+            arrays[f"tftid_{name}"] = tids
         if self.profile is not None:
             # inside the npz payload, so arrays_sha256 — the fingerprint
             # load_index verifies — covers the profile arrays too
@@ -690,6 +782,7 @@ class LinkageIndex:
                 for r in self.rules
             ],
             "tf_columns": sorted(self.tf_tables),
+            "tf_tid_columns": sorted(self.tf_tids),
             "approx": (
                 None
                 if self.approx is None
@@ -799,6 +892,12 @@ def load_index(directory: str | os.PathLike) -> LinkageIndex:
     else:
         unique_id = npz["unique_id"]
     tf_tables = {name: npz[f"tf_{name}"] for name in meta.get("tf_columns", [])}
+    # legacy artifacts carry no per-row token ids ("tf_tid_columns"
+    # absent): tf_tids stays empty and the index serves unadjusted
+    tf_tids = {
+        name: npz[f"tftid_{name}"]
+        for name in meta.get("tf_tid_columns", [])
+    }
     approx = None
     am = meta.get("approx")
     if am is not None:
@@ -808,6 +907,7 @@ def load_index(directory: str | os.PathLike) -> LinkageIndex:
             q=int(am["q"]),
             bands=int(am["bands"]),
             rows_per_band=int(am["rows_per_band"]),
+            idf=npz["approx_idf"] if "approx_idf" in npz.files else None,
             band_index=[
                 ApproxBand(
                     rows_sorted=npz[f"approx{b}_rows"],
@@ -857,6 +957,7 @@ def load_index(directory: str | os.PathLike) -> LinkageIndex:
         state_hash=meta["state_hash"],
         approx=approx,
         profile=profile,
+        tf_tids=tf_tids,
     )._rebuild_layout()
 
 
@@ -967,7 +1068,7 @@ def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
                 if getattr(linker, "_obs", None) is not None:
                     linker._obs.record("quality_profile", profile.summary())
 
-        from ..term_frequencies import term_frequency_columns
+        from ..term_frequencies import term_frequency_columns, tf_fold_spec
 
         tf_tables = {}
         for name in term_frequency_columns(settings):
@@ -977,16 +1078,16 @@ def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
                 tf_tables[name] = np.bincount(
                     tids[tids >= 0], minlength=sc.n_tokens
                 ).astype(np.int64)
-        if tf_tables:
-            import warnings
-
-            warnings.warn(
-                "settings flag term_frequency_adjustments on "
-                f"{sorted(tf_tables)} but online serving returns "
-                "UNADJUSTED match probabilities (the Fellegi-Sunter score "
-                "only); the per-token count tables ride in the artifact "
-                "(index.tf_tables) for downstream re-ranking."
-            )
+        # per-row reference token ids for the serve-time u-probability
+        # fold (one per tf_fold_spec column with a count table): with
+        # these in the artifact, serving scores ARE TF-adjusted — the old
+        # "unadjusted at serve" warning is gone because the gap it warned
+        # about is gone
+        tf_tids = {
+            name: table.strings[name].token_ids.astype(np.int32)
+            for _ci, name, _top in tf_fold_spec(settings)
+            if name in tf_tables
+        }
 
         state_hash = settings_state_hash(
             settings,
@@ -1009,6 +1110,7 @@ def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
             state_hash=state_hash,
             approx=approx,
             profile=profile,
+            tf_tids=tf_tids,
         )
     finally:
         if clear_caches:
@@ -1106,7 +1208,7 @@ def _build_approx_serve(table: EncodedTable, settings: dict):
     cfg = ApproxConfig.from_settings(settings, table)
     if cfg is None:
         return None
-    band_codes, uniq_keys = compute_band_codes(table, cfg)
+    band_codes, uniq_keys, idf = compute_band_codes(table, cfg)
     col_meta = {}
     for name in cfg.cols:
         sc = table.strings[name]
@@ -1160,6 +1262,7 @@ def _build_approx_serve(table: EncodedTable, settings: dict):
         bands=cfg.bands,
         rows_per_band=cfg.rows_per_band,
         band_index=bands,
+        idf=idf,
     )
 
 

@@ -913,10 +913,21 @@ class LinkageService:
         from ..obs.drift import DriftMonitor
 
         s = self._settings
+        profile = self.engine.index.profile
+        # the served-score distribution and the profile's must describe
+        # the SAME scoring (both TF-adjusted or both not) — either
+        # mismatch (TF engine over a pre-fold unadjusted profile, OR a
+        # tf_adjust=False engine over an adjusted profile) would alert on
+        # the adjustment delta itself. Re-anchor the score channel dark
+        # with a reason instead; the fold-invariant gamma channels stay.
+        score_reference = bool(
+            getattr(self.engine, "tf_active", False)
+        ) == bool(getattr(profile, "tf_adjusted", False))
         return DriftMonitor(
-            self.engine.index.profile,
+            profile,
             window_s=float(s.get("drift_window_s", 60.0) or 60.0),
             alert_psi=float(s.get("drift_alert_psi", 0.25) or 0.0),
+            score_reference=score_reference,
         )
 
     def _drift_tick(self, force: bool = False) -> None:

@@ -61,6 +61,7 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "approx_rows_per_band",
     "approx_threshold",
     "approx_pair_budget",
+    "approx_tf_weighting",
     "spill_dir",
     "profile_dir",
     "telemetry_dir",
@@ -86,6 +87,7 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "serve_hedge_ms",
     "serve_probe_queries",
     "serve_fused",
+    "serve_tf_adjust",
     "serve_trace_sample_rate",
     "obs_exposition_port",
     "obs_flight_records",

@@ -240,6 +240,111 @@ def compute_token_adjustment_device(
     return adj, np.asarray(tok_lambda), np.asarray(counts)
 
 
+# ---------------------------------------------------------------------------
+# Serve-time u-probability fold (the first-class scoring step)
+#
+# The ex-post lambda aggregation above needs the whole scored batch (the
+# per-token lambda IS a batch statistic), so it can never run inside a
+# serve dispatch. The fold below is the Fellegi-Sunter-native alternative:
+# for a TF-flagged comparison whose two sides AGREE on a token t, the
+# average u-probability of the comparison's top (exact-agreement) level is
+# replaced by the token's own collision probability tf(t) = count(t) / N —
+# "John Smith" pairs stop borrowing the rarity of the average surname. In
+# log space that is one per-pair delta per TF column,
+#
+#     delta_c = [tid_l == tid_r >= 0] * (log u_c[L_c - 1] - log tf(t))
+#
+# folded into the running log-Bayes-factor:
+#
+#     p_tf = sigmoid(match_logit + sum_c delta_c)
+#
+# The SAME expression (same table values, same accumulation order, same
+# association) runs inside the fused serve megakernel
+# (serve/engine.make_score_fused_fn), the unfused serve oracle, and the
+# offline fold kernel below — which is what makes serve<->offline and
+# fused<->unfused TF-adjusted scores bit-identical, not merely close.
+# ---------------------------------------------------------------------------
+
+
+def tf_fold_spec(settings: dict) -> tuple:
+    """((gamma_index, col_name, top_level), ...) for every comparison the
+    u-probability fold can serve: TF-flagged, plain ``col_name`` form (the
+    u table is per comparison, so a custom multi-column comparison has no
+    single token column to fold — those keep the ex-post path and are
+    announced by :func:`_warn_custom_tf_once`). ``top_level`` is the
+    comparison's exact-agreement gamma level ``num_levels - 1``: a pair
+    that agrees on the token sits at that level under every shipped
+    comparison kind, so the delta swaps exactly that level's u."""
+    out = []
+    for ci, c in enumerate(settings["comparison_columns"]):
+        if not c.get("term_frequency_adjustments"):
+            continue
+        if "col_name" not in c:
+            used = tuple(c.get("custom_columns_used", ()))
+            if used:
+                _warn_custom_tf_once(used)
+            continue
+        out.append((ci, c["col_name"], int(c["num_levels"]) - 1))
+    return tuple(out)
+
+
+def tf_log_table(counts: np.ndarray) -> np.ndarray:
+    """(n_tokens,) float64 ``log(count / total)`` relative-frequency table
+    for one TF column. Computed ONCE host-side (numpy) and consumed as
+    data by both the serve megakernel and the offline fold kernel — the
+    two paths gather from arrays with identical values, so no
+    cross-library log implementation can split their bits. Zero counts
+    (never observed tokens) floor at one occurrence."""
+    counts = np.asarray(counts, np.float64)
+    total = max(float(counts.sum()), 1.0)
+    return np.log(np.maximum(counts, 1.0) / total)
+
+
+def tf_fold_delta(tid_l, tid_r, log_tf, log_u_top, dtype):
+    """The canonical per-column fold delta (traced; the ONE expression
+    shared by the serve kernels and :func:`make_tf_fold_fn` — the
+    bit-parity contract forbids it forking). Disagreeing or null pairs
+    contribute exactly 0."""
+    import jax.numpy as jnp
+
+    agree = (tid_l == tid_r) & (tid_l >= 0)
+    idx = jnp.clip(tid_l, 0, log_tf.shape[0] - 1)
+    zero = jnp.zeros((), dtype)
+    return jnp.where(agree, log_u_top - log_tf[idx], zero)
+
+
+@functools.lru_cache(maxsize=None)
+def make_tf_fold_fn(spec: tuple):
+    """Jitted offline fold: ``fn(z, u, tid_l.., tid_r.., log_tf..) -> p_tf``
+    where ``z`` is :func:`..models.fellegi_sunter.match_logit` for the
+    pairs, ``u`` the (C, L) u-probability table in the compute dtype, and
+    per spec column one (n,) int32 token-id pair plus the
+    :func:`tf_log_table` values cast to the compute dtype. Mirrors the
+    fused serve kernel's tail step for step (``_safe_log(u)`` lookup, the
+    left-to-right delta accumulation, ``sigmoid(z + tf_sum)``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .models.fellegi_sunter import _safe_log
+
+    n_tf = len(spec)
+
+    @jax.jit
+    def fold(z, u, *arrs):
+        tid_l = arrs[:n_tf]
+        tid_r = arrs[n_tf : 2 * n_tf]
+        log_tf = arrs[2 * n_tf :]
+        log_u = _safe_log(u)
+        tf_sum = jnp.zeros(z.shape, z.dtype)
+        for t, (ci, _name, top) in enumerate(spec):
+            tf_sum = tf_sum + tf_fold_delta(
+                tid_l[t], tid_r[t], log_tf[t], log_u[ci, top], z.dtype
+            )
+        return jax.nn.sigmoid(z + tf_sum)
+
+    return fold
+
+
 @check_types
 def make_adjustment_for_term_frequencies(
     df_e,

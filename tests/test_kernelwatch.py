@@ -572,6 +572,31 @@ def test_bench_report_normaliser_and_flags(tmp_path):
                for ln in report.splitlines())
 
 
+def test_bench_report_recall_at_budget_direction(tmp_path):
+    """The recall-per-budget family (round 11's recall_at_budget, round
+    14's TF twin) is higher-is-better: a drop across rounds flags
+    REGRESSION, a rise IMPROVEMENT — never a neutral CHANGE."""
+    from splink_tpu.obs.cli import _metric_direction
+
+    assert _metric_direction("recall_at_budget") == "higher"
+    assert _metric_direction("recall_at_budget_tf") == "higher"
+    (tmp_path / "BENCH_r11.json").write_text(json.dumps({
+        "metric": "approx_blocking_pairs_per_sec", "value": 1.0,
+        "recall_at_budget": 0.891, "tier": "cpu",
+    }))
+    (tmp_path / "BENCH_r14.json").write_text(json.dumps({
+        "metric": "approx_blocking_pairs_per_sec", "value": 1.0,
+        "recall_at_budget": 0.5, "tier": "cpu",
+    }))
+    report = bench_report_text(sorted(
+        str(p) for p in tmp_path.glob("BENCH_*.json")
+    ))
+    assert any(
+        "REGRESSION" in ln and "recall_at_budget" in ln
+        for ln in report.splitlines()
+    )
+
+
 def test_bench_report_tolerates_roundless_artifacts(tmp_path):
     """Artifacts without an 'n' key or an r<digits> filename carry
     round=None: flagged deltas between them render 'r?' instead of

@@ -315,6 +315,32 @@ def test_serve_fused_key():
     validate_settings(_minimal(serve_fused=False))
 
 
+def test_serve_tf_adjust_key():
+    """serve_tf_adjust completes true (TF-flagged models serve ADJUSTED
+    scores by default once the artifact carries the fold data) and
+    validates as a strict boolean."""
+    s = complete_settings_dict(_minimal())
+    assert s["serve_tf_adjust"] is True
+    for bad in ({"serve_tf_adjust": "yes"}, {"serve_tf_adjust": 1}):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    validate_settings(_minimal(serve_tf_adjust=False))
+
+
+def test_approx_tf_weighting_key():
+    """approx_tf_weighting completes false (the unweighted tier is the
+    bit-compatible default) and validates as a strict boolean."""
+    s = complete_settings_dict(_minimal())
+    assert s["approx_tf_weighting"] is False
+    for bad in (
+        {"approx_tf_weighting": "on"},
+        {"approx_tf_weighting": 1},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    validate_settings(_minimal(approx_tf_weighting=True))
+
+
 def test_serve_observability_defaults_filled():
     """The obs v2 keys complete from the schema: tracing OFF (sample rate
     0), exposition endpoint OFF (port 0), flight recorder ON at 256
