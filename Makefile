@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -122,6 +122,16 @@ perf-smoke:
 tf-smoke:
 	python scripts/tf_smoke.py
 
+# Offline-scale smoke: the billion-row write path's contracts — an
+# out-of-core index build over a corpus larger than the configured
+# working set is content-fingerprint-identical to the resident build,
+# the sharded spill emission's pair set equals the ordinary path's with
+# zero steady-state recompiles across chunk shapes and spill segments,
+# and a build SIGKILLed mid-segment resumes from its manifest to a
+# bit-identical fingerprint (docs/blocking.md#offline-scale).
+scale-smoke:
+	python scripts/scale_smoke.py
+
 bench:
 	python bench.py
 
@@ -129,4 +139,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke bench
+all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench

@@ -1302,6 +1302,237 @@ def bench_perf():
     assert not audit_findings, [f.format() for f in audit_findings]
 
 
+def _proc_rss_mb(field: str = "VmRSS") -> float:
+    """Current (VmRSS) or high-water (VmHWM) resident set, MB, procfs."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith(field):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def _scale_child(mode: str, n_rows: str, out_path: str) -> int:
+    """One fresh-process build phase for `bench.py scale`: encode a
+    deterministic WIDE corpus (6 x 32-byte string columns, so the packed
+    reference matrix — the term the out-of-core build bounds — dominates
+    every other O(n) allocation), train 1 cheap EM iteration, then build
+    the serving index resident or out-of-core. Reports the BUILD phase's
+    RETAINED RSS delta (VmRSS after the build minus VmRSS just before
+    it, inputs released and gc'd — the resident build keeps the full
+    packed matrix live, the out-of-core one O(chunk) plus droppable page
+    cache), plus wall and the content fingerprint the parent asserts
+    identical across modes."""
+    import resource
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import warnings
+
+    import pandas as pd
+
+    from splink_tpu import Splink
+
+    warnings.filterwarnings("ignore")
+    n = int(n_rows)
+    rng = np.random.default_rng(7)
+    cols = {f"f{k}": rng.integers(0, 50_000, n).astype(str) for k in range(6)}
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            # blocks of 20 rows: ~10 pairs/row trains EM while keeping the
+            # serve-rule bucket dictionary (n/20 entries, built by BOTH
+            # build modes) small next to the packed matrix — the term the
+            # out-of-core path actually bounds
+            "city": (np.arange(n) // 20).astype(str),
+            **cols,
+        }
+    )
+    settings = {
+        "link_type": "dedupe_only",
+        "blocking_rules": ["l.city = r.city"],
+        "comparison_columns": [
+            {"col_name": f"f{k}", "num_levels": 2,
+             "comparison": {"kind": "exact"}, "max_string_length": 32}
+            for k in range(6)
+        ],
+        "max_iterations": 1,
+    }
+    if mode == "ooc":
+        settings["build_spill_dir"] = tempfile.mkdtemp(prefix="bench_scale_")
+        settings["build_spill_chunk_rows"] = 16384
+        settings["emit_shard_chunks"] = 4
+    import gc
+
+    linker = Splink(settings, df=df)
+    linker.estimate_parameters()
+    linker.release_input()  # billions-row posture: encoded table only
+    del df, cols
+    gc.collect()
+    # RETAINED footprint delta across the build: encode/EM transients have
+    # already peaked and been collected, so VmRSS-after minus VmRSS-before
+    # isolates what the BUILD leaves resident — the full packed matrix on
+    # the resident path, O(chunk) + droppable page cache out of core
+    rss_before = _proc_rss_mb("VmRSS")
+    t0 = time.perf_counter()
+    index = linker.export_index()
+    fp = index.content_fingerprint()
+    build_wall = time.perf_counter() - t0
+    gc.collect()
+    rss_after = _proc_rss_mb("VmRSS")
+    peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    with open(out_path, "w") as fh:
+        json.dump(
+            {
+                "mode": mode,
+                "n_rows": n,
+                "n_lanes": int(index.n_lanes),
+                "build_wall_s": round(build_wall, 3),
+                "build_rss_delta_mb": round(max(rss_after - rss_before, 0), 1),
+                "peak_rss_mb": round(peak_kb / 1024.0, 1),
+                "fingerprint": fp,
+            },
+            fh,
+        )
+    return 0
+
+
+def bench_scale():
+    """Offline-scale benchmark (`python bench.py scale`, BENCHMARKS.md
+    round 15): (a) resident vs out-of-core index build — wall and
+    per-process peak RSS at 3 corpus sizes (fresh subprocess per phase so
+    ru_maxrss isolates each build), fingerprints asserted identical;
+    (b) sharded vs single-shard spill emission pairs/s on the virtual
+    8-device mesh (the multi-host write-path shape, CPU tier)."""
+    tier = _probe_device_init()
+    import subprocess
+    import tempfile
+    import warnings
+
+    from splink_tpu.blocking_device import (
+        build_device_plan,
+        emit_pairs_sharded,
+    )
+    from splink_tpu.data import encode_table
+    from splink_tpu.obs.metrics import compile_requests, install_compile_monitor
+    from splink_tpu.parallel.mesh import make_mesh
+    from splink_tpu.settings import complete_settings_dict
+    from splink_tpu.spill import PairSpillStore
+
+    warnings.filterwarnings("ignore")
+    install_compile_monitor()
+    sizes = [
+        int(v)
+        for v in os.environ.get(
+            "SPLINK_TPU_BENCH_SCALE_ROWS", "100000,400000,800000"
+        ).split(",")
+    ]
+    tmp = tempfile.mkdtemp(prefix="bench_scale_parent_")
+    sweep = []
+    for n in sizes:
+        row = {"n_rows": n}
+        for mode in ("resident", "ooc"):
+            out = os.path.join(tmp, f"{mode}_{n}.json")
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "scale-child", mode, str(n), out],
+                capture_output=True, text=True, timeout=1800,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            if proc.returncode != 0:
+                print(proc.stderr[-2000:], file=sys.stderr)
+                sys.exit(2)
+            child = json.load(open(out))
+            row[f"{mode}_build_wall_s"] = child["build_wall_s"]
+            row[f"{mode}_build_rss_delta_mb"] = child["build_rss_delta_mb"]
+            row[f"{mode}_peak_rss_mb"] = child["peak_rss_mb"]
+            row[f"{mode}_fingerprint"] = child["fingerprint"]
+        assert row["resident_fingerprint"] == row["ooc_fingerprint"], (
+            f"fingerprint divergence at n={n}"
+        )
+        row["fingerprint_identical"] = True
+        del row["resident_fingerprint"], row["ooc_fingerprint"]
+        sweep.append(row)
+        print(json.dumps({"phase": "build_sweep", **row}), flush=True)
+
+    # ---- sharded vs single-shard emission throughput (virtual mesh) ----
+    n_emit = int(os.environ.get("SPLINK_TPU_BENCH_SCALE_EMIT_ROWS", 200_000))
+    rng = np.random.default_rng(3)
+    import pandas as pd
+
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n_emit),
+            "first_name": rng.integers(0, 50, n_emit).astype(str),
+            "surname": rng.integers(0, 40, n_emit).astype(str),
+            "block": (np.arange(n_emit) % (n_emit // 400)).astype(str),
+        }
+    )
+    s = complete_settings_dict(
+        {
+            "link_type": "dedupe_only",
+            "comparison_columns": [
+                {"col_name": "first_name"},
+                {"col_name": "surname"},
+            ],
+            "blocking_rules": [
+                "l.block = r.block",
+                "l.block = r.block and l.surname = r.surname",
+            ],
+        }
+    )
+    table = encode_table(df, s)
+    plan = build_device_plan(s, table)
+    mesh = make_mesh(8)
+    emit = {}
+    for label, shards, m in (
+        ("single_shard", 1, None),
+        ("sharded_mesh8", 8, mesh),
+    ):
+        # warmup drive (compile), then the timed drive
+        for rep in ("warm", "timed"):
+            store = PairSpillStore.attach(
+                os.path.join(tmp, f"emit_{label}_{rep}"), np.int32, {}
+            )
+            c0 = compile_requests()
+            t0 = time.perf_counter()
+            with store:
+                stats = emit_pairs_sharded(
+                    plan, store, 1 << 20, n_shards=shards, mesh=m
+                )
+            store.finalize()
+            wall = time.perf_counter() - t0
+            if rep == "timed":
+                emit[label] = {
+                    "pairs": stats["pairs"],
+                    "segments": stats["segments"],
+                    "wall_s": round(wall, 3),
+                    "pairs_per_sec": round(stats["pairs"] / max(wall, 1e-9)),
+                    "steady_state_compile_requests": compile_requests() - c0,
+                }
+        print(json.dumps({"phase": f"emit_{label}", **emit[label]}), flush=True)
+
+    print(json.dumps({
+        "metric": "ooc_build_rss_delta_mb_at_max_corpus",
+        "value": sweep[-1]["ooc_build_rss_delta_mb"],
+        "unit": "MB",
+        "build_sweep": sweep,
+        "emission": emit,
+        "build_rss_growth_resident": round(
+            (sweep[-1]["resident_build_rss_delta_mb"] or 0.1)
+            / max(sweep[0]["resident_build_rss_delta_mb"], 0.1), 2
+        ),
+        "build_rss_growth_ooc": round(
+            (sweep[-1]["ooc_build_rss_delta_mb"] or 0.1)
+            / max(sweep[0]["ooc_build_rss_delta_mb"], 0.1), 2
+        ),
+        "device": "cpu",
+        **tier,
+    }))
+
+
 def main():
     tier = _probe_device_init()
     import jax
@@ -1551,5 +1782,10 @@ if __name__ == "__main__":
         bench_tf()
     elif "perf" in sys.argv[1:]:
         bench_perf()
+    elif "scale-child" in sys.argv[1:]:
+        i = sys.argv.index("scale-child")
+        sys.exit(_scale_child(sys.argv[i + 1], sys.argv[i + 2], sys.argv[i + 3]))
+    elif "scale" in sys.argv[1:]:
+        bench_scale()
     else:
         main()

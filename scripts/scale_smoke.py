@@ -1,0 +1,210 @@
+"""Offline-scale smoke (`make scale-smoke`): gate the billion-row write
+path's four contracts end to end on the CPU tier:
+
+  1. bounded working set — the out-of-core index build streams the packed
+     reference matrix over a corpus MANY chunks larger than the
+     configured ``build_spill_chunk_rows`` working set, and the artifact
+     it produces is content-fingerprint-identical to the resident build's
+     (parity vs the resident path);
+  2. sharded emission parity — the spill store's pair set equals the
+     ordinary blocking path's on the same rules;
+  3. zero steady-state recompiles — re-driving the sharded emission over
+     the same plan (chunk shapes, shard switches and spill segments
+     included) keeps the jax.monitoring compile-request counter flat;
+  4. resume-after-kill green — a subprocess build SIGKILLed mid-segment
+     (SPLINK_TPU_FAULTS, the emit_segment site) resumes over the same
+     build directory to a fingerprint bit-identical to an uninterrupted
+     run (tests/spill_build_worker.py is the driver).
+
+Exits nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _df(n, seed):
+    import numpy as np
+    import pandas as pd
+
+    r = np.random.default_rng(seed)
+    firsts = np.array(["amelia", "oliver", "isla", "george", "ava", "noah"])
+    lasts = np.array(["smith", "jones", "taylor", "brown", "wilson"])
+    return pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "first_name": firsts[r.integers(0, 6, n)],
+            "surname": lasts[r.integers(0, 5, n)],
+            "city": [f"c{i % 5}" for i in range(n)],
+        }
+    )
+
+
+def _settings(**overrides):
+    s = {
+        "link_type": "dedupe_only",
+        "blocking_rules": ["l.city = r.city", "l.surname = r.surname"],
+        "comparison_columns": [
+            {
+                "col_name": "first_name",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "max_iterations": 3,
+    }
+    s.update(overrides)
+    return s
+
+
+def main() -> int:
+    import warnings
+
+    import numpy as np
+
+    from splink_tpu import Splink
+    from splink_tpu.obs.metrics import (
+        compile_requests,
+        install_compile_monitor,
+    )
+
+    install_compile_monitor()
+    warnings.filterwarnings("ignore")
+    failures = []
+    tmp = tempfile.mkdtemp(prefix="splink_scale_smoke_")
+    n = 5000  # ~5x the 1024-row working-set chunk below
+
+    # ---- 1+2: out-of-core build parity over a multi-chunk corpus ----
+    df = _df(n, seed=1)
+    resident = Splink(_settings(), df=df)
+    resident.estimate_parameters()
+    fp_resident = resident.export_index().content_fingerprint()
+    pairs_resident = resident._pairs
+
+    ooc = Splink(
+        _settings(
+            build_spill_dir=os.path.join(tmp, "build"),
+            build_spill_chunk_rows=1024,
+            emit_shard_chunks=4,
+            blocking_chunk_pairs=262144,
+        ),
+        df=df,
+    )
+    ooc.estimate_parameters()
+    ix = ooc.export_index()
+    n_chunks = -(-n // 1024)
+    if not isinstance(ix.packed, np.memmap):
+        failures.append("out-of-core build did not stream the packed matrix")
+    if ix.content_fingerprint() != fp_resident:
+        failures.append(
+            "out-of-core index fingerprint diverged from the resident build"
+        )
+    else:
+        print(
+            f"scale-smoke: OOC fingerprint parity over {n_chunks} packed "
+            f"chunks OK ({ix.content_fingerprint()[:16]})"
+        )
+    store = getattr(ooc._pairs, "spill_store", None)
+    if store is None:
+        failures.append("build_spill_dir did not route through the store")
+    else:
+        a = set(zip(pairs_resident.idx_l.tolist(),
+                    pairs_resident.idx_r.tolist()))
+        b = set(zip(ooc._pairs.idx_l.tolist(), ooc._pairs.idx_r.tolist()))
+        if a != b:
+            failures.append("sharded spill pair set != ordinary blocking")
+        else:
+            print(
+                f"scale-smoke: sharded emission parity OK "
+                f"({len(b)} pairs, {len(store.segments)} segments)"
+            )
+        store.verify()
+        print("scale-smoke: manifest sha256 verify OK")
+
+    # ---- 3: zero steady-state recompiles across segments ----
+    from splink_tpu.blocking_device import (
+        build_device_plan,
+        emit_pairs_sharded,
+    )
+    from splink_tpu.data import encode_table
+    from splink_tpu.settings import complete_settings_dict
+    from splink_tpu.spill import PairSpillStore
+
+    s_plan = complete_settings_dict(_settings())
+    table = encode_table(df, s_plan)
+    plan = build_device_plan(s_plan, table)
+    st1 = PairSpillStore.attach(os.path.join(tmp, "rc1"), np.int32, {})
+    with st1:
+        emit_pairs_sharded(plan, st1, 262144, n_shards=4)
+    st1.finalize()
+    c0 = compile_requests()
+    st2 = PairSpillStore.attach(os.path.join(tmp, "rc2"), np.int32, {})
+    with st2:
+        emit_pairs_sharded(plan, st2, 262144, n_shards=4)
+    st2.finalize()
+    delta = compile_requests() - c0
+    if delta:
+        failures.append(f"{delta} steady-state recompiles across segments")
+    else:
+        print("scale-smoke: zero steady-state recompiles OK")
+
+    # ---- 4: resume-after-kill, bit-identical fingerprint ----
+    worker = os.path.join(REPO, "tests", "spill_build_worker.py")
+    build = os.path.join(tmp, "killbuild")
+    env = dict(os.environ)
+    env.pop("SPLINK_TPU_FAULTS", None)
+    ref_out = os.path.join(tmp, "ref.json")
+    ref = subprocess.run(
+        [sys.executable, worker, ref_out, os.path.join(tmp, "refbuild"), "1"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    if ref.returncode != 0:
+        failures.append(f"reference build failed: {ref.stderr[-500:]}")
+    killed = subprocess.run(
+        [sys.executable, worker, os.path.join(tmp, "k.json"), build, "1"],
+        cwd=REPO,
+        env={**env, "SPLINK_TPU_FAULTS": "emit_segment@seq=2:kind=kill"},
+        capture_output=True, text=True, timeout=600,
+    )
+    if killed.returncode != -signal.SIGKILL:
+        failures.append(
+            f"kill injection did not SIGKILL (rc={killed.returncode})"
+        )
+    resumed = subprocess.run(
+        [sys.executable, worker, os.path.join(tmp, "r.json"), build, "1"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    if resumed.returncode != 0:
+        failures.append(f"resumed build failed: {resumed.stderr[-500:]}")
+    elif not failures:
+        want = json.load(open(ref_out))["fingerprint"]
+        got = json.load(open(os.path.join(tmp, "r.json")))["fingerprint"]
+        if want != got:
+            failures.append("resume-after-kill fingerprint diverged")
+        else:
+            print("scale-smoke: resume-after-kill bit-identical OK")
+
+    if failures:
+        for f in failures:
+            print(f"scale-smoke FAILED: {f}", file=sys.stderr)
+        return 1
+    print("scale-smoke: ALL OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -958,6 +958,39 @@ def _ensure_default_registry() -> None:
             {},
         )
 
+    # Spill-emission transfer digest sharded over the pair-position axis:
+    # each shard mixes its own (i, j) lanes against replicated constants
+    # and the wraparound uint32 sum lowers to exactly ONE declared psum —
+    # the only cross-device traffic the sharded write path performs (the
+    # emission decode itself is collective-free, block_pair_decode_sharded
+    # above).
+    @register_shard_kernel(
+        "spill_chunk_digest_sharded",
+        n_pairs=64,
+        allow_collectives=("all-reduce",),
+    )
+    def _build_spill_chunk_digest_sharded():
+        import jax
+        import numpy as np
+
+        from ..blocking_device import make_chunk_digest_fn
+        from ..parallel.mesh import pair_sharding
+
+        mesh = audit_mesh()
+        fn = make_chunk_digest_fn(mesh)
+        shard = pair_sharding(mesh)
+        rng = np.random.default_rng(0)
+        i = jax.device_put(
+            rng.integers(0, 64, size=64).astype(np.int32), shard
+        )
+        j = jax.device_put(
+            rng.integers(0, 64, size=64).astype(np.int32), shard
+        )
+        keep = jax.device_put(
+            rng.integers(0, 2, size=64).astype(bool), shard
+        )
+        return fn, (i, j, keep), {}
+
     # Approximate-blocking minhash signatures sharded over the RECORD
     # axis: each shard sketches its own rows against the replicated hash
     # parameters — embarrassingly parallel, zero collectives, outputs

@@ -774,6 +774,38 @@ def _ensure_default_registry() -> None:
             {},
         )
 
+    @register_kernel("spill_chunk_digest")
+    def _build_spill_chunk_digest():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..blocking_device import make_chunk_digest_fn
+
+        fn = make_chunk_digest_fn()
+        rng = np.random.default_rng(0)
+        i = jnp.asarray(rng.integers(0, 64, size=64).astype(np.int32))
+        j = jnp.asarray(rng.integers(0, 64, size=64).astype(np.int32))
+        keep = jnp.asarray(rng.integers(0, 2, size=64).astype(bool))
+        return fn, (i, j, keep), {}
+
+    @register_kernel("spill_chunk_digest_compact")
+    def _build_spill_chunk_digest_compact():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..blocking_device import make_chunk_digest_compact_fn
+
+        fn = make_chunk_digest_compact_fn()
+        rng = np.random.default_rng(0)
+        i_ext = jnp.asarray(
+            np.concatenate(
+                [rng.integers(0, 64, size=64), [37]]
+            ).astype(np.int32)
+        )
+        j = jnp.asarray(rng.integers(0, 64, size=64).astype(np.int32))
+        pos = jnp.arange(64, dtype=jnp.int32)
+        return fn, (i_ext, j, pos), {}
+
     # ----- approximate blocking (splink_tpu/approx/) -----
     # The minhash-signature and LSH-verification kernels run over every
     # record / every candidate pair of an approx-tier run (and the minhash

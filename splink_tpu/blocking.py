@@ -76,10 +76,43 @@ class PairIndex:
     # idx_l/idx_r are memmaps living in this directory; the linker adopts it
     # for lifetime management.
     spill_tmp: str | None = None
+    # When the pairs came through the DURABLE spill store (build_spill_dir:
+    # sharded emission with a resume manifest), this is the owning
+    # spill.PairSpillStore — caller-owned, never auto-deleted, and what the
+    # spill-fed streamed EM consumes directly.
+    spill_store: object | None = None
 
     @property
     def n_pairs(self) -> int:
         return len(self.idx_l)
+
+    def release(self) -> None:
+        """Deterministically release the spill backing: close the memmaps
+        FIRST, then reclaim the transient spill directory. The weakref
+        finalizer does the same reclaim at GC time on POSIX, but Windows
+        refuses to unlink a file with a live mapping — callers that need
+        portable, immediate reclamation use this instead of relying on
+        collection order. Idempotent; leaves a durable spill_store's files
+        untouched (those are caller-owned)."""
+        import shutil
+
+        for name in ("idx_l", "idx_r"):
+            arr = getattr(self, name)
+            mm = getattr(arr, "_mmap", None)
+            if mm is not None:
+                setattr(self, name, np.zeros(0, arr.dtype))
+                try:
+                    mm.close()
+                except (BufferError, OSError):
+                    pass  # an external view still holds the map
+        fin = self.__dict__.pop("_finalizer", None)
+        if fin is not None:
+            fin.detach()
+        if self.spill_tmp is not None:
+            shutil.rmtree(self.spill_tmp, ignore_errors=True)
+            self.spill_tmp = None
+        if self.spill_store is not None:
+            self.spill_store.release_maps()
 
 
 def _proc_start_time(pid: int) -> int | None:
@@ -160,7 +193,21 @@ def _sweep_stale_spill_dirs(spill_dir: str) -> None:
 class _PairSink:
     """Accumulates per-rule pair chunks; either in RAM (concatenate at the
     end) or streamed to spill files as they are produced, so the pair set
-    never exists twice in memory (chunks + concatenated copy)."""
+    never exists twice in memory (chunks + concatenated copy).
+
+    A context manager: an exception anywhere inside the ``with`` body
+    aborts the sink — handles closed, the partial spill directory
+    reclaimed — so segments written before a mid-emission failure are
+    never left for the stale-dir sweep to (not) find: the owning process
+    is still alive, which is exactly the case the pid-based sweep
+    correctly refuses to touch."""
+
+    def __enter__(self) -> "_PairSink":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.abort()
 
     def __init__(self, spill_dir: str | None, idx_dtype):
         self.idx_dtype = idx_dtype
@@ -244,8 +291,10 @@ class _PairSink:
                 arrs.append(np.empty(0, self.idx_dtype))
         out = PairIndex(arrs[0], arrs[1], spill_tmp=self.spill_tmp)
         # reclaim the files when the pair index goes away (unlink while the
-        # memmaps are open is safe on POSIX; space frees on close)
-        weakref.finalize(out, shutil.rmtree, self.spill_tmp, True)
+        # memmaps are open is safe on POSIX; space frees on close). The
+        # handle is kept so PairIndex.release() can close the maps first
+        # and detach — the Windows-safe deterministic path.
+        out._finalizer = weakref.finalize(out, shutil.rmtree, self.spill_tmp, True)
         return out
 
 
@@ -923,7 +972,7 @@ def block_using_rules(
     # dedup semantics (a pair any exact rule produced is never re-emitted)
     # and appends its budget-ordered chunks to the same sink.
     approx_on = bool(settings.get("approx_blocking"))
-    try:
+    with sink:
         # Device-native tier first (blocking_device.py): the sort-based
         # hash join runs as jitted kernels and streams budgeted chunks into
         # the same sink. Falls through to the host join for unsupported
@@ -954,9 +1003,6 @@ def block_using_rules(
 
         approx_block_into(settings, table, n_left, sink, pair_consumer)
         return sink.finish()
-    except BaseException:
-        sink.abort()
-        raise
 
 
 def _block_rules_into(
@@ -1170,8 +1216,7 @@ def cartesian_block(
         if pair_consumer is not None:
             pair_consumer(i, j)
         return PairIndex(i, j)
-    sink = _PairSink(spill_dir, idx_dtype)
-    try:
+    with _PairSink(spill_dir, idx_dtype) as sink:
         for i, j in _iter_all_pairs_chunks(
             table, link_type, n_left, _CARTESIAN_CHUNK
         ):
@@ -1183,6 +1228,3 @@ def cartesian_block(
                     j.astype(idx_dtype, copy=False),
                 )
         return sink.finish()
-    except BaseException:
-        sink.abort()
-        raise

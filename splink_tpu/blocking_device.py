@@ -498,6 +498,107 @@ def build_device_plan(
 # --------------------------------------------------------------------------
 
 
+def _emission_context(plan: DeviceBlockPlan, batch_size: int, mesh):
+    """Shared device setup for the TWO emission drivers
+    (:func:`iter_device_pairs` — streaming — and
+    :func:`emit_pairs_sharded` — the spill write path): the int32-safe
+    batch clamp, mesh padding, the replicated put, the
+    compaction-placement decision and the plan-constant uploads. One
+    implementation, because the drivers are documented pair-set twins and
+    a one-sided change to any of these invariants would silently diverge
+    them."""
+    import jax
+    import jax.numpy as jnp
+
+    # int32-safe bound, same margin as pairgen: batch-relative pc entries
+    # can overshoot the batch end by up to one unit's pair count
+    safe = (1 << 31) - 1 - plan.chunk * plan.chunk
+    batch_size = min(max(int(batch_size), 64), safe)
+    shard = None
+    if mesh is not None:
+        from .parallel.mesh import (
+            pad_to_multiple,
+            pair_sharding,
+            replicated,
+        )
+
+        msz = mesh.devices.size
+        batch_size = pad_to_multiple(batch_size, msz)
+        if batch_size > safe:
+            batch_size = max(safe // msz, 1) * msz
+        shard = pair_sharding(mesh)
+        repl = replicated(mesh)
+        put = lambda a: jax.device_put(jnp.asarray(a), repl)  # noqa: E731
+    else:
+        put = jnp.asarray
+    # on-device compaction only where it pays: it saves D2H bytes on
+    # accelerator links but runs as a serial scatter loop on the XLA CPU
+    # backend (make_pair_emit_fn docstring) — there the host compacts
+    compact_dev = mesh is None and jax.default_backend() != "cpu"
+    return {
+        "batch_size": batch_size,
+        "put": put,
+        "shard": shard,
+        "compact_dev": compact_dev,
+        "ranks": put(plan.ranks),
+        "codes_l": put(
+            plan.codes_l if len(plan.codes_l) else np.zeros((1, 1), np.int32)
+        ),
+        "codes_r": put(
+            plan.codes_r if len(plan.codes_r) else np.zeros((1, 1), np.int32)
+        ),
+        "uid": put(
+            plan.uid_codes if plan.uid_codes is not None
+            else np.zeros(1, np.int32)
+        ),
+        "res_ops": tuple(put(a) for a in plan.res_ops),
+    }
+
+
+def _rule_emit_setup(plan, r, rp, ctx, mesh, pos_cache):
+    """Per-rule shared setup for both drivers: the pow2-clamped rule batch
+    (mesh-padded), the cached position iota, the uploaded plan arrays and
+    the cached emission kernel (one specialisation per (rule, batch,
+    mesh, compaction) — the kernel_cache key both drivers share, so a
+    warmup through one driver warms the other)."""
+    import jax
+    import jax.numpy as jnp
+
+    rule_bs = min(ctx["batch_size"], _pow2(max(rp.total, 64)))
+    if mesh is not None:
+        from .parallel.mesh import pad_to_multiple
+
+        rule_bs = pad_to_multiple(rule_bs, mesh.devices.size)
+    pos_rule = pos_cache.get(rule_bs)
+    if pos_rule is None:
+        if mesh is not None:
+            pos_rule = jax.device_put(
+                np.arange(rule_bs, dtype=np.int32), ctx["shard"]
+            )
+        else:
+            pos_rule = jnp.arange(rule_bs, dtype=jnp.int32)
+        pos_cache[rule_bs] = pos_rule
+    put = ctx["put"]
+    order_dev = put(rp.order)
+    units_dev = tuple(put(a) for a in (rp.ua, rp.la, rp.ub, rp.lb))
+    kkey = (
+        r, rule_bs, None if mesh is None else id(mesh), ctx["compact_dev"],
+    )
+    fn = plan.kernel_cache.get(kkey)
+    if fn is None:
+        fn = plan.kernel_cache[kkey] = make_pair_emit_fn(
+            rule_bs,
+            n_prev=r,
+            has_uid_mask=plan.uid_codes is not None,
+            rank_filter=rp.rank_filter,
+            own_res=rp.residual_fn,
+            prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
+            mesh=mesh,
+            compact=ctx["compact_dev"],
+        )
+    return rule_bs, pos_rule, order_dev, units_dev, fn
+
+
 def iter_device_pairs(plan: DeviceBlockPlan, batch_size: int, mesh=None):
     """Drive the emission kernels over every rule, yielding
     ``(rule_index, i, j)`` host int32 chunks of at most ``batch_size``
@@ -520,50 +621,18 @@ def iter_device_pairs(plan: DeviceBlockPlan, batch_size: int, mesh=None):
     from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
-    import jax
-    import jax.numpy as jnp
-
     from .obs.events import publish
 
     if plan.n_candidates == 0:
         return
-    # int32-safe bound, same margin as pairgen: batch-relative pc entries
-    # can overshoot the batch end by up to one unit's pair count
-    safe = (1 << 31) - 1 - plan.chunk * plan.chunk
-    batch_size = min(max(int(batch_size), 64), safe)
-    if mesh is not None:
-        from .parallel.mesh import (
-            pad_to_multiple,
-            pair_sharding,
-            replicated,
-        )
-
-        msz = mesh.devices.size
-        batch_size = pad_to_multiple(batch_size, msz)
-        if batch_size > safe:
-            batch_size = max(safe // msz, 1) * msz
-        shard = pair_sharding(mesh)
-        repl = replicated(mesh)
-        put = lambda a: jax.device_put(jnp.asarray(a), repl)  # noqa: E731
-    else:
-        put = jnp.asarray
-
-    # on-device compaction only where it pays: it saves D2H bytes on
-    # accelerator links but runs as a serial scatter loop on the XLA CPU
-    # backend (make_pair_emit_fn docstring) — there the host compacts
-    compact_dev = mesh is None and jax.default_backend() != "cpu"
-    ranks_dev = put(plan.ranks)
-    codes_l_dev = put(
-        plan.codes_l if len(plan.codes_l) else np.zeros((1, 1), np.int32)
-    )
-    codes_r_dev = put(
-        plan.codes_r if len(plan.codes_r) else np.zeros((1, 1), np.int32)
-    )
-    uid_dev = put(
-        plan.uid_codes if plan.uid_codes is not None
-        else np.zeros(1, np.int32)
-    )
-    res_ops_dev = tuple(put(a) for a in plan.res_ops)
+    ctx = _emission_context(plan, batch_size, mesh)
+    batch_size = ctx["batch_size"]
+    compact_dev = ctx["compact_dev"]
+    ranks_dev = ctx["ranks"]
+    codes_l_dev = ctx["codes_l"]
+    codes_r_dev = ctx["codes_r"]
+    uid_dev = ctx["uid"]
+    res_ops_dev = ctx["res_ops"]
     pos_cache: dict = {}
     pool = ThreadPoolExecutor(max_workers=_D2H_DEPTH)
     inflight: deque = deque()
@@ -625,41 +694,13 @@ def iter_device_pairs(plan: DeviceBlockPlan, batch_size: int, mesh=None):
         for r, rp in enumerate(plan.rules):
             if rp.total == 0:
                 continue
-            # clamp to this rule's total (power-of-two bucket): a 38k-pair
-            # rule must not pad to a multi-M batch of dead lanes
-            rule_bs = min(batch_size, _pow2(max(rp.total, 64)))
-            if mesh is not None:
-                rule_bs = pad_to_multiple(rule_bs, mesh.devices.size)
-            pos_rule = pos_cache.get(rule_bs)
-            if pos_rule is None:
-                if mesh is not None:
-                    pos_rule = jax.device_put(
-                        np.arange(rule_bs, dtype=np.int32), shard
-                    )
-                else:
-                    pos_rule = jnp.arange(rule_bs, dtype=jnp.int32)
-                pos_cache[rule_bs] = pos_rule
-            order_dev = put(rp.order)
-            units_dev = tuple(put(a) for a in (rp.ua, rp.la, rp.ub, rp.lb))
-            kkey = (
-                r, rule_bs, None if mesh is None else id(mesh), compact_dev,
+            # rule batch clamped to the rule total (pow2 bucket): a
+            # 38k-pair rule must not pad to a multi-M batch of dead lanes
+            rule_bs, pos_rule, order_dev, units_dev, fn = _rule_emit_setup(
+                plan, r, rp, ctx, mesh, pos_cache
             )
-            fn = plan.kernel_cache.get(kkey)
-            if fn is None:
-                fn = plan.kernel_cache[kkey] = make_pair_emit_fn(
-                    rule_bs,
-                    n_prev=r,
-                    has_uid_mask=plan.uid_codes is not None,
-                    rank_filter=rp.rank_filter,
-                    own_res=rp.residual_fn,
-                    prev_res=tuple(
-                        p.residual_fn for p in plan.rules[:r]
-                    ),
-                    mesh=mesh,
-                    compact=compact_dev,
-                )
             for p0, p1, meta in _unit_batch_meta(rp.pc, rp.total, rule_bs):
-                meta_dev = put(meta)
+                meta_dev = ctx["put"](meta)
                 out_i, out_j, keep = fn(
                     pos_rule, order_dev, *units_dev, ranks_dev,
                     codes_l_dev, codes_r_dev, uid_dev, res_ops_dev,
@@ -776,6 +817,464 @@ def device_block_rules(
                 j.astype(sink.idx_dtype, copy=False),
             )
     return sink.finish() if finish else sink
+
+
+# --------------------------------------------------------------------------
+# Sharded, out-of-core, resumable emission (the billion-row write path)
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=8)
+def make_chunk_digest_fn(mesh=None):
+    """Jitted transfer-integrity digest over one emitted pair chunk:
+    fn(i, j, keep) -> uint32 scalar, the wraparound sum of a per-lane
+    multiplicative mix of (i, j) over the kept lanes.
+
+    Computed ON DEVICE right after the emission kernel (the pairs are
+    already resident), then re-derived on the host from the downloaded
+    arrays (spill.chunk_digest_host) — a mismatch catches corruption in
+    the D2H path itself, the failure mode a tunnelled accelerator link
+    adds on top of disk rot (which the manifest's sha256 covers). The sum
+    is order-independent, which is exactly right: compaction reorders
+    nothing but drops masked lanes, so the kept-lane multiset is the
+    written multiset. Under a mesh the lane mixes are embarrassingly
+    parallel along the sharded position axis and the sum lowers to one
+    declared psum (shard_audit: spill_chunk_digest_sharded)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .spill import DIGEST_ADD, DIGEST_MUL
+
+    jit_kwargs = {}
+    if mesh is not None:
+        from .parallel.mesh import replicated
+
+        jit_kwargs = {"out_shardings": replicated(mesh)}
+
+    @functools.partial(jax.jit, **jit_kwargs)
+    def fn(i, j, keep):
+        mixed = (i.astype(jnp.uint32) * jnp.uint32(DIGEST_MUL)) ^ (
+            j.astype(jnp.uint32) + jnp.uint32(DIGEST_ADD)
+        )
+        mixed = mixed ^ (mixed >> jnp.uint32(15))
+        return jnp.sum(
+            jnp.where(keep, mixed, jnp.uint32(0)), dtype=jnp.uint32
+        )
+
+    return fn
+
+
+@functools.lru_cache(maxsize=1)
+def make_chunk_digest_compact_fn():
+    """The transfer digest for COMPACTED emission chunks (the accelerator
+    path, where on-device compaction halves D2H bytes): fn(i_ext, j, pos)
+    -> uint32, with ``i_ext`` carrying the survivor count as its last lane
+    (the emit kernel's compacted layout) and ``pos < count`` selecting
+    exactly the survivor lanes. Same mix and sum as
+    :func:`make_chunk_digest_fn`, so the host mirror over the downloaded
+    prefix verifies it unchanged — without this twin, the very backends
+    whose tunnelled D2H link the digest exists to check would commit
+    segments unverified."""
+    import jax
+    import jax.numpy as jnp
+
+    from .spill import DIGEST_ADD, DIGEST_MUL
+
+    @jax.jit
+    def fn(i_ext, j, pos):
+        # static python index, NOT i_ext[-1]: a traced negative index
+        # lowers through an int64 dynamic_slice under x64 (TA-DTYPE — the
+        # same hazard the segment-sort kernel documents)
+        cnt = i_ext[i_ext.shape[0] - 1]
+        i = i_ext[:-1]
+        keep = pos < cnt
+        mixed = (i.astype(jnp.uint32) * jnp.uint32(DIGEST_MUL)) ^ (
+            j.astype(jnp.uint32) + jnp.uint32(DIGEST_ADD)
+        )
+        mixed = mixed ^ (mixed >> jnp.uint32(15))
+        return jnp.sum(
+            jnp.where(keep, mixed, jnp.uint32(0)), dtype=jnp.uint32
+        )
+
+    return fn
+
+
+def _shard_unit_ranges(pc: np.ndarray, n_shards: int) -> list[tuple[int, int]]:
+    """Partition a rule's units into ``n_shards`` contiguous [lo, hi) index
+    ranges balanced by CUMULATIVE PAIR COUNT (not unit count — unit pair
+    sizes vary by orders of magnitude, and a row-count split would leave
+    one shard holding every monster rectangle). Contiguity is what makes a
+    shard's position space a simple offset slice of the rule's pc table,
+    so each shard drives the SAME emission kernel over its own
+    batch-relative metadata."""
+    n_units = len(pc) - 1
+    total = int(pc[-1])
+    if n_units <= 0 or total == 0:
+        return [(0, 0)] * n_shards
+    cuts = [
+        int(np.searchsorted(pc, (total * k) // n_shards, side="left"))
+        for k in range(n_shards + 1)
+    ]
+    cuts[0], cuts[-1] = 0, n_units
+    # monotone repair: searchsorted on a heavily skewed pc can cross
+    for k in range(1, n_shards + 1):
+        cuts[k] = min(max(cuts[k], cuts[k - 1]), n_units)
+    return [(cuts[k], cuts[k + 1]) for k in range(n_shards)]
+
+
+def emit_pairs_sharded(
+    plan: DeviceBlockPlan,
+    store,
+    batch_size: int,
+    n_shards: int = 1,
+    mesh=None,
+    budget: int | None = None,
+    fault_plan=None,
+    shard_filter: tuple[int, int] | None = None,
+):
+    """Drive the sharded, resumable emission of ``plan`` into a
+    :class:`~.spill.PairSpillStore`.
+
+    Each rule's triangle/rectangle units partition into ``n_shards``
+    contiguous pair-count-balanced ranges (:func:`_shard_unit_ranges`);
+    every (rule, shard) streams fixed-shape pow2 chunks through the SAME
+    emission kernels as :func:`iter_device_pairs` (one specialisation per
+    rule — shard metadata rows are floored to the rule-wide kpad so a
+    shard switch never recompiles), each chunk committing as one manifest
+    segment. With ``mesh`` the chunk decode shards over the data axis via
+    the collective-free ``block_pair_decode_sharded`` kernel and the host
+    compacts per shard.
+
+    Determinism is the resumability contract: segments enumerate in fixed
+    (rule, shard, seq) order with deterministic contents, so a driver
+    relaunched over a half-built store SKIPS the committed prefix (no
+    kernel runs for it) and appends byte-identical segments from there —
+    the approx tier's progressive-budget discipline applied globally:
+    ``budget`` caps total emitted pairs across all rules and shards, the
+    final segment truncating exactly at the envelope.
+
+    ``shard_filter=(p, P)`` emits only shards with ``shard % P == p`` —
+    the multi-controller partition: each host drives its own subset of
+    every rule's shards into its own per-process store, and the spill-fed
+    EM's cross-process stats reduction makes the union behave as one
+    global pair set (the same contract as global_pair_slice over a
+    materialised G). ``budget`` is enforced against THIS driver's
+    committed store — i.e. PER PROCESS under a shard filter (each
+    controller's envelope, not a cross-process global; a global cap wants
+    ``budget // P`` per process).
+
+    Returns a stats dict (segments, skipped, pairs, exhausted). The caller
+    finalizes the store.
+    """
+    import time as _time
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import jax.numpy as jnp
+
+    from .obs.events import publish
+    from .resilience import faults as _faults
+
+    if fault_plan is None:
+        fault_plan = _faults.active_plan()
+
+    safe = (1 << 31) - 1 - plan.chunk * plan.chunk
+    batch_size = min(max(int(batch_size), 64), safe)
+    if mesh is not None:
+        from .parallel.mesh import pad_to_multiple, pair_sharding, replicated
+
+        msz = mesh.devices.size
+        batch_size = pad_to_multiple(batch_size, msz)
+        if batch_size > safe:
+            batch_size = max(safe // msz, 1) * msz
+        shard_s = pair_sharding(mesh)
+        repl = replicated(mesh)
+        put = lambda a: jax.device_put(jnp.asarray(a), repl)  # noqa: E731
+    else:
+        put = jnp.asarray
+
+    compact_dev = mesh is None and jax.default_backend() != "cpu"
+    ranks_dev = put(plan.ranks)
+    codes_l_dev = put(
+        plan.codes_l if len(plan.codes_l) else np.zeros((1, 1), np.int32)
+    )
+    codes_r_dev = put(
+        plan.codes_r if len(plan.codes_r) else np.zeros((1, 1), np.int32)
+    )
+    uid_dev = put(
+        plan.uid_codes if plan.uid_codes is not None
+        else np.zeros(1, np.int32)
+    )
+    res_ops_dev = tuple(put(a) for a in plan.res_ops)
+    digest_fn = (
+        make_chunk_digest_compact_fn()
+        if compact_dev
+        else make_chunk_digest_fn(mesh)
+    )
+    pos_cache: dict = {}
+    pool = ThreadPoolExecutor(max_workers=_D2H_DEPTH)
+    inflight: deque = deque()
+    stats = {"segments": 0, "skipped": 0, "pairs": 0, "exhausted": False}
+    # resumed stores already carry pairs toward the budget envelope
+    emitted = sum(s.pairs for s in store.segments)
+    t_start = _time.perf_counter()
+
+    def fetch(out_i, out_j, keep, n_valid, dig):
+        """Download + host-compact one chunk (the iter_device_pairs fetch
+        logic, minus zero-copy slicing — segment bytes are written
+        immediately, so owning copies buy nothing)."""
+        if keep is None:
+            return (
+                np.asarray(out_i)[:n_valid].copy(),
+                np.asarray(out_j)[:n_valid].copy(),
+                None,
+            )
+        if compact_dev:
+            ih = np.asarray(out_i)
+            jh = np.asarray(out_j)
+            cnt = int(ih[-1])
+            d = None if dig is None else int(np.asarray(dig))
+            return ih[:cnt].copy(), jh[:cnt].copy(), d
+        if mesh is None:
+            kh = np.asarray(keep)[:n_valid]
+            ih = np.asarray(out_i)[:n_valid]
+            jh = np.asarray(out_j)[:n_valid]
+            d = None if dig is None else int(np.asarray(dig))
+            if kh.all():
+                return ih.copy(), jh.copy(), d
+            return ih[kh], jh[kh], d
+        kh = np.asarray(keep)
+        d = None if dig is None else int(np.asarray(dig))
+        return np.asarray(out_i)[kh], np.asarray(out_j)[kh], d
+
+    def drain_one():
+        nonlocal emitted
+        r, s, k, fut = inflight.popleft()
+        i, j, dig = fut.result()
+        if budget is not None and emitted + len(i) > budget:
+            keep = max(budget - emitted, 0)
+            i, j, dig = i[:keep], j[:keep], None
+            stats["exhausted"] = True
+        emitted += len(i)
+        stats["pairs"] += len(i)
+        stats["segments"] += 1
+        store.write_segment(
+            r, s, k, i, j, digest=dig,
+            fault_hook=lambda: fault_plan.fire(
+                "emit_segment", rule=r, shard=s, seq=k
+            ),
+        )
+
+    try:
+        for r, rp in enumerate(plan.rules):
+            if rp.total == 0:
+                continue
+            rule_bs = min(batch_size, _pow2(max(rp.total, 64)))
+            if mesh is not None:
+                rule_bs = pad_to_multiple(rule_bs, mesh.devices.size)
+            ranges = _shard_unit_ranges(rp.pc, n_shards)
+            # two-pass metadata build: learn each shard's natural kpad,
+            # then floor every shard at the rule-wide max so all segments
+            # of a rule share ONE kernel specialisation
+            shard_metas: list[list] = []
+            for lo, hi in ranges:
+                if hi <= lo:
+                    shard_metas.append([])
+                    continue
+                pc_rel = rp.pc[lo : hi + 1] - rp.pc[lo]
+                shard_metas.append(
+                    _unit_batch_meta(pc_rel, int(pc_rel[-1]), rule_bs)
+                )
+            kpad_rule = max(
+                (m[0][2].shape[0] - 2 for m in shard_metas if m), default=0
+            )
+            for s_idx, (lo, hi) in enumerate(ranges):
+                if shard_metas[s_idx] and (
+                    shard_metas[s_idx][0][2].shape[0] - 2 < kpad_rule
+                ):
+                    pc_rel = rp.pc[lo : hi + 1] - rp.pc[lo]
+                    shard_metas[s_idx] = _unit_batch_meta(
+                        pc_rel, int(pc_rel[-1]), rule_bs, kpad_min=kpad_rule
+                    )
+            pos_rule = pos_cache.get(rule_bs)
+            if pos_rule is None:
+                if mesh is not None:
+                    pos_rule = jax.device_put(
+                        np.arange(rule_bs, dtype=np.int32), shard_s
+                    )
+                else:
+                    pos_rule = jnp.arange(rule_bs, dtype=jnp.int32)
+                pos_cache[rule_bs] = pos_rule
+            order_dev = put(rp.order)
+            units_dev = tuple(put(a) for a in (rp.ua, rp.la, rp.ub, rp.lb))
+            kkey = (
+                r, rule_bs, None if mesh is None else id(mesh), compact_dev,
+            )
+            fn = plan.kernel_cache.get(kkey)
+            if fn is None:
+                fn = plan.kernel_cache[kkey] = make_pair_emit_fn(
+                    rule_bs,
+                    n_prev=r,
+                    has_uid_mask=plan.uid_codes is not None,
+                    rank_filter=rp.rank_filter,
+                    own_res=rp.residual_fn,
+                    prev_res=tuple(p.residual_fn for p in plan.rules[:r]),
+                    mesh=mesh,
+                    compact=compact_dev,
+                )
+            for s_idx, (lo, _hi) in enumerate(ranges):
+                if shard_filter is not None and (
+                    s_idx % shard_filter[1] != shard_filter[0]
+                ):
+                    continue
+                for k, (_p0, p1, meta) in enumerate(shard_metas[s_idx]):
+                    if store.segment_done(r, s_idx, k):
+                        stats["skipped"] += 1
+                        continue
+                    if budget is not None:
+                        # budget runs drain sequentially: the stop decision
+                        # must depend only on COMMITTED pair counts, or a
+                        # resumed run (which sees committed counts, not
+                        # optimistic in-flight ones) would dispatch a
+                        # different segment set than the uninterrupted one
+                        while inflight:
+                            drain_one()
+                        if emitted >= budget:
+                            stats["exhausted"] = True
+                            raise StopIteration
+                    meta = meta.copy()
+                    meta[0] += lo  # shard units index the FULL unit tables
+                    meta_dev = put(meta)
+                    out_i, out_j, keep = fn(
+                        pos_rule, order_dev, *units_dev, ranks_dev,
+                        codes_l_dev, codes_r_dev, uid_dev, res_ops_dev,
+                        meta_dev,
+                    )
+                    dig = None
+                    if keep is not None:
+                        # compact layout passes positions (the count rides
+                        # as out_i's last lane); uncompacted passes the
+                        # keep mask directly
+                        dig = (
+                            digest_fn(out_i, out_j, pos_rule)
+                            if compact_dev
+                            else digest_fn(out_i, out_j, keep)
+                        )
+                    inflight.append(
+                        (r, s_idx, k,
+                         pool.submit(fetch, out_i, out_j, keep, p1 - _p0, dig))
+                    )
+                    while len(inflight) > _D2H_DEPTH:
+                        drain_one()
+        while inflight:
+            drain_one()
+    except StopIteration:
+        while inflight:
+            drain_one()
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+        try:
+            elapsed = max(_time.perf_counter() - t_start, 1e-9)
+            publish(
+                "blocking_spill",
+                rules=len(plan.rules),
+                shards=n_shards,
+                segments=stats["segments"],
+                skipped=stats["skipped"],
+                pairs=stats["pairs"],
+                pairs_per_sec=round(stats["pairs"] / elapsed),
+                chunk_budget=batch_size,
+                budget=budget,
+                exhausted=stats["exhausted"],
+                elapsed_s=round(elapsed, 4),
+            )
+        except Exception as e:  # noqa: BLE001 - telemetry must never break emission
+            logger.debug("blocking_spill telemetry publish failed: %s", e)
+    return stats
+
+
+def spill_block_rules(
+    settings: dict,
+    table: EncodedTable,
+    n_left: int | None,
+    build_dir: str,
+    budget: int | None = None,
+):
+    """The build_spill_dir write path: sharded resumable emission into
+    ``<build_dir>/pairs``, returning a durable store-backed PairIndex — or
+    None when the job's rule shapes need the host join (the caller falls
+    back to the ordinary, non-resumable path with its own warning).
+
+    A store already finalized for this exact job returns instantly (the
+    idempotent-restart property a relaunch-loop harness needs); a
+    half-built one resumes from its last committed segment.
+    """
+    import os
+
+    from .parallel.mesh import mesh_from_settings
+    from .resilience.checkpoint import settings_state_hash
+    from .spill import PairSpillStore
+
+    try:
+        plan = build_device_plan(settings, table, n_left)
+    except Exception as e:  # noqa: BLE001 - never lose a run to the new tier
+        logger.warning(
+            "spill emission plan build failed (%s: %s); falling back to "
+            "the non-resumable blocking path", type(e).__name__, e,
+        )
+        return None
+    if plan is None:
+        return None
+    from .blocking import _idx_dtype
+
+    import jax
+
+    from .parallel.distributed import distributed_is_initialized
+
+    p_idx, p_cnt = 0, 1
+    if distributed_is_initialized():
+        p_idx, p_cnt = jax.process_index(), jax.process_count()
+    mesh = mesh_from_settings(settings)
+    n_shards = int(settings.get("emit_shard_chunks") or 0)
+    if n_shards <= 0:
+        n_shards = (mesh.devices.size if mesh is not None else 1) * p_cnt
+    n_shards = max(n_shards, p_cnt)
+    batch = int(settings.get("blocking_chunk_pairs") or DEFAULT_CHUNK_PAIRS)
+    state_hash = settings_state_hash(
+        settings, extra={"artifact": "pair_spill", "n_rows": int(table.n_rows)}
+    )
+    meta = {
+        "state_hash": state_hash,
+        "n_shards": n_shards,
+        "chunk_pairs": batch,
+        "budget": budget,
+        "process_index": p_idx,
+        "process_count": p_cnt,
+        "rule_totals": [int(rp.total) for rp in plan.rules],
+    }
+    store = PairSpillStore.attach(
+        os.path.join(build_dir, "pairs"), _idx_dtype(table.n_rows), meta
+    )
+    if store.completed:
+        logger.info(
+            "spill store at %s already finalized (%d pairs); reusing",
+            store.directory, store.total_pairs,
+        )
+        return store.as_pair_index()
+    with store:
+        stats = emit_pairs_sharded(
+            plan, store, batch, n_shards=n_shards, mesh=mesh,
+            budget=budget,
+            shard_filter=None if p_cnt == 1 else (p_idx, p_cnt),
+        )
+    store.finalize(exhausted=stats["exhausted"])
+    logger.info(
+        "spill emission: %d pairs in %d segments (%d resumed) at %s",
+        store.total_pairs, len(store.segments), stats["skipped"],
+        store.directory,
+    )
+    return store.as_pair_index()
 
 
 # --------------------------------------------------------------------------

@@ -97,6 +97,44 @@ class EncodedTable:
             cache[name] = (ranks, vocab)
         return cache[name]
 
+    def slice_rows(self, start: int, stop: int) -> "EncodedTable":
+        """A shallow row-window view [start, stop) of every encoded column.
+
+        Column-level metadata (widths, ascii/wide kinds, token-id
+        vocabularies) is row-independent, so packing a window through
+        ``gammas.pack_table`` yields exactly the corresponding rows of the
+        full table's packed matrix — the property the out-of-core index
+        build relies on to stream the reference matrix to disk chunk by
+        chunk with an O(chunk) working set instead of materialising all
+        ``n_rows x n_lanes`` at once. Slices are numpy views: no column
+        data is copied."""
+        sl = slice(start, stop)
+        out = EncodedTable(
+            n_rows=len(self.unique_id[sl]),
+            unique_id=self.unique_id[sl],
+            source_table=(
+                None if self.source_table is None else self.source_table[sl]
+            ),
+        )
+        for name, sc in self.strings.items():
+            out.strings[name] = EncodedStringColumn(
+                bytes_=sc.bytes_[sl],
+                lengths=sc.lengths[sl],
+                token_ids=sc.token_ids[sl],
+                null_mask=sc.null_mask[sl],
+                values=sc.values[sl],
+                width=sc.width,
+            )
+        for name, nc in self.numerics.items():
+            out.numerics[name] = EncodedNumericColumn(
+                values_f64=nc.values_f64[sl],
+                null_mask=nc.null_mask[sl],
+                values=nc.values[sl],
+            )
+        for name, vals in self.raw.items():
+            out.raw[name] = vals[sl]
+        return out
+
 
 def _to_object_array(values) -> np.ndarray:
     import pandas as pd

@@ -1156,42 +1156,16 @@ class GammaProgram:
         if n == 0:
             host = np.zeros((0, self.n_cols), np.int8)
             return host, (jnp.asarray(host) if keep_device else None)
-        batch_size = min(batch_size, max(n, 1))
         out = np.empty((n, self.n_cols), np.int8)
         device_batches = []
-        # Double-buffered: batch k+1 is dispatched before batch k's result is
-        # pulled to the host, so device compute overlaps the D2H transfer
-        # (JAX dispatch is async; np.asarray is the only sync point). The
-        # flagged kernel carries the two-phase overflow flag as an extra G
-        # row ([-1, 0]); a flagged batch is redone through the exact twin at
-        # its read point, before anything consumes it.
-        pending = None  # (start, stop, device result, bl, br)
-
-        def read_pending(pending):
-            ps, pe, pG, pbl, pbr = pending
-            arr = np.asarray(pG)
-            if arr[-1, 0]:
-                pG = self._gamma_batch_flagged_exact(
-                    jnp.asarray(pbl), jnp.asarray(pbr)
-                )
-                arr = np.asarray(pG)
-            out[ps:pe] = arr[: pe - ps]
+        pos = 0
+        for arr, pG, valid in self._iter_gamma_batches(
+            idx_l, idx_r, batch_size
+        ):
+            out[pos : pos + valid] = arr
             if keep_device:
-                device_batches.append(pG[: pe - ps])
-
-        for start in range(0, n, batch_size):
-            stop = min(start + batch_size, n)
-            bl = idx_l[start:stop]
-            br = idx_r[start:stop]
-            if stop - start < batch_size:
-                pad = batch_size - (stop - start)
-                bl = np.concatenate([bl, np.zeros(pad, bl.dtype)])
-                br = np.concatenate([br, np.zeros(pad, br.dtype)])
-            G = self._gamma_batch_flagged(jnp.asarray(bl), jnp.asarray(br))
-            if pending is not None:
-                read_pending(pending)
-            pending = (start, stop, G, bl, br)
-        read_pending(pending)
+                device_batches.append(pG[:valid])
+            pos += valid
         dev = None
         if keep_device:
             dev = (
@@ -1200,6 +1174,75 @@ class GammaProgram:
                 else jnp.concatenate(device_batches)
             )
         return out, dev
+
+    def _iter_gamma_batches(
+        self, idx_l: np.ndarray, idx_r: np.ndarray, batch_size: int
+    ):
+        """The ONE batched gamma loop, yielding ``(host_rows, device_G,
+        valid)`` per ``batch_size`` batch (host_rows already sliced to the
+        valid count; device_G still padded — consumers slice only if they
+        keep it, so the lazy device slice is never dispatched for nothing).
+
+        Double-buffered: batch k+1 is dispatched before batch k's result is
+        pulled to the host, so device compute overlaps the D2H transfer
+        (JAX dispatch is async; np.asarray is the only sync point). The
+        flagged kernel carries the two-phase overflow flag as an extra G
+        row ([-1, 0]); a flagged batch is redone through the exact twin at
+        its read point, before anything consumes it. Shared by
+        :meth:`compute_with_device` (resident G) and
+        :meth:`iter_gamma_chunks` (the spill-fed stream) — their
+        bit-identity contract is this single implementation.
+        """
+        n = len(idx_l)
+        batch_size = min(batch_size, max(n, 1))
+        pending = None  # (rows_in_batch, device result, bl, br)
+
+        def read_pending(pending):
+            valid, pG, pbl, pbr = pending
+            arr = np.asarray(pG)
+            if arr[-1, 0]:
+                pG = self._gamma_batch_flagged_exact(
+                    jnp.asarray(pbl), jnp.asarray(pbr)
+                )
+                arr = np.asarray(pG)
+            return arr[:valid], pG, valid
+
+        for start in range(0, n, batch_size):
+            stop = min(start + batch_size, n)
+            bl = np.asarray(idx_l[start:stop])
+            br = np.asarray(idx_r[start:stop])
+            if stop - start < batch_size:
+                pad = batch_size - (stop - start)
+                bl = np.concatenate([bl, np.zeros(pad, bl.dtype)])
+                br = np.concatenate([br, np.zeros(pad, br.dtype)])
+            G = self._gamma_batch_flagged(jnp.asarray(bl), jnp.asarray(br))
+            if pending is not None:
+                yield read_pending(pending)
+            pending = (stop - start, G, bl, br)
+        yield read_pending(pending)
+
+    def iter_gamma_chunks(
+        self,
+        idx_l: np.ndarray,
+        idx_r: np.ndarray,
+        batch_size: int = DEFAULT_PAIR_BATCH,
+    ):
+        """Yield host gamma blocks of ``batch_size`` pairs — the bounded-
+        working-set twin of :meth:`compute_with_device` for consumers that
+        must never hold the full G (the spill-fed streamed EM: at billions
+        of pairs even int8 G is tens of GB of host RAM). Both ride the
+        SAME :meth:`_iter_gamma_batches` loop, so the yielded blocks
+        concatenate to exactly the matrix ``compute_with_device`` returns —
+        batch boundaries at multiples of ``batch_size`` from the slice
+        start, which is what keeps a spill-streamed EM trajectory
+        bit-identical to the resident streamed one. ``idx_l`` / ``idx_r``
+        may be memmaps; each slice is read once per pass."""
+        if len(idx_l) == 0:
+            return
+        for arr, _pG, _valid in self._iter_gamma_batches(
+            idx_l, idx_r, batch_size
+        ):
+            yield arr
 
 
 class _StreamBatcher:

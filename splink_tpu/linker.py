@@ -418,6 +418,56 @@ class Splink:
     def _ensure_pairs(self) -> PairIndex:
         if self._pairs is None:
             table = self._ensure_encoded()
+            build_dir = self.settings.get("build_spill_dir") or None
+            if build_dir and self.settings.get("approx_blocking"):
+                # the spill driver emits EXACT-rule pairs only; when the
+                # approximate LSH tier can actually run, taking it would
+                # silently drop every approx pair — the recall feature the
+                # setting opts into (the same hazard gate _virtual_plan
+                # applies to the virtual pair index)
+                from .approx.lsh import approx_columns
+
+                if approx_columns(self.settings, table):
+                    from .utils.logging_utils import warn_degraded
+
+                    warn_degraded(
+                        "spill_blocking", "host_blocking",
+                        "approx_blocking needs materialised blocking (the "
+                        "spill emission driver has no approximate tier)",
+                    )
+                    build_dir = None
+            if build_dir:
+                # The durable write path (docs/blocking.md#offline-scale):
+                # sharded, manifest-committed, RESUMABLE emission into the
+                # caller-owned spill store. Overlap scoring is off here by
+                # design — a resumed build skips committed segments, so no
+                # per-chunk consumer can be fed consistently; the streamed
+                # EM consumes the manifest afterwards instead.
+                from .blocking_device import spill_block_rules
+                from .parallel.distributed import spill_shard_dir
+
+                with self._stage("blocking"):
+                    pairs = spill_block_rules(
+                        self.settings, table, self._n_left,
+                        spill_shard_dir(build_dir),
+                    )
+                if pairs is not None:
+                    self._pairs = pairs
+                    logger.info(
+                        "blocking produced %d candidate pairs (spill store)",
+                        pairs.n_pairs,
+                    )
+                    self._obs.count("pairs_blocked", int(pairs.n_pairs))
+                    from .blocking import clear_key_code_cache
+
+                    clear_key_code_cache(table)
+                    return self._pairs
+                from .utils.logging_utils import warn_degraded
+
+                warn_degraded(
+                    "spill_blocking", "host_blocking",
+                    "rule shapes unsupported by the device emission plan",
+                )
             stream = self._overlap_stream(table)
             with self._stage("blocking"):
                 self._pairs = block_using_rules(
@@ -511,6 +561,21 @@ class Splink:
         if self._G is None:
             table = self._ensure_encoded()
             pairs = self._ensure_pairs()  # overlap may set _G or _P here
+            if self._multihost_spill_store(pairs) is not None:
+                # this process's store holds ONLY its shard subset — a
+                # gamma matrix over it would feed scoring/EM paths that
+                # assume the FULL pair set, silently producing divergent
+                # parameters or subset-only output frames per controller.
+                # Training is supported (estimate_parameters routes to the
+                # manifest-fed streamed EM with cross-process reduction);
+                # scoring output is a single-controller operation.
+                raise RuntimeError(
+                    "this pair index is a per-process spill shard subset "
+                    "(multi-controller emission): scoring APIs need the "
+                    "full pair set and are single-controller — train with "
+                    "estimate_parameters here, then score in a "
+                    "single-process run over the saved model"
+                )
             if self._G is not None:
                 return self._G
             if self._P is not None:
@@ -638,7 +703,28 @@ class Splink:
         if not self._pattern_capable():
             return False
         pairs = self._ensure_pairs()
+        if self._multihost_spill_store(pairs) is not None:
+            # a per-process spill store's n_pairs is LOCAL and differs per
+            # controller — a count-dependent regime choice here could put
+            # controllers on different EM paths (one in a collective, one
+            # not: deadlock). The manifest-fed streamed driver is the one
+            # multi-controller-correct path for these stores, so the
+            # decision is pinned deterministically (process_count is
+            # identical in every store's meta).
+            return False
         return pairs.n_pairs > int(self.settings["max_resident_pairs"])
+
+    @staticmethod
+    def _multihost_spill_store(pairs):
+        """The pair index's spill store when it was written under
+        MULTI-CONTROLLER emission (and therefore holds only this
+        process's shard subset) — None otherwise."""
+        store = getattr(pairs, "spill_store", None)
+        if store is not None and (
+            int(store.meta.get("process_count", 1) or 1) > 1
+        ):
+            return store
+        return None
 
     def _pattern_mesh(self):
         """The mesh pattern passes shard over: the configured mesh on a
@@ -1130,9 +1216,31 @@ class Splink:
             if self._use_pattern_pipeline():
                 self._run_em_patterns(compute_ll)
             else:
-                G = self._ensure_gammas()
-                self._run_em(G, compute_ll)
-                self._G_dev = None
+                pairs = self._ensure_pairs()
+                store = getattr(pairs, "spill_store", None)
+                # A store written under multi-controller emission holds
+                # only THIS process's shard subset, so the manifest-fed
+                # driver (whose cross-process stats reduction forms the
+                # global aggregate) is the ONLY correct EM path for it —
+                # and the branch must not depend on the LOCAL pair count,
+                # which differs per process and would split controllers
+                # across collective/non-collective regimes (deadlock) or
+                # train each on its own subset without reduction.
+                # process_count is identical in every per-process store's
+                # meta, so this decision is globally consistent.
+                if store is not None and (
+                    self._multihost_spill_store(pairs) is not None
+                    or pairs.n_pairs
+                    > int(self.settings["max_resident_pairs"])
+                ):
+                    # spill-store-backed pairs past the resident cap: EM
+                    # consumes the manifest directly — gammas per chunk on
+                    # device, never rematerialised host-side
+                    self._run_em_streamed_spill(pairs, compute_ll)
+                else:
+                    G = self._ensure_gammas()
+                    self._run_em(G, compute_ll)
+                    self._G_dev = None
         finally:
             self._ckpt_dir_arg = None
             self._ckpt_resume = False
@@ -1403,6 +1511,74 @@ class Splink:
         import jax
 
         from .parallel.distributed import global_pair_slice
+
+        if jax.process_count() > 1:
+            G = G[global_pair_slice(len(G))]
+        batch = int(self.settings["pair_batch_size"])
+
+        def batches():
+            for s in range(0, len(G), batch):
+                yield G[s : s + batch]
+
+        self._run_em_streamed_driver(batches, compute_ll)
+
+    def _run_em_streamed_spill(self, pairs: PairIndex, compute_ll: bool) -> None:
+        """Manifest-fed streamed EM: the spill store IS the pair stream.
+
+        Each EM pass walks the committed pair range of the store's memmaps
+        in ``pair_batch_size`` slices, computes that slice's gamma block on
+        device (GammaProgram.iter_gamma_chunks — same batching, padding
+        and overflow semantics as the resident paths) and feeds it to
+        run_em_streamed. The gamma matrix NEVER materialises on the host:
+        at billions of pairs even the int8 G is tens of GB, which is what
+        capped the old write path. Multi-controller runs stream only their
+        global_pair_slice of the manifest and reduce stats with
+        all_sum_stats, exactly like the materialised path. Trajectory is
+        bit-identical to a (hypothetical) resident streamed run over the
+        same pair order — batch boundaries match by construction."""
+        import jax
+
+        from .parallel.distributed import global_pair_slice
+        from .spill import iter_spill_gamma_batches
+
+        store = pairs.spill_store
+        program = GammaProgram(
+            self.settings, self._ensure_encoded(),
+            float_dtype=self._float_dtype,
+        )
+        batch = int(self.settings["pair_batch_size"])
+        pair_range = None
+        if (
+            jax.process_count() > 1
+            and int(store.meta.get("process_count", 1) or 1) == 1
+        ):
+            # a SHARED single-writer store consumed by many controllers
+            # slices like a materialised G; a per-process store (written
+            # under multi-controller emission) already holds only this
+            # host's shard subset — streaming it whole IS the local slice
+            pair_range = global_pair_slice(store.total_pairs)
+
+        def batches():
+            return iter_spill_gamma_batches(
+                store, program, batch, pair_range=pair_range
+            )
+
+        self._obs.count("pairs_gamma_scored", int(store.total_pairs))
+        self._last_em_result = None
+        logger.info(
+            "spill-fed streamed EM over %d pairs (%d manifest segments)",
+            store.total_pairs, len(store.segments),
+        )
+        self._run_em_streamed_driver(batches, compute_ll)
+        self._emit_em_diagnostics(None)
+
+    def _run_em_streamed_driver(self, batches, compute_ll: bool) -> None:
+        """The shared streamed-EM driver: checkpoint/resume plumbing,
+        telemetry and the run_em_streamed call over any re-iterable batch
+        factory — the materialised G path and the spill-manifest path
+        differ ONLY in where their gamma batches come from."""
+        import jax
+
         from .parallel.streaming import run_em_streamed
         from .resilience import RetryPolicy, active_plan
         from .resilience.checkpoint import EMCheckpointer
@@ -1410,16 +1586,15 @@ class Splink:
         dtype = self._float_dtype
         lam0, m0, u0, _ = self.params.to_arrays(dtype=dtype)
         init = FSParams(lam=jnp.asarray(lam0), m=jnp.asarray(m0), u=jnp.asarray(u0))
-        batch = int(self.settings["pair_batch_size"])
         mesh = mesh_from_settings(self.settings)
         stats_reduce = None
         if jax.process_count() > 1:
             from .parallel.distributed import all_sum_stats
 
-            G = G[global_pair_slice(len(G))]
             # host-local mesh shardings don't span controllers; the
             # explicit cross-process reduction is what makes each host's
-            # partial stats a global aggregate
+            # partial stats a global aggregate (the caller already
+            # restricted its stream to this host's global_pair_slice)
             mesh = None
             stats_reduce = all_sum_stats
 
@@ -1468,10 +1643,6 @@ class Splink:
                 return
 
         tel = self._obs if self._obs.enabled else None
-
-        def batches():
-            for s in range(0, len(G), batch):
-                yield G[s : s + batch]
 
         def on_iteration(it, params_dev, ll, converged_now=False):
             if compute_ll and ll is not None:

@@ -177,6 +177,22 @@ def summarize_events(events: list[dict]) -> str:
                     f"pairs in {rr.get('chunks')} chunk(s)"
                 )
 
+    # ---- sharded spill-emission telemetry --------------------------------
+    spill = [e for e in events if e.get("type") == "blocking_spill"]
+    if spill:
+        lines.append("")
+        lines.append(f"spill emission: {len(spill)} run(s)")
+        for ev in spill:
+            # torn/old records may miss fields: render 0, never crash
+            lines.append(
+                f"  pairs={ev.get('pairs') or 0:,} "
+                f"segments={ev.get('segments') or 0} "
+                f"shards={ev.get('shards') or 0} "
+                f"resumed={ev.get('skipped') or 0} "
+                f"pairs/s={ev.get('pairs_per_sec') or 0:,}"
+                + (" [budget exhausted]" if ev.get("exhausted") else "")
+            )
+
     # ---- approximate-blocking telemetry ----------------------------------
     approx = [e for e in events if e.get("type") == "blocking_approx"]
     if approx:

@@ -747,13 +747,20 @@ def _uid_mask_codes(table: EncodedTable, link_type: str) -> np.ndarray | None:
     return uid_codes.astype(np.int32)
 
 
-def _unit_batch_meta(pc: np.ndarray, total: int, rule_bs: int):
+def _unit_batch_meta(pc: np.ndarray, total: int, rule_bs: int,
+                     kpad_min: int = 0):
     """One metadata row [u0, valid, pc_rel...] per batch of ``rule_bs``
     positions, padded to ONE power-of-two kpad for the whole rule (one
     kernel specialisation per rule). pc_rel entries past the last unit
     (and padding) are int32 max and fall out of the unit lookup; the int32
     clip cannot corrupt in-batch positions because the driver already
-    clamped the batch size below 2^31 - chunk^2."""
+    clamped the batch size below 2^31 - chunk^2.
+
+    ``kpad_min`` floors the pad width: the SHARDED emission driver splits a
+    rule's units across shards whose natural kpads can differ, and the
+    meta row's length is part of the kernel's compiled shape — flooring
+    every shard at the rule-wide maximum keeps all of a rule's segments on
+    ONE specialisation (the zero-steady-state-recompiles contract)."""
     starts = list(range(0, total, rule_bs))
     u0s, u1s = [], []
     for p0 in starts:
@@ -762,6 +769,7 @@ def _unit_batch_meta(pc: np.ndarray, total: int, rule_bs: int):
         u1s.append(int(np.searchsorted(pc, p1 - 1, side="right")) - 1)
     kmax = max(u1 - u0 + 2 for u0, u1 in zip(u0s, u1s))
     kpad = 1 << int(max(kmax, 2) - 1).bit_length()
+    kpad = max(kpad, int(kpad_min))
     imax = np.iinfo(np.int32).max
     out = []
     for b, p0 in enumerate(starts):

@@ -187,6 +187,21 @@ def host_tags() -> dict:
     }
 
 
+def spill_shard_dir(base: str) -> str:
+    """Per-controller root for the durable spill write path: under
+    multi-controller each process emits ITS shard subset of the pair
+    stream into its own ``<base>/proc<k>`` store (single-writer manifests
+    — the same discipline as the checkpoint writer), while a
+    single-process run uses ``base`` directly so the common case has no
+    extra directory level. ``base`` must be shared storage when the
+    consuming EM later runs with a different controller layout."""
+    import os
+
+    if not distributed_is_initialized():
+        return base
+    return os.path.join(base, f"proc{jax.process_index()}")
+
+
 def global_pair_slice(n_pairs_global: int) -> slice:
     """The half-open range of global pair indices this host is responsible
     for feeding. Hosts stream disjoint slices; the psum in the EM stats makes

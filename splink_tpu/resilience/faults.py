@@ -48,6 +48,20 @@ Serve-path fault sites (SERVE_SITES; exercised end to end by
     swap_validate   QueryEngine.swap_index, before the parity-probe
                     replay commits — a raise rolls the swap back with the
                     old index still serving
+
+Offline write-path sites (BUILD_SITES; the kill-and-resume contract of
+the billion-row build — tests/test_spill_resume.py, ``make scale-smoke``):
+
+    emit_segment    sharded spill emission (blocking_device.
+                    emit_pairs_sharded), fired AFTER a segment's bytes are
+                    appended + fsynced but BEFORE its manifest commit —
+                    the widest window a kill can tear; a resumed driver
+                    truncates the torn tail and re-emits the segment
+                    byte-identically (coords: rule, shard, seq)
+    build_chunk     out-of-core packed-matrix writer (serve/index.
+                    _pack_table_out_of_core), fired between a chunk's
+                    byte append and its build_state.json watermark commit
+                    (coords: chunk)
 """
 
 from __future__ import annotations
@@ -68,6 +82,11 @@ DEFAULT_SLOW_DELAY_MS = 250
 # The serve-path injection points (documented above); chaos_smoke drives
 # every one of them and asserts the service-level recovery contract.
 SERVE_SITES = ("serve_worker", "serve_batch", "swap_load", "swap_validate")
+
+# The offline write-path injection points (documented above); the
+# kill-and-resume tests and scale_smoke aim these at the commit windows of
+# the spill emission driver and the out-of-core index build.
+BUILD_SITES = ("emit_segment", "build_chunk")
 
 
 class InjectedFault(RuntimeError):

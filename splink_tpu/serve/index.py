@@ -60,6 +60,7 @@ from ..gammas import (
 from ..resilience.checkpoint import (
     atomic_write_bytes,
     atomic_write_json,
+    fsync_dir,
     settings_state_hash,
 )
 
@@ -68,6 +69,39 @@ logger = logging.getLogger("splink_tpu")
 INDEX_VERSION = 1
 META_NAME = "linkage_index.json"
 ARRAYS_STEM = "linkage_index"  # arrays live at <stem>-<sha16>.npz
+
+BUILD_STATE_NAME = "build_state.json"
+BUILD_STATE_VERSION = 1
+
+# row chunk for hashing / streaming large arrays: big enough that per-chunk
+# python overhead vanishes, small enough that the transient contiguous copy
+# stays tens of MB
+_HASH_CHUNK_ROWS = 1 << 18
+
+
+def _hash_update_array(h, arr: np.ndarray, chunk_rows: int = _HASH_CHUNK_ROWS):
+    """h.update() over an array's bytes in row chunks. Byte-identical to
+    ``h.update(np.ascontiguousarray(arr).tobytes())`` — row-chunk bytes of
+    a row-major array concatenate to the whole-array bytes — WITHOUT the
+    full-size contiguous copy that call materialises: the out-of-core
+    build hands content_fingerprint a disk-backed packed matrix, and the
+    fingerprint walk must not be the step that re-materialises it in
+    host RAM."""
+    if arr.ndim == 0 or len(arr) == 0:
+        h.update(np.ascontiguousarray(arr).tobytes())
+        return
+    for s in range(0, len(arr), chunk_rows):
+        h.update(np.ascontiguousarray(arr[s : s + chunk_rows]).tobytes())
+    # drop the pages a memmapped source just faulted in: the hash walk is
+    # one sequential pass and must not leave the whole file resident
+    mm = getattr(arr, "_mmap", None)
+    if mm is not None:
+        try:
+            import mmap as _mmap
+
+            mm.madvise(_mmap.MADV_DONTNEED)
+        except (AttributeError, ValueError, OSError):
+            pass
 
 # canonical-key-token type tags (see _canon_token)
 _KEY_SEP = "\x1f"
@@ -424,10 +458,14 @@ class LinkageIndex:
             h = hashlib.sha256()
             h.update(self.state_hash.encode())
             h.update(self.dtype.encode())
-            h.update(np.ascontiguousarray(self.packed).tobytes())
+            # row-chunked: the packed matrix may be a disk-backed memmap
+            # (out-of-core build) whose whole-array tobytes() would
+            # re-materialise exactly the footprint the build avoided;
+            # digest is byte-identical to the one-shot form
+            _hash_update_array(h, self.packed)
             for r in self.rules:
                 for a in (r.rows_sorted, r.starts, r.sizes, r.row_bucket):
-                    h.update(np.ascontiguousarray(a).tobytes())
+                    _hash_update_array(h, a)
             if self.approx is not None:
                 # approx config + band CSRs change the compiled gather
                 # menu, so they are part of the executable-binding
@@ -721,7 +759,6 @@ class LinkageIndex:
         path."""
         directory = os.fspath(directory)
         os.makedirs(directory, exist_ok=True)
-        buf = io.BytesIO()
         arrays = {"packed": self.packed}
         for r, rule in enumerate(self.rules):
             arrays[f"rule{r}_rows"] = rule.rows_sorted
@@ -753,11 +790,23 @@ class LinkageIndex:
             )
         if self.unique_id.dtype != object:
             arrays["unique_id"] = self.unique_id
-        np.savez_compressed(buf, **arrays)
-        payload = buf.getvalue()
-        fingerprint = hashlib.sha256(payload).hexdigest()
-        arrays_file = f"{ARRAYS_STEM}-{fingerprint[:16]}.npz"
-        atomic_write_bytes(os.path.join(directory, arrays_file), payload)
+        if any(isinstance(a, np.memmap) for a in arrays.values()):
+            # out-of-core artifact: the npz streams straight to a temp
+            # file in the target directory (numpy writes each array
+            # through the zip stream — never the whole payload in RAM),
+            # the fingerprint comes from a chunked re-read, and os.replace
+            # commits under the fingerprint-derived name exactly like the
+            # resident path
+            arrays_file, fingerprint = self._save_arrays_streaming(
+                directory, arrays
+            )
+        else:
+            buf = io.BytesIO()
+            np.savez_compressed(buf, **arrays)
+            payload = buf.getvalue()
+            fingerprint = hashlib.sha256(payload).hexdigest()
+            arrays_file = f"{ARRAYS_STEM}-{fingerprint[:16]}.npz"
+            atomic_write_bytes(os.path.join(directory, arrays_file), payload)
         from ..params import _jsonable_settings
 
         meta = {
@@ -827,6 +876,42 @@ class LinkageIndex:
             directory, self.n_rows, len(self.rules), self.n_lanes,
         )
         return path
+
+    @staticmethod
+    def _save_arrays_streaming(directory: str, arrays: dict):
+        """Write the arrays npz without ever holding the payload in RAM:
+        temp file in the target directory, fsync, chunked sha256 of the
+        file bytes, then os.replace under the fingerprint-derived name
+        (the same crash-safety shape as atomic_write_bytes). Returns
+        (arrays_file, fingerprint)."""
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(
+            prefix=ARRAYS_STEM + ".", suffix=".tmp", dir=directory
+        )
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                np.savez_compressed(fh, **arrays)
+                fh.flush()
+                os.fsync(fh.fileno())
+            h = hashlib.sha256()
+            with open(tmp, "rb") as fh:
+                while True:
+                    block = fh.read(1 << 22)
+                    if not block:
+                        break
+                    h.update(block)
+            fingerprint = h.hexdigest()
+            arrays_file = f"{ARRAYS_STEM}-{fingerprint[:16]}.npz"
+            os.replace(tmp, os.path.join(directory, arrays_file))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        fsync_dir(directory)
+        return arrays_file, fingerprint
 
 
 def load_index(directory: str | os.PathLike) -> LinkageIndex:
@@ -974,6 +1059,130 @@ def _string_vocab(sc: EncodedStringColumn) -> list[str]:
     return [v if v is not None else "" for v in vocab]
 
 
+def _pack_table_out_of_core(
+    table: EncodedTable,
+    float_dtype,
+    include,
+    qgram_specs,
+    charset_specs,
+    build_dir: str,
+    chunk_rows: int,
+    state_hash: str,
+    fault_plan=None,
+):
+    """Row-chunked, resumable pack_table: (packed memmap, layout).
+
+    The packed reference matrix is the dominant resident term of an index
+    build (n_rows x n_lanes x 4 bytes — at 100M rows of a 64-lane table,
+    ~26 GB). pack_table's lane LAYOUT depends only on column metadata, so
+    packing ``chunk_rows``-row windows (EncodedTable.slice_rows) produces
+    exactly the corresponding rows of the full matrix; each chunk streams
+    to ``<build_dir>/index_build/packed.bin`` with plain buffered writes
+    (no mapping — the written pages live in the kernel's evictable page
+    cache, not this process's anonymous RSS) and commits through an atomic
+    ``build_state.json`` watermark. A killed build resumes at the last
+    committed chunk; a state file from a different job/shape starts fresh.
+    Returns a read-only memmap over the finished file — bit-identical,
+    row for row, to what pack_table would have returned resident.
+    """
+    from ..resilience import faults as _faults
+    from ..resilience.checkpoint import atomic_write_json
+
+    if fault_plan is None:
+        fault_plan = _faults.active_plan()
+    out_dir = os.path.join(os.fspath(build_dir), "index_build")
+    os.makedirs(out_dir, exist_ok=True)
+    n = table.n_rows
+    chunk_rows = max(int(chunk_rows), 1)
+    # layout + lane count from a zero-row window — the same determinism
+    # _layout_rebuild_table already relies on for load-time rebuilds
+    probe, layout = pack_table(
+        table.slice_rows(0, 0),
+        float_dtype,
+        include=include,
+        qgram_specs=qgram_specs,
+        charset_specs=charset_specs,
+        jw_specs=(),
+    )
+    n_lanes = probe.shape[1]
+    data_path = os.path.join(out_dir, "packed.bin")
+    state_path = os.path.join(out_dir, BUILD_STATE_NAME)
+    want_state = {
+        "version": BUILD_STATE_VERSION,
+        "state_hash": state_hash,
+        "n_rows": int(n),
+        "n_lanes": int(n_lanes),
+        "chunk_rows": int(chunk_rows),
+        "dtype": "float64" if float_dtype == np.float64 else "float32",
+    }
+    chunks_done = 0
+    if os.path.exists(state_path) and os.path.exists(data_path):
+        try:
+            with open(state_path, encoding="utf-8") as fh:
+                st = json.load(fh)
+            if all(st.get(k) == v for k, v in want_state.items()):
+                chunks_done = int(st.get("chunks_done", 0))
+        except (OSError, json.JSONDecodeError, ValueError):
+            chunks_done = 0
+    n_chunks = -(-n // chunk_rows) if n else 0
+    chunks_done = min(chunks_done, n_chunks)
+    row_bytes = n_lanes * 4
+    watermark = min(chunks_done * chunk_rows, n) * row_bytes
+    if chunks_done:
+        try:
+            have = os.path.getsize(data_path)
+        except OSError:
+            have = -1
+        if have < watermark:
+            # data shorter than the committed watermark (partial copy of
+            # the build dir, bin replaced while the state file survived):
+            # truncate() below would silently ZERO-EXTEND the missing
+            # prefix into all-zero packed rows — start fresh instead (the
+            # spill store raises for the same condition; here a rebuild
+            # is cheap and always correct)
+            logger.warning(
+                "out-of-core build state at %s commits %d bytes but "
+                "packed.bin holds %d; discarding the stale watermark and "
+                "rebuilding from chunk 0", out_dir, watermark, have,
+            )
+            chunks_done = 0
+            watermark = 0
+    if chunks_done:
+        logger.info(
+            "out-of-core index build resumed at %s: %d/%d packed chunks "
+            "committed", out_dir, chunks_done, n_chunks,
+        )
+    with open(data_path, "r+b" if os.path.exists(data_path) else "w+b") as fh:
+        fh.truncate(watermark)  # drop any torn uncommitted tail
+        fh.seek(watermark)
+        for k in range(chunks_done, n_chunks):
+            s, e = k * chunk_rows, min((k + 1) * chunk_rows, n)
+            arr, _ = pack_table(
+                table.slice_rows(s, e),
+                float_dtype,
+                include=include,
+                qgram_specs=qgram_specs,
+                charset_specs=charset_specs,
+                jw_specs=(),
+            )
+            if arr.shape[1] != n_lanes:  # pragma: no cover - layout is static
+                raise ServeIndexError(
+                    f"chunk {k} packed {arr.shape[1]} lanes, layout probe "
+                    f"said {n_lanes}"
+                )
+            np.ascontiguousarray(arr).tofile(fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+            # the injection point sits between the byte append and the
+            # watermark commit — the widest window a kill can tear
+            fault_plan.fire("build_chunk", chunk=k)
+            atomic_write_json(state_path, {**want_state, "chunks_done": k + 1})
+    if n == 0:
+        return np.zeros((0, n_lanes), np.uint32), layout
+    packed = np.memmap(data_path, dtype=np.uint32, mode="r", shape=(n, n_lanes))
+    return packed, layout
+
+
 def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
     """Freeze a trained linker into a :class:`LinkageIndex`.
 
@@ -1002,15 +1211,46 @@ def build_index(linker, *, clear_caches: bool = True) -> LinkageIndex:
         float_dtype = jnp.float64 if dtype_np == np.float64 else jnp.float32
         lam, m, u, _ = linker.params.to_arrays(dtype=dtype_np)
 
-        packed, layout = pack_table(
-            table,
-            float_dtype,
-            include=comparison_columns_used(settings),
-            qgram_specs=qgram_specs_for(settings),
-            charset_specs=charset_specs_for(settings),
-            jw_specs=(),
-        )
         include = comparison_columns_used(settings)
+        build_dir = settings.get("build_spill_dir") or None
+        if build_dir:
+            # out-of-core: the packed matrix streams to disk chunk by
+            # chunk (bounded working set, resumable) and rides in the
+            # index as a read-only memmap — every downstream consumer
+            # (device_state upload, fingerprint, save) reads it the same.
+            # Per-process root under multi-controller (the pairs path's
+            # discipline): P processes must not race truncate/append on
+            # one packed.bin — each writes its own deterministic,
+            # fingerprint-identical copy instead.
+            from ..parallel.distributed import spill_shard_dir
+
+            packed, layout = _pack_table_out_of_core(
+                table,
+                float_dtype,
+                include=include,
+                qgram_specs=qgram_specs_for(settings),
+                charset_specs=charset_specs_for(settings),
+                build_dir=spill_shard_dir(build_dir),
+                chunk_rows=int(
+                    settings.get("build_spill_chunk_rows") or 1048576
+                ),
+                state_hash=settings_state_hash(
+                    settings,
+                    extra={
+                        "artifact": "index_build",
+                        "n_rows": int(table.n_rows),
+                    },
+                ),
+            )
+        else:
+            packed, layout = pack_table(
+                table,
+                float_dtype,
+                include=include,
+                qgram_specs=qgram_specs_for(settings),
+                charset_specs=charset_specs_for(settings),
+                jw_specs=(),
+            )
         string_cols = [
             n for n in table.strings if include is None or n in include
         ]

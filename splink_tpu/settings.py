@@ -63,6 +63,9 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "approx_pair_budget",
     "approx_tf_weighting",
     "spill_dir",
+    "build_spill_dir",
+    "build_spill_chunk_rows",
+    "emit_shard_chunks",
     "profile_dir",
     "telemetry_dir",
     "telemetry_memory",

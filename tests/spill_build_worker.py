@@ -1,0 +1,97 @@
+"""Subprocess driver for the spill-build kill-and-resume tests
+(tests/test_spill_resume.py) and ``make scale-smoke``.
+
+Runs the full offline write path — sharded spill emission into
+build_spill_dir, (spill-capable) EM, out-of-core index build — over a
+deterministic fixture corpus, then writes the index content fingerprint
+to the result path. The parent aims SPLINK_TPU_FAULTS at the emission /
+build commit windows (kind=kill), relaunches with the same build dir and
+asserts the resumed fingerprint is bit-identical to an uninterrupted
+run's.
+
+Usage: python spill_build_worker.py <result.json> <build_dir> <mesh_n>
+"""
+
+import json
+import os
+import sys
+
+# the script lives in tests/ — put the repo root (the package's parent) on
+# sys.path; running `python tests/spill_build_worker.py` puts only tests/
+# there
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# force the virtual-device CPU tier BEFORE jax imports (this process does
+# not load tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def main() -> int:
+    out_path, build_dir, mesh_n = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+    import numpy as np
+    import pandas as pd
+
+    from splink_tpu import Splink
+
+    rng = np.random.default_rng(42)
+    # > 2x build_spill_chunk_rows so the out-of-core packed build commits
+    # MULTIPLE chunks (the build_chunk fault site must have a chunk 1 to
+    # hit, and a resume must have a committed prefix to skip)
+    n = 2500
+    firsts = np.array(["amelia", "oliver", "isla", "george", "ava", "noah"])
+    lasts = np.array(["smith", "jones", "taylor", "brown", "wilson"])
+    df = pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "first_name": firsts[rng.integers(0, 6, n)],
+            "surname": lasts[rng.integers(0, 5, n)],
+            "city": [f"c{i % 4}" for i in range(n)],
+        }
+    )
+    settings = {
+        "link_type": "dedupe_only",
+        "blocking_rules": ["l.city = r.city", "l.surname = r.surname"],
+        "comparison_columns": [
+            {
+                "col_name": "first_name",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "max_iterations": 3,
+        "build_spill_dir": build_dir,
+        "build_spill_chunk_rows": 1024,
+        "emit_shard_chunks": 4,
+        "blocking_chunk_pairs": 65536,
+        "device_pair_generation": "off",  # materialise through the store
+        "mesh": {"data": mesh_n},
+    }
+    linker = Splink(settings, df=df)
+    linker.estimate_parameters()
+    index = linker.export_index()
+    json.dump(
+        {
+            "fingerprint": index.content_fingerprint(),
+            "n_pairs": int(linker._pairs.n_pairs),
+            "segments": len(linker._pairs.spill_store.segments),
+        },
+        open(out_path, "w"),
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -364,6 +364,149 @@ def test_auto_gate_uses_host_below_threshold(monkeypatch):
 
 
 # ----------------------------------------------------------------------
+# Sharded spill emission (the billion-row write path)
+# ----------------------------------------------------------------------
+
+
+def _emit_to_store(plan, tmp_path, name, n_shards, batch=128, mesh=None):
+    from splink_tpu.spill import PairSpillStore
+
+    store = PairSpillStore.attach(str(tmp_path / name), np.int32, {})
+    from splink_tpu.blocking_device import emit_pairs_sharded
+
+    with store:
+        emit_pairs_sharded(plan, store, batch, n_shards=n_shards, mesh=mesh)
+    store.finalize()
+    pi = store.as_pair_index()
+    return set(zip(pi.idx_l.tolist(), pi.idx_r.tolist()))
+
+
+@pytest.mark.parametrize("rules", DEDUPE_RULESETS)
+def test_sharded_emission_pair_set_parity(rules, tmp_path):
+    """ACCEPTANCE: the sharded spill emission's pair set exactly equals
+    the single-shard device tier's (and the host oracle's) on every rule
+    shape — shards partition units, never pairs."""
+    s = _settings(rules)
+    t = encode_table(_df(120, 3), s)
+    plan = build_device_plan(s, t)
+    assert plan is not None
+    single = {
+        (int(a), int(b))
+        for _r, i, j in iter_device_pairs(plan, 128)
+        for a, b in zip(i, j)
+    }
+    sharded = _emit_to_store(plan, tmp_path, "sharded", n_shards=3)
+    assert sharded == single
+    sh = dict(s)
+    sh["device_blocking"] = "off"
+    ph = block_using_rules(sh, t)
+    assert sharded == set(zip(ph.idx_l.tolist(), ph.idx_r.tolist()))
+    assert sharded, f"degenerate fixture: no pairs for {rules}"
+
+
+@pytest.mark.parametrize(
+    "rules",
+    [
+        ["l.first_name = r.first_name"],
+        ["l.first_name = r.surname"],
+        ["l.first_name = r.first_name", "l.surname = r.surname"],
+    ],
+)
+def test_sharded_emission_parity_link_only(rules, tmp_path):
+    s = _settings(rules, link_type="link_only")
+    t = concat_tables(_df(70, 5), _df(90, 6), s)
+    plan = build_device_plan(s, t, n_left=70)
+    assert plan is not None
+    sharded = _emit_to_store(plan, tmp_path, "link", n_shards=4, batch=64)
+    sh = dict(s)
+    sh["device_blocking"] = "off"
+    ph = block_using_rules(sh, t, 70)
+    assert sharded == set(zip(ph.idx_l.tolist(), ph.idx_r.tolist()))
+    assert sharded
+
+
+def test_sharded_emission_mesh_parity(tmp_path):
+    """Shard scheduling composes with the mesh decode: units partition
+    across shards AND each chunk's positions shard over the virtual
+    8-device mesh (block_pair_decode_sharded)."""
+    from splink_tpu.parallel.mesh import make_mesh
+
+    s = _settings(
+        ["l.first_name = r.first_name", "l.surname = r.surname"]
+    )
+    t = encode_table(_df(150, 23), s)
+    plan = build_device_plan(s, t)
+    sharded = _emit_to_store(
+        plan, tmp_path, "mesh", n_shards=4, batch=256, mesh=make_mesh(8)
+    )
+    sh = dict(s)
+    sh["device_blocking"] = "off"
+    ph = block_using_rules(sh, t)
+    assert sharded == set(zip(ph.idx_l.tolist(), ph.idx_r.tolist()))
+
+
+def test_sharded_emission_zero_steady_state_recompiles(tmp_path):
+    """ACCEPTANCE: across chunk shapes, shard switches AND spill segments,
+    a second drive of the same plan compiles nothing — shard metadata
+    rows are floored to the rule-wide kpad so every (rule, shard, seq)
+    shares one specialisation."""
+    from splink_tpu.blocking_device import emit_pairs_sharded
+    from splink_tpu.obs.metrics import (
+        compile_requests,
+        install_compile_monitor,
+    )
+    from splink_tpu.spill import PairSpillStore
+
+    install_compile_monitor()
+    s = _settings(["l.first_name = r.first_name", "l.surname = r.surname"])
+    t = encode_table(_df(250, 14), s)
+    plan = build_device_plan(s, t)
+    store1 = PairSpillStore.attach(str(tmp_path / "one"), np.int32, {})
+    with store1:
+        emit_pairs_sharded(plan, store1, 128, n_shards=3)
+    store1.finalize()
+    c0 = compile_requests()
+    store2 = PairSpillStore.attach(str(tmp_path / "two"), np.int32, {})
+    with store2:
+        emit_pairs_sharded(plan, store2, 128, n_shards=3)
+    store2.finalize()
+    c1 = compile_requests()
+    assert c1 == c0, f"{c1 - c0} steady-state recompiles across segments"
+    a = store1.as_pair_index()
+    b = store2.as_pair_index()
+    assert np.array_equal(a.idx_l, b.idx_l)
+    assert np.array_equal(a.idx_r, b.idx_r)
+
+
+def test_spill_block_rules_settings_shapes(tmp_path):
+    """emit_shard_chunks resolves the shard count; the host-only rule
+    shapes fall back (None) instead of half-building a store."""
+    from splink_tpu.blocking_device import spill_block_rules
+
+    s = _settings(
+        ["l.first_name = r.first_name"], emit_shard_chunks=2,
+        blocking_chunk_pairs=256,
+    )
+    t = encode_table(_df(120, 19), s)
+    pi = spill_block_rules(s, t, None, str(tmp_path / "ok"))
+    assert pi is not None
+    import json as _json
+    import os as _os
+
+    m = _json.load(
+        open(_os.path.join(str(tmp_path / "ok"), "pairs", "pair_manifest.json"))
+    )
+    assert m["meta"]["n_shards"] == 2
+    assert {seg["shard"] for seg in m["segments"]} <= {0, 1}
+    # cartesian rule: no device plan, caller falls back
+    s2 = _settings(["l.amount < r.amount"])
+    t2 = encode_table(
+        _df(25, 18).assign(amount=np.arange(25.0)), s2
+    )
+    assert spill_block_rules(s2, t2, None, str(tmp_path / "no")) is None
+
+
+# ----------------------------------------------------------------------
 # Serving bucket CSR
 # ----------------------------------------------------------------------
 
@@ -478,6 +621,89 @@ def test_bad_emit_twin_trips_ta_dtype():
     assert any(f.rule == "TA-DTYPE" for f in findings), [
         f.format() for f in findings
     ]
+
+
+def test_spill_digest_kernels_registered_and_clean():
+    from splink_tpu.analysis.shard_audit import run_shard_audit
+    from splink_tpu.analysis.trace_audit import run_audit
+
+    findings, audited = run_audit(
+        ["spill_chunk_digest", "spill_chunk_digest_compact"]
+    )
+    assert audited == 2
+    assert not findings, "\n".join(f.format() for f in findings)
+    findings, audited = run_shard_audit(["spill_chunk_digest_sharded"])
+    assert audited == 1
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_bad_digest_shard_twin_trips_sa_coll():
+    """FALSIFIABILITY (acceptance): the digest's cross-shard sum is its
+    ONE declared collective — a twin registered WITHOUT the declaration
+    must trip SA-COLL, proving the audit would catch a kernel that grew
+    undeclared cross-device traffic."""
+    from splink_tpu.analysis.shard_audit import (
+        register_shard_kernel,
+        run_shard_audit,
+    )
+
+    registry: dict = {}
+
+    @register_shard_kernel(
+        "bad_spill_digest_sharded", n_pairs=64, registry=registry
+    )  # no allow_collectives: the psum is undeclared
+    def _build():
+        import jax
+
+        from splink_tpu.analysis.shard_audit import audit_mesh
+        from splink_tpu.blocking_device import make_chunk_digest_fn
+        from splink_tpu.parallel.mesh import pair_sharding
+
+        mesh = audit_mesh()
+        fn = make_chunk_digest_fn(mesh)
+        shard = pair_sharding(mesh)
+        i = jax.device_put(np.zeros(64, np.int32), shard)
+        j = jax.device_put(np.zeros(64, np.int32), shard)
+        keep = jax.device_put(np.ones(64, bool), shard)
+        return fn, (i, j, keep), {}
+
+    findings, audited = run_shard_audit(registry=registry, baselines={})
+    assert audited == 1
+    assert any(f.rule == "SA-COLL" for f in findings), [
+        f.format() for f in findings
+    ]
+
+
+def test_doctored_digest_mem_baseline_trips_pa_mem():
+    """FALSIFIABILITY (acceptance): a perf baseline claiming the digest
+    executable used to move fewer bytes makes PA-MEM fire — the measured
+    layer would catch a memory regression in the new kernel."""
+    import copy
+
+    from splink_tpu.analysis import perf_audit as pa
+
+    kernels = {}
+    for cell in pa.perf_plan(["spill_chunk_digest"]):
+        kernels.setdefault(cell.kernel, {})[cell.label] = pa.measure_cell(
+            cell, best_of=2
+        )
+    base = {"tiers": {pa.current_tier(): {"kernels": kernels}}}
+    doctored = copy.deepcopy(base)
+    cell0 = doctored["tiers"][pa.current_tier()]["kernels"][
+        "spill_chunk_digest"
+    ]
+    label = next(iter(cell0))
+    cell0[label]["argument_bytes"] = cell0[label]["argument_bytes"] / 10.0
+    findings, _ = pa.run_perf_audit(
+        ["spill_chunk_digest"], doctored, best_of=2, remeasure=2
+    )
+    mem = [f for f in findings if f.rule == "PA-MEM"]
+    assert mem and "argument_bytes" in mem[0].message
+    # the honest measurement stays clean
+    findings, _ = pa.run_perf_audit(
+        ["spill_chunk_digest"], base, best_of=2, remeasure=2
+    )
+    assert not [f for f in findings if f.rule == "PA-MEM"]
 
 
 def test_bad_shard_twin_trips_sa_coll():

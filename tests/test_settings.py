@@ -422,6 +422,39 @@ def test_approx_blocking_key_types_validated():
     )
 
 
+def test_offline_scale_defaults_filled():
+    """The out-of-core write-path keys complete from the schema: spill
+    path OFF (empty dir), 1M-row build chunks, auto shard count."""
+    s = complete_settings_dict(_minimal())
+    assert s["build_spill_dir"] == ""
+    assert s["build_spill_chunk_rows"] == 1048576
+    assert s["emit_shard_chunks"] == 0
+
+
+def test_offline_scale_key_types_validated():
+    """Type/bound violations on the write-path keys are rejected by the
+    schema validator (the PR 5/7 key-validation pattern)."""
+    for bad in (
+        {"build_spill_dir": 7},
+        {"build_spill_dir": True},
+        {"build_spill_chunk_rows": 0},
+        {"build_spill_chunk_rows": 1023},
+        {"build_spill_chunk_rows": "big"},
+        {"emit_shard_chunks": -1},
+        {"emit_shard_chunks": "auto"},
+        {"emit_shard_chunks": 2.5},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    validate_settings(
+        _minimal(
+            build_spill_dir="/tmp/build",
+            build_spill_chunk_rows=4096,
+            emit_shard_chunks=8,
+        )
+    )
+
+
 def test_quality_observatory_defaults_filled():
     """The drift-observatory keys complete from the schema: profile
     capture OFF by default (legacy builds unchanged), 16 score bins, a

@@ -1,0 +1,520 @@
+"""The out-of-core write path's storage layer (splink_tpu/spill.py) and
+its consumers: the manifest-committed pair spill store, the sharded
+emission driver's resumability contract, the out-of-core packed-matrix
+build and the _PairSink lifecycle satellite.
+
+The load-bearing assertions are byte/bit-identity ones: a resumed
+emission must append exactly the bytes an uninterrupted run writes, the
+out-of-core packed matrix must equal the resident pack row for row, and
+the chunked fingerprint walk must produce the digest of the one-shot
+hash. Anything weaker would let a subtly wrong resume (re-emitted
+segment, shifted offset, truncation off by one) hide.
+"""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from splink_tpu.blocking import _PairSink, block_using_rules
+from splink_tpu.blocking_device import (
+    build_device_plan,
+    emit_pairs_sharded,
+    make_chunk_digest_fn,
+    spill_block_rules,
+)
+from splink_tpu.data import encode_table
+from splink_tpu.settings import complete_settings_dict
+from splink_tpu.spill import (
+    MANIFEST_NAME,
+    PairSpillStore,
+    SpillCorruptionError,
+    SpillError,
+    chunk_digest_host,
+    iter_spill_gamma_batches,
+)
+
+
+def _settings(rules, **extra):
+    s = {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name"},
+            {"col_name": "surname"},
+        ],
+        "blocking_rules": list(rules),
+    }
+    s.update(extra)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return complete_settings_dict(s)
+
+
+_NAMES = ["john", "mary", "jones", "smith", None, "lee", "ann"]
+
+
+def _df(n, seed):
+    r = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "unique_id": np.arange(n),
+            "first_name": r.choice(_NAMES, n),
+            "surname": r.choice(_NAMES, n),
+        }
+    )
+
+
+def _host_pairs(settings, table):
+    s = dict(settings)
+    s["device_blocking"] = "off"
+    p = block_using_rules(s, table)
+    return set(zip(p.idx_l.tolist(), p.idx_r.tolist()))
+
+
+# ----------------------------------------------------------------------
+# Store mechanics
+# ----------------------------------------------------------------------
+
+
+def test_store_commit_reopen_and_pair_index(tmp_path):
+    d = str(tmp_path / "pairs")
+    store = PairSpillStore.attach(d, np.int32, {"job": "a"})
+    store.write_segment(0, 0, 0, np.array([1, 2], np.int32),
+                        np.array([3, 4], np.int32))
+    store.write_segment(0, 0, 1, np.array([5], np.int32),
+                        np.array([6], np.int32))
+    store.finalize()
+    back = PairSpillStore.attach(d, np.int32, {"job": "a"})
+    assert back.completed and back.total_pairs == 3
+    pi = back.as_pair_index()
+    assert pi.idx_l.tolist() == [1, 2, 5]
+    assert pi.idx_r.tolist() == [3, 4, 6]
+    assert pi.spill_store is back
+    back.verify()  # sha256 of every segment holds
+    back.close()
+
+
+def test_store_refuses_foreign_meta_and_dtype(tmp_path):
+    d = str(tmp_path / "pairs")
+    PairSpillStore.attach(d, np.int32, {"state_hash": "aaa"}).finalize()
+    with pytest.raises(SpillError, match="different job"):
+        PairSpillStore.attach(d, np.int32, {"state_hash": "bbb"})
+    with pytest.raises(SpillError, match="int64"):
+        PairSpillStore.attach(d, np.int64, {"state_hash": "aaa"})
+    # extra bookkeeping merged by finalize() must NOT break re-attach
+    PairSpillStore.attach(d, np.int32, {"state_hash": "aaa"})
+
+
+def test_store_truncates_torn_tail_on_attach(tmp_path):
+    """Bytes past the committed watermark (a kill between the byte append
+    and the manifest commit) are dropped on attach — the resumed stream
+    lands exactly where an uninterrupted one would."""
+    d = str(tmp_path / "pairs")
+    store = PairSpillStore.attach(d, np.int32, {})
+    store.write_segment(0, 0, 0, np.arange(4, dtype=np.int32),
+                        np.arange(4, dtype=np.int32))
+    store.close()
+    for name in ("idx_l.bin", "idx_r.bin"):
+        with open(os.path.join(d, name), "ab") as fh:
+            fh.write(b"tornbytes")
+    back = PairSpillStore.attach(d, np.int32, {})
+    assert back.total_pairs == 4
+    assert os.path.getsize(os.path.join(d, "idx_l.bin")) == 16
+    seg = back.write_segment(0, 0, 1, np.array([9], np.int32),
+                             np.array([9], np.int32))
+    assert seg.offset == 4
+
+
+def test_store_detects_disk_corruption(tmp_path):
+    d = str(tmp_path / "pairs")
+    store = PairSpillStore.attach(d, np.int32, {})
+    store.write_segment(0, 0, 0, np.arange(8, dtype=np.int32),
+                        np.arange(8, dtype=np.int32))
+    store.finalize()
+    with open(os.path.join(d, "idx_r.bin"), "r+b") as fh:
+        fh.seek(4)
+        fh.write(b"\xff\xff\xff\xff")
+    back = PairSpillStore.attach(d, np.int32, {})
+    with pytest.raises(SpillCorruptionError, match="sha256"):
+        back.verify()
+
+
+def test_store_missing_bytes_is_corruption(tmp_path):
+    d = str(tmp_path / "pairs")
+    store = PairSpillStore.attach(d, np.int32, {})
+    store.write_segment(0, 0, 0, np.arange(8, dtype=np.int32),
+                        np.arange(8, dtype=np.int32))
+    store.close()
+    with open(os.path.join(d, "idx_l.bin"), "r+b") as fh:
+        fh.truncate(8)  # shorter than the committed watermark
+    with pytest.raises(SpillCorruptionError, match="manifest commits"):
+        PairSpillStore.attach(d, np.int32, {})
+
+
+def test_store_refuses_append_after_finalize_and_duplicate_segment(tmp_path):
+    d = str(tmp_path / "pairs")
+    store = PairSpillStore.attach(d, np.int32, {})
+    store.write_segment(0, 0, 0, np.array([1], np.int32),
+                        np.array([2], np.int32))
+    with pytest.raises(SpillError, match="already committed"):
+        store.write_segment(0, 0, 0, np.array([1], np.int32),
+                            np.array([2], np.int32))
+    store.finalize()
+    with pytest.raises(SpillError, match="finalized"):
+        store.write_segment(0, 0, 1, np.array([1], np.int32),
+                            np.array([2], np.int32))
+
+
+def test_store_context_manager_aborts_uncommitted(tmp_path):
+    """An exception inside the ``with`` truncates appended-but-uncommitted
+    bytes (write handles closed BEFORE the truncate — the Windows-safe
+    ordering)."""
+    d = str(tmp_path / "pairs")
+    store = PairSpillStore.attach(d, np.int32, {})
+    with pytest.raises(RuntimeError):
+        with store:
+            store.write_segment(0, 0, 0, np.array([1], np.int32),
+                                np.array([2], np.int32))
+            # simulate a mid-segment failure AFTER a raw append
+            fl, _fr = store._open_files()
+            fl.write(b"\x01\x02\x03\x04")
+            fl.flush()
+            raise RuntimeError("boom")
+    assert os.path.getsize(os.path.join(d, "idx_l.bin")) == 4  # 1 committed pair
+    back = PairSpillStore.attach(d, np.int32, {})
+    assert back.total_pairs == 1
+
+
+def test_transfer_digest_compact_layout_agrees_with_host():
+    """The compacted-chunk digest twin (the accelerator path's layout:
+    survivors in the leading lanes, count as out_i's extra last lane)
+    must agree with the host mirror over the downloaded prefix — the
+    same verification write_segment runs on a real accelerator build."""
+    from splink_tpu.blocking_device import make_chunk_digest_compact_fn
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    bs, cnt = 64, 37
+    i = np.zeros(bs, np.int32)
+    j = np.zeros(bs, np.int32)
+    i[:cnt] = rng.integers(0, 500, cnt)
+    j[:cnt] = rng.integers(0, 500, cnt)
+    i_ext = np.concatenate([i, [cnt]]).astype(np.int32)
+    pos = np.arange(bs, dtype=np.int32)
+    dev = int(np.asarray(make_chunk_digest_compact_fn()(
+        jnp.asarray(i_ext), jnp.asarray(j), jnp.asarray(pos)
+    )))
+    assert dev == chunk_digest_host(i[:cnt], j[:cnt])
+
+
+def test_transfer_digest_device_host_agree_and_mismatch_raises(tmp_path):
+    rng = np.random.default_rng(7)
+    i = rng.integers(0, 1000, 257).astype(np.int32)
+    j = rng.integers(0, 1000, 257).astype(np.int32)
+    keep = rng.integers(0, 2, 257).astype(bool)
+    import jax.numpy as jnp
+
+    dev = int(np.asarray(make_chunk_digest_fn()(
+        jnp.asarray(i), jnp.asarray(j), jnp.asarray(keep)
+    )))
+    assert dev == chunk_digest_host(i[keep], j[keep])
+    store = PairSpillStore.attach(str(tmp_path / "p"), np.int32, {})
+    store.write_segment(0, 0, 0, i[keep], j[keep], digest=dev)
+    with pytest.raises(SpillCorruptionError, match="transfer digest"):
+        store.write_segment(0, 0, 1, i[~keep], j[~keep], digest=dev + 1)
+
+
+# ----------------------------------------------------------------------
+# Sharded emission: determinism, resume, budget
+# ----------------------------------------------------------------------
+
+
+def _plan_and_host(seed=3, n=200):
+    s = _settings(
+        ["l.first_name = r.first_name", "l.surname = r.surname"]
+    )
+    t = encode_table(_df(n, seed), s)
+    plan = build_device_plan(s, t)
+    assert plan is not None
+    return s, t, plan, _host_pairs(s, t)
+
+
+def test_resumed_emission_is_byte_identical(tmp_path):
+    """Kill-simulation at segment granularity: a driver that died after k
+    commits, relaunched over the same store, skips the committed prefix
+    and appends bytes IDENTICAL to an uninterrupted run's."""
+    _s, _t, plan, _host = _plan_and_host()
+    d_full = str(tmp_path / "full")
+    store = PairSpillStore.attach(d_full, np.int32, {})
+    with store:
+        emit_pairs_sharded(plan, store, 128, n_shards=3)
+    store.finalize()
+    full = open(os.path.join(d_full, "idx_l.bin"), "rb").read()
+    assert full
+
+    d_part = str(tmp_path / "part")
+    part = PairSpillStore.attach(d_part, np.int32, {})
+    orig = part.write_segment
+    count = [0]
+
+    def dying(*a, **k):
+        if count[0] >= 4:
+            raise RuntimeError("simulated death mid-build")
+        count[0] += 1
+        return orig(*a, **k)
+
+    part.write_segment = dying
+    with pytest.raises(RuntimeError):
+        with part:
+            emit_pairs_sharded(plan, part, 128, n_shards=3)
+    part.write_segment = orig
+    resumed = PairSpillStore.attach(d_part, np.int32, {})
+    with resumed:
+        stats = emit_pairs_sharded(plan, resumed, 128, n_shards=3)
+    resumed.finalize()
+    assert stats["skipped"] == 4
+    assert open(os.path.join(d_part, "idx_l.bin"), "rb").read() == full
+    assert open(os.path.join(d_part, "idx_r.bin"), "rb").read() == (
+        open(os.path.join(d_full, "idx_r.bin"), "rb").read()
+    )
+
+
+def test_budget_envelope_exact_and_resume_stable(tmp_path):
+    """The global budget truncates the final segment exactly at the
+    envelope, and a resumed budgeted run commits the SAME segment set
+    (the stop decision depends only on committed counts)."""
+    _s, _t, plan, _host = _plan_and_host()
+    d = str(tmp_path / "b")
+    store = PairSpillStore.attach(d, np.int32, {})
+    with store:
+        stats = emit_pairs_sharded(plan, store, 64, n_shards=2, budget=150)
+    store.finalize()
+    assert store.total_pairs == 150 and stats["exhausted"]
+    manifest = json.load(open(os.path.join(d, MANIFEST_NAME)))
+    d2 = str(tmp_path / "b2")
+    part = PairSpillStore.attach(d2, np.int32, {})
+    orig = part.write_segment
+    count = [0]
+
+    def dying(*a, **k):
+        if count[0] >= 1:
+            raise RuntimeError("dead")
+        count[0] += 1
+        return orig(*a, **k)
+
+    part.write_segment = dying
+    with pytest.raises(RuntimeError):
+        with part:
+            emit_pairs_sharded(plan, part, 64, n_shards=2, budget=150)
+    part.write_segment = orig
+    resumed = PairSpillStore.attach(d2, np.int32, {})
+    with resumed:
+        emit_pairs_sharded(plan, resumed, 64, n_shards=2, budget=150)
+    resumed.finalize()
+    m2 = json.load(open(os.path.join(d2, MANIFEST_NAME)))
+    assert [s_["pairs"] for s_ in m2["segments"]] == [
+        s_["pairs"] for s_ in manifest["segments"]
+    ]
+    assert resumed.total_pairs == 150
+
+
+def test_multi_controller_shard_filter_partitions_exactly(tmp_path):
+    """shard_filter=(p, P): the P per-process stores' union equals the
+    unfiltered pair set with no overlap — the multi-host emission
+    contract, exercised single-process."""
+    s, t, plan, host = _plan_and_host()
+    parts = []
+    P = 3
+    for p in range(P):
+        d = str(tmp_path / f"proc{p}")
+        store = PairSpillStore.attach(d, np.int32, {})
+        with store:
+            emit_pairs_sharded(
+                plan, store, 128, n_shards=4, shard_filter=(p, P)
+            )
+        store.finalize()
+        pi = store.as_pair_index()
+        parts.append(set(zip(pi.idx_l.tolist(), pi.idx_r.tolist())))
+    union = set().union(*parts)
+    assert union == host
+    assert sum(len(p) for p in parts) == len(union), "shard overlap"
+
+
+# ----------------------------------------------------------------------
+# Spill-fed gamma stream
+# ----------------------------------------------------------------------
+
+
+def test_iter_spill_gamma_batches_matches_resident(tmp_path):
+    from splink_tpu.gammas import GammaProgram
+
+    s = _settings(["l.first_name = r.first_name"])
+    t = encode_table(_df(150, 11), s)
+    pi = spill_block_rules(s, t, None, str(tmp_path))
+    assert pi is not None and pi.spill_store is not None
+    program = GammaProgram(s, t)
+    chunks = list(
+        iter_spill_gamma_batches(pi.spill_store, program, batch_size=64)
+    )
+    assert len(chunks) > 1  # actually chunked
+    G_stream = np.concatenate(chunks)
+    G_full, _ = program.compute_with_device(
+        np.asarray(pi.idx_l), np.asarray(pi.idx_r), batch_size=64
+    )
+    assert np.array_equal(G_stream, G_full)
+
+
+def test_iter_spill_gamma_batches_refuses_unfinalized(tmp_path):
+    from splink_tpu.gammas import GammaProgram
+
+    s = _settings(["l.first_name = r.first_name"])
+    t = encode_table(_df(40, 12), s)
+    store = PairSpillStore.attach(str(tmp_path / "p"), np.int32, {})
+    store.write_segment(0, 0, 0, np.array([0], np.int32),
+                        np.array([1], np.int32))
+    with pytest.raises(SpillError, match="not finalized"):
+        list(iter_spill_gamma_batches(store, GammaProgram(s, t), 64))
+
+
+# ----------------------------------------------------------------------
+# _PairSink lifecycle satellite
+# ----------------------------------------------------------------------
+
+
+def test_pair_sink_context_manager_reclaims_on_abort(tmp_path):
+    spill = str(tmp_path / "spill")
+    sink = _PairSink(spill, np.int32)
+    partial = sink.spill_tmp
+    assert partial and os.path.isdir(partial)
+    with pytest.raises(RuntimeError):
+        with sink:
+            sink.append(np.array([1], np.int32), np.array([2], np.int32))
+            raise RuntimeError("mid-emission failure")
+    assert not os.path.isdir(partial), "aborted sink left its segments"
+    # success path leaves the finished spill alive
+    with _PairSink(spill, np.int32) as ok:
+        ok.append(np.array([1], np.int32), np.array([2], np.int32))
+        pi = ok.finish()
+    assert os.path.isdir(pi.spill_tmp)
+
+
+def test_pair_index_release_closes_maps_before_unlink(tmp_path):
+    s = _settings(["l.first_name = r.first_name"],
+                  spill_dir=str(tmp_path / "spill"))
+    t = encode_table(_df(60, 13), s)
+    pairs = block_using_rules(s, t)
+    spill_tmp = pairs.spill_tmp
+    assert spill_tmp and os.path.isdir(spill_tmp)
+    mm = pairs.idx_l._mmap
+    pairs.release()
+    assert mm.closed, "memmap must close before the unlink (Windows-safe)"
+    assert not os.path.isdir(spill_tmp)
+    assert pairs.spill_tmp is None
+    pairs.release()  # idempotent
+
+
+# ----------------------------------------------------------------------
+# Out-of-core packed build
+# ----------------------------------------------------------------------
+
+
+def test_slice_rows_packs_identically():
+    import jax.numpy as jnp
+
+    from splink_tpu.gammas import pack_table
+
+    s = _settings(["l.first_name = r.first_name"])
+    t = encode_table(_df(137, 14), s)
+    full, layout_full = pack_table(t, jnp.float32)
+    rows = [pack_table(t.slice_rows(a, min(a + 32, t.n_rows)), jnp.float32)[0]
+            for a in range(0, t.n_rows, 32)]
+    assert np.array_equal(np.concatenate(rows), full)
+    probe, layout_probe = pack_table(t.slice_rows(0, 0), jnp.float32)
+    assert probe.shape[1] == full.shape[1]
+
+
+def test_pack_out_of_core_resumes_bit_identical(tmp_path):
+    import jax.numpy as jnp
+
+    from splink_tpu.gammas import pack_table
+    from splink_tpu.serve.index import _pack_table_out_of_core
+
+    s = _settings(["l.first_name = r.first_name"])
+    t = encode_table(_df(300, 15), s)
+    full, _ = pack_table(t, jnp.float32)
+
+    d1 = str(tmp_path / "a")
+    packed, _ = _pack_table_out_of_core(
+        t, jnp.float32, None, (), (), d1, chunk_rows=64, state_hash="h1"
+    )
+    assert isinstance(packed, np.memmap)
+    assert np.array_equal(np.asarray(packed), full)
+
+    # interrupted build: first 2 chunks committed + a torn half-chunk tail
+    d2 = str(tmp_path / "b")
+    out_dir = os.path.join(d2, "index_build")
+    os.makedirs(out_dir)
+    data = os.path.join(out_dir, "packed.bin")
+    row_bytes = full.shape[1] * 4
+    with open(data, "wb") as fh:
+        np.ascontiguousarray(full[:128]).tofile(fh)
+        fh.write(b"\x00" * (row_bytes // 2))  # torn tail
+    json.dump(
+        {
+            "version": 1, "state_hash": "h1", "n_rows": 300,
+            "n_lanes": int(full.shape[1]), "chunk_rows": 64,
+            "dtype": "float32", "chunks_done": 2,
+        },
+        open(os.path.join(out_dir, "build_state.json"), "w"),
+    )
+    packed2, _ = _pack_table_out_of_core(
+        t, jnp.float32, None, (), (), d2, chunk_rows=64, state_hash="h1"
+    )
+    assert np.array_equal(np.asarray(packed2), full)
+
+    # a state file bound to a DIFFERENT job restarts from scratch
+    packed3, _ = _pack_table_out_of_core(
+        t, jnp.float32, None, (), (), d2, chunk_rows=64, state_hash="h2"
+    )
+    assert np.array_equal(np.asarray(packed3), full)
+
+
+def test_summarize_renders_blocking_spill_event_and_tolerates_torn():
+    from splink_tpu.obs.cli import summarize_events
+
+    full = {
+        "type": "blocking_spill", "rules": 2, "shards": 4, "segments": 9,
+        "skipped": 3, "pairs": 12345, "pairs_per_sec": 99999,
+        "chunk_budget": 4096, "budget": None, "exhausted": False,
+        "elapsed_s": 0.5,
+    }
+    out = summarize_events([full])
+    assert "spill emission" in out and "12,345" in out and "resumed=3" in out
+    # torn record: missing fields render as 0, never crash
+    out2 = summarize_events([{"type": "blocking_spill"}])
+    assert "spill emission" in out2
+
+
+def test_hash_update_array_matches_one_shot():
+    import hashlib
+
+    from splink_tpu.serve.index import _hash_update_array
+
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 2**32, size=(1000, 7), dtype=np.uint32)
+    h1 = hashlib.sha256()
+    h1.update(np.ascontiguousarray(arr).tobytes())
+    h2 = hashlib.sha256()
+    _hash_update_array(h2, arr, chunk_rows=17)
+    assert h1.hexdigest() == h2.hexdigest()
+    # non-contiguous source hashes its C-order bytes, like tobytes()
+    v = arr[::2]
+    h3 = hashlib.sha256()
+    h3.update(np.ascontiguousarray(v).tobytes())
+    h4 = hashlib.sha256()
+    _hash_update_array(h4, v, chunk_rows=13)
+    assert h3.hexdigest() == h4.hexdigest()

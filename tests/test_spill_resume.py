@@ -1,0 +1,82 @@
+"""Kill-and-resume of the spill-backed offline build (ISSUE 15 satellite).
+
+A REAL SIGKILL (fault-plan kind=kill: no atexit, no finally blocks) lands
+mid-segment in the emission driver — after the segment's bytes hit disk,
+before its manifest commit — and, separately, mid-chunk in the
+out-of-core packed-matrix writer. The relaunched build must resume from
+the last committed state and produce an index whose CONTENT FINGERPRINT
+is bit-identical to an uninterrupted run's, on both mesh widths (the
+explicit single-device mesh and the virtual 8-device one). Anything
+weaker would let a resume that re-emits, drops or reorders a segment
+hide behind EM's tolerance of pair order.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "spill_build_worker.py")
+
+
+def _run_worker(tmp_path, tag, mesh_n, faults=None, build=None):
+    out = str(tmp_path / f"{tag}.json")
+    if build is None:
+        build = str(tmp_path / f"build_{tag}")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("SPLINK_TPU_FAULTS", None)
+    if faults:
+        env["SPLINK_TPU_FAULTS"] = faults
+    proc = subprocess.run(
+        [sys.executable, WORKER, out, build, str(mesh_n)],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=600,
+    )
+    return proc, out, build
+
+
+@pytest.mark.parametrize(
+    "mesh_n,fault",
+    [
+        # kill between a spill segment's byte append and its manifest
+        # commit — the widest window — on the single-device mesh
+        (1, "emit_segment@seq=2:kind=kill"),
+        # kill between an out-of-core packed chunk's append and its
+        # watermark commit, with the emission mesh-sharded 8 wide
+        (8, "build_chunk@chunk=1:kind=kill"),
+    ],
+)
+def test_killed_build_resumes_bit_identical(tmp_path, mesh_n, fault):
+    # uninterrupted oracle (its own build dir)
+    ref, ref_out, _ = _run_worker(tmp_path, f"ref-{mesh_n}", mesh_n)
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    want = json.load(open(ref_out))
+
+    # killed run: a REAL SIGKILL mid-commit-window
+    killed, _, build = _run_worker(
+        tmp_path, f"killed-{mesh_n}", mesh_n, faults=fault
+    )
+    assert killed.returncode == -signal.SIGKILL, (
+        killed.returncode, killed.stdout[-1000:], killed.stderr[-1000:],
+    )
+    # something durable was committed before death (a resume has state)
+    assert os.path.isdir(build)
+
+    # resumed run over the SAME build dir, no faults
+    resumed, res_out, _ = _run_worker(
+        tmp_path, f"resumed-{mesh_n}", mesh_n, build=build
+    )
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    got = json.load(open(res_out))
+    assert got["fingerprint"] == want["fingerprint"], (
+        "resumed build fingerprint diverged from the uninterrupted run"
+    )
+    assert got["n_pairs"] == want["n_pairs"]
+    log = resumed.stderr + resumed.stdout
+    assert "resumed" in log.lower() or got["segments"] > 0
