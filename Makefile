@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke wire-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -59,6 +59,18 @@ serve-smoke:
 # brown-out episode stay recompile-free (docs/serving.md#resilience).
 chaos-smoke:
 	python scripts/chaos_smoke.py
+
+# Wire chaos smoke: two real services behind loopback WireServers, a
+# ReplicaRouter over RemoteReplica clients, driven through every wire
+# fault site (resilience/faults.py WIRE_SITES — host kill mid-request,
+# partition + heal, slow link tripping the hedger, torn frames, per-
+# remote breaker storm) and assert the multi-host contract: no future
+# hangs, no exception escapes, sheds are machine-readable, wire events
+# land in the JSONL sink, remote answers stay bit-identical to local,
+# and post-recovery steady state performs ZERO recompiles
+# (docs/serving.md#multi-host).
+wire-smoke:
+	python scripts/wire_chaos_smoke.py
 
 # Device-blocking smoke: device<->host pair-set parity (the host join is
 # the oracle) over sequential/null/asymmetric rules with budgeted chunked
@@ -139,4 +151,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench
+all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke wire-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench

@@ -1399,6 +1399,169 @@ def _scale_child(mode: str, n_rows: str, out_path: str) -> int:
     return 0
 
 
+def bench_wire():
+    """Wire-tier benchmark (`python bench.py wire`, round 16): the cost
+    of putting the serving tier behind the multi-host RPC protocol.
+
+    ONE trained index serves two tiers over the SAME warmed engine —
+    ``local`` submits straight into the LinkageService, ``remote`` routes
+    every query through a loopback WireServer + RemoteReplica (frame
+    encode → TCP → dispatch → frame decode, the full multi-host path
+    minus the physical network). The tiers run INTERLEAVED best-of-N
+    open bursts (shared-container drift hits both alike); the headline
+    is the remote/local throughput ratio plus the closed-loop RTT the
+    wire adds per request. Gates: one query batch parity-checked
+    bit-identical across the wire, and ZERO steady-state compile
+    requests in either tier (frames never touch the compile cache)."""
+    tier = _probe_device_init()
+    import jax
+
+    from splink_tpu.obs.metrics import (
+        compile_requests,
+        install_compile_monitor,
+    )
+    from splink_tpu import Splink
+    from splink_tpu.serve import (
+        LinkageService,
+        QueryEngine,
+        RemoteReplica,
+        WireServer,
+    )
+
+    install_compile_monitor()
+    n_rows = int(os.environ.get("SPLINK_TPU_BENCH_WIRE_ROWS", 200_000))
+    n_queries = int(os.environ.get("SPLINK_TPU_BENCH_WIRE_QUERIES", 2000))
+    repeats = int(os.environ.get("SPLINK_TPU_BENCH_WIRE_REPEATS", 5))
+    rng = np.random.default_rng(0)
+    df = _make_df(rng, n_rows)
+
+    settings = dict(SETTINGS)
+    settings["max_iterations"] = 5
+    settings["serve_top_k"] = 5
+    settings["serve_queue_depth"] = n_queries
+    linker = Splink(settings, df=df)
+    t0 = time.perf_counter()
+    linker.estimate_parameters()
+    train_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    index = linker.export_index()
+    build_s = time.perf_counter() - t0
+
+    engine = QueryEngine(index)
+    t0 = time.perf_counter()
+    warm = engine.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    records = df.sample(
+        n=min(n_queries, len(df)), replace=n_queries > len(df),
+        random_state=0,
+    ).to_dict(orient="records")
+    while len(records) < n_queries:
+        records.extend(records[: n_queries - len(records)])
+
+    svc = LinkageService(engine, deadline_ms=None)
+    server = WireServer(svc).start()
+    remote = RemoteReplica(
+        ("127.0.0.1", server.port),
+        pool_size=2,
+        request_timeout_ms=120_000.0,
+    )
+
+    # parity gate: one probe batch across the wire, bit-identical
+    probe = records[:64]
+    local_res = [svc.query(dict(r), timeout=120) for r in probe]
+    remote_res = [
+        f.result(timeout=120)
+        for f in [remote.submit(dict(r)) for r in probe]
+    ]
+    mismatches = 0
+    for lo, re in zip(local_res, remote_res):
+        assert not lo.shed and not re.shed, (lo.reason, re.reason)
+        if len(lo.matches) != len(re.matches) or any(
+            str(lu) != str(ru) or lp != rp
+            for (lu, lp), (ru, rp) in zip(lo.matches, re.matches)
+        ):
+            mismatches += 1
+    assert mismatches == 0, f"wire parity: {mismatches} mismatched queries"
+
+    # closed loop: the per-request RTT each tier adds, one in flight
+    def closed_loop(fn, n=100):
+        lats = []
+        for r in records[:n]:
+            t0 = time.perf_counter()
+            fn(dict(r))
+            lats.append((time.perf_counter() - t0) * 1000.0)
+        return np.percentile(np.asarray(lats), [50, 99])
+
+    seq_local = closed_loop(lambda r: svc.query(r, timeout=120))
+    seq_remote = closed_loop(
+        lambda r: remote.submit(r).result(timeout=120)
+    )
+
+    # steady state starts HERE: warmup + parity + closed loops done
+    c_warm = compile_requests()
+    tiers_fn = {
+        "local": lambda r: svc.submit(r),
+        "remote": lambda r: remote.submit(r),
+    }
+    best = {name: 0.0 for name in tiers_fn}
+    for rep in range(repeats):
+        order = (
+            tuple(tiers_fn) if rep % 2 == 0 else tuple(reversed(tiers_fn))
+        )
+        for name in order:
+            submit = tiers_fn[name]
+            t0 = time.perf_counter()
+            futs = [submit(dict(r)) for r in records]
+            for f in futs:
+                res = f.result(timeout=600)
+                assert not res.shed, (name, res.reason)
+            best[name] = max(
+                best[name], n_queries / (time.perf_counter() - t0)
+            )
+    c_end = compile_requests()
+    link = remote.latency_summary()
+    remote.close()
+    server.close()
+    svc.close()
+
+    qps_local, qps_remote = best["local"], best["remote"]
+    print(json.dumps({
+        "metric": "wire_remote_queries_per_sec",
+        "value": round(qps_remote, 1),
+        "unit": "queries/sec",
+        "local_queries_per_sec": round(qps_local, 1),
+        "remote_over_local": round(qps_remote / qps_local, 3),
+        "closed_loop_local_ms": {
+            "p50": round(float(seq_local[0]), 3),
+            "p99": round(float(seq_local[1]), 3),
+        },
+        "closed_loop_remote_ms": {
+            "p50": round(float(seq_remote[0]), 3),
+            "p99": round(float(seq_remote[1]), 3),
+        },
+        "wire_rtt_added_p50_ms": round(
+            float(seq_remote[0] - seq_local[0]), 3
+        ),
+        "parity_queries_checked": len(probe),
+        "parity_mismatches": mismatches,
+        "reconnects": link.get("reconnects", 0),
+        "n_reference_rows": n_rows,
+        "n_queries": n_queries,
+        "repeats": repeats,
+        "train_seconds": round(train_s, 3),
+        "index_build_seconds": round(build_s, 3),
+        "warmup_seconds": round(warmup_s, 3),
+        "warmup_combinations": warm["combinations"],
+        "steady_state_compiles": c_end - c_warm,
+        "device": str(jax.devices()[0]),
+        **tier,
+    }))
+    assert c_end - c_warm == 0, (
+        f"wire bench steady state performed {c_end - c_warm} recompiles"
+    )
+
+
 def bench_scale():
     """Offline-scale benchmark (`python bench.py scale`, BENCHMARKS.md
     round 15): (a) resident vs out-of-core index build — wall and
@@ -1785,6 +1948,8 @@ if __name__ == "__main__":
     elif "scale-child" in sys.argv[1:]:
         i = sys.argv.index("scale-child")
         sys.exit(_scale_child(sys.argv[i + 1], sys.argv[i + 2], sys.argv[i + 3]))
+    elif "wire" in sys.argv[1:]:
+        bench_wire()
     elif "scale" in sys.argv[1:]:
         bench_scale()
     else:

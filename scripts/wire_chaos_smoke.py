@@ -1,0 +1,339 @@
+"""Wire chaos smoke (`make wire-smoke`): the multi-host serving tier
+under injected network faults.
+
+Two real LinkageServices behind WireServers on loopback, fronted by
+RemoteReplica clients and a ReplicaRouter — the exact multi-host
+deployment shape, minus the second machine. Every scenario asserts the
+wire-tier resilience contract end to end:
+
+  1. no future ever hangs past its timeout (every submit resolves);
+  2. no exception escapes to a caller through a future — connection
+     loss, torn frames and partitions resolve as machine-readable sheds;
+  3. the structured wire events land in the JSONL sink;
+  4. post-fault throughput recovers (a follow-up wave serves non-shed);
+  5. remote answers are BIT-identical to the same queries served
+     locally against the same index (JSON floats round-trip exactly);
+  6. post-recovery steady state performs ZERO recompiles — reconnects
+     and failovers never touch the compile cache.
+
+Scenarios:
+
+  A  remote parity            -> every wire-served probability equals the
+                                 locally served one bitwise
+  B  host kill mid-request    -> in-flight sheds connection_lost, the
+                                 router fails over to the live remote,
+                                 restart + reconnect re-admits the host
+  C  partition + heal         -> sheds while dark, reconnect storm stays
+                                 bounded (backoff), heal re-admits
+  D  slow link                -> the p95-hedger fires a backup request to
+                                 the fast remote; answers stay non-shed
+  E  torn response frame      -> the torn frame sheds exactly one request
+                                 and never poisons protocol state
+  F  breaker per remote       -> a dead remote's breaker opens and fails
+                                 fast locally; the handshake probe closes
+                                 it after restart
+
+Exits nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WAVE_TIMEOUT_S = 60  # generous: the contract is "never hangs", not "fast"
+
+
+def _settings():
+    return {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.surname = r.surname"],
+        "max_iterations": 4,
+        "serve_top_k": 64,
+        "serve_query_buckets": [16, 128],
+        "serve_candidate_buckets": [64, 256],
+        "serve_brownout_top_k": 2,
+        "serve_breaker_threshold": 2,
+        "serve_probe_queries": 8,
+        "serve_queue_depth": 256,
+    }
+
+
+def _corpus(n=200, seed=7):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    return pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+
+
+def _drive(target, records, timeout=WAVE_TIMEOUT_S):
+    """Submit a wave and wait for EVERY future: a hang or an escaping
+    exception here is a contract violation."""
+    futures = [target.submit(dict(r)) for r in records]
+    return [f.result(timeout=timeout) for f in futures]
+
+
+def _await_recovery(rep, record, what, budget_s=20):
+    """Poll one remote until a submit serves non-shed; a remote that
+    never re-admits within the budget is a contract violation."""
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        res = rep.submit(dict(record)).result(timeout=WAVE_TIMEOUT_S)
+        if not res.shed:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"{what}: remote never recovered")
+
+
+def _set_plan(spec):
+    from splink_tpu.resilience import faults
+
+    faults.reset_plans()
+    if spec:
+        os.environ[faults.ENV_VAR] = spec
+    else:
+        os.environ.pop(faults.ENV_VAR, None)
+
+
+def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
+    import warnings
+
+    import numpy as np
+
+    from splink_tpu import Splink
+    from splink_tpu.obs.events import EventSink, read_events, register_ambient
+    from splink_tpu.obs.metrics import compile_requests, install_compile_monitor
+    from splink_tpu.resilience.retry import RetryPolicy
+    from splink_tpu.serve import (
+        LinkageService,
+        QueryEngine,
+        RemoteReplica,
+        ReplicaRouter,
+        WireServer,
+        load_index,
+    )
+
+    install_compile_monitor()
+    warnings.simplefilter("ignore")  # degradations are asserted via events
+    _set_plan("")
+    tmp = tempfile.mkdtemp(prefix="splink_wire_chaos_")
+    events_path = os.path.join(tmp, "wire_events.jsonl")
+    sink = EventSink(events_path, run_id="wire-chaos-smoke")
+    register_ambient(sink)
+
+    df = _corpus()
+    linker = Splink(_settings(), df=df)
+    linker.estimate_parameters()
+    idx_path = os.path.join(tmp, "idx")
+    linker.export_index(idx_path)
+
+    def _stack(name):
+        """One host: engine + service + wire server, all on the SAME
+        exported index so every replica answers identically."""
+        engine = QueryEngine(load_index(idx_path))
+        engine.warmup()
+        svc = LinkageService(engine, deadline_ms=None, name=name)
+        server = WireServer(svc, name=name).start()
+        return svc, server
+
+    def _remote(server, **over):
+        kw = dict(
+            pool_size=2,
+            retry_policy=RetryPolicy(base_delay=0.05, max_delay=0.5),
+            breaker_threshold=2,
+            breaker_cooldown_s=0.2,
+            connect_timeout_ms=300.0,
+            request_timeout_ms=WAVE_TIMEOUT_S * 1000.0,
+        )
+        kw.update(over)
+        return RemoteReplica(("127.0.0.1", server.port), **kw)
+
+    svc_a, server_a = _stack("host-a")
+    svc_b, server_b = _stack("host-b")
+    rep_a = _remote(server_a)
+    rep_b = _remote(server_b)
+
+    records = df.head(100).to_dict(orient="records")
+    wave = records[:20]
+
+    # ---- A: remote answers bit-identical to local -----------------------
+    local = _drive(svc_a, records[:40])
+    remote = _drive(rep_a, records[:40])
+    checked = 0
+    for lo, re in zip(local, remote):
+        assert not lo.shed and not re.shed, (lo.reason, re.reason)
+        assert len(lo.matches) == len(re.matches), "A: match sets differ"
+        for (lu, lp), (ru, rp) in zip(lo.matches, re.matches):
+            assert str(lu) == str(ru), f"A: match order differs ({lu}!={ru})"
+            assert np.float64(lp) == np.float64(rp), (
+                f"A: parity violation on {lu}: {lp!r} != {rp!r}"
+            )
+            checked += 1
+        assert lo.n_candidates == re.n_candidates
+    assert checked > 50, f"A: only {checked} pairs compared"
+    print(f"wire A ok: {checked} remote probabilities bit-identical to local")
+
+    # ---- B: host kill mid-request -> shed + failover + re-admission -----
+    router = ReplicaRouter([rep_a, rep_b], hedge_ms=0)
+    pre = _drive(router, wave)
+    assert not any(r.shed for r in pre), "B: pre-fault wave must serve"
+    inflight = [rep_a.submit(dict(r)) for r in records]  # park on host A
+    port_a = server_a.port
+    server_a.kill()  # abrupt: no goodbye, no draining
+    t0 = time.monotonic()
+    dead = [f.result(timeout=WAVE_TIMEOUT_S) for f in inflight]
+    assert time.monotonic() - t0 < WAVE_TIMEOUT_S
+    shed = [r for r in dead if r.shed]
+    assert shed, "B: the kill must shed the in-flight wave"
+    assert all(
+        r.reason in ("connection_lost", "remote_unreachable", "breaker_open")
+        for r in shed
+    ), f"B: unmachine-readable shed reasons {sorted({r.reason for r in shed})}"
+    results = _drive(router, wave)  # router must route around the corpse
+    assert not any(r.shed for r in results), "B: failover wave must serve"
+    assert rep_a.health_state == "broken", "B: dead remote must rank broken"
+    svc_a2 = LinkageService(
+        QueryEngine(load_index(idx_path)), deadline_ms=None, name="host-a"
+    )
+    svc_a2.engine.warmup()
+    server_a = WireServer(svc_a2, port=port_a, name="host-a").start()
+    _await_recovery(rep_a, wave[0], "B re-admission")
+    assert rep_a.reconnects >= 1, "B: reconnect must be recorded"
+    print(f"wire B ok: kill shed {len(shed)} in-flight, router failed over, "
+          f"restart re-admitted after {rep_a.reconnects} reconnect(s)")
+
+    # ---- C: partition + heal -> bounded reconnect storm -----------------
+    server_b.partition(1.0)
+    res = rep_b.submit(dict(wave[0])).result(timeout=WAVE_TIMEOUT_S)
+    assert res.shed and res.reason in (
+        "connection_lost", "remote_unreachable", "breaker_open"
+    ), f"C: partitioned remote must shed machine-readably, got {res.reason}"
+    dark = _drive(router, wave)  # the healthy remote absorbs the traffic
+    assert not any(r.shed for r in dark), "C: router wave during partition"
+    _await_recovery(rep_b, wave[0], "C heal")
+    print(f"wire C ok: partition shed cleanly, healed after "
+          f"{rep_b.reconnects} reconnect(s)")
+
+    # ---- D: slow link trips the hedger ----------------------------------
+    for r in wave:  # seed both latency windows for the p95 hedger
+        rep_a.submit(dict(r)).result(timeout=WAVE_TIMEOUT_S)
+        rep_b.submit(dict(r)).result(timeout=WAVE_TIMEOUT_S)
+    hedged = ReplicaRouter([rep_a, rep_b], hedge_ms=30)
+    _set_plan("wire_request@kind=net_delay:delay_ms=400:times=40")
+    h0 = hedged.hedges
+    results = _drive(hedged, wave)
+    assert not any(r.shed for r in results), "D: hedged wave must serve"
+    assert hedged.hedges > h0, "D: the slow link must trip the hedger"
+    _set_plan("")
+    print(f"wire D ok: slow link tripped {hedged.hedges - h0} hedge(s), "
+          "all answers served")
+    # quiesce: the losing hedge requests are still in flight server-side;
+    # a wave queued BEHIND them on every pooled connection drains them so
+    # scenario E's one-shot fault budget cannot be consumed by stragglers
+    _drive(rep_a, wave[:4])
+    _drive(rep_b, wave[:4])
+
+    # ---- E: torn response frame -> one shed, no poisoned state ----------
+    _set_plan("wire_response@kind=net_torn_frame:times=1")
+    res = rep_a.submit(dict(wave[0])).result(timeout=WAVE_TIMEOUT_S)
+    assert res.shed and res.reason == "connection_lost", (
+        f"E: torn frame must shed connection_lost, got {res.reason}"
+    )
+    _set_plan("")
+    _await_recovery(rep_a, wave[0], "E post-torn-frame")
+    follow = _drive(rep_a, wave)
+    assert not any(r.shed for r in follow), "E: post-torn wave must serve"
+    print("wire E ok: torn frame shed exactly one request, link recovered")
+
+    # ---- F: per-remote breaker opens, fails fast, probe recovers --------
+    port_a = server_a.port
+    server_a.kill()
+    svc_a2.close()
+    deadline = time.monotonic() + 20
+    while rep_a.breaker.state != "open" and time.monotonic() < deadline:
+        rep_a.submit(dict(wave[0])).result(timeout=WAVE_TIMEOUT_S)
+        time.sleep(0.02)
+    assert rep_a.breaker.state == "open", "F: breaker must open"
+    t0 = time.monotonic()
+    fast = [
+        rep_a.submit(dict(r)).result(timeout=WAVE_TIMEOUT_S) for r in wave
+    ]
+    assert time.monotonic() - t0 < 2.0, "F: open breaker must fail FAST"
+    assert all(r.shed for r in fast)
+    assert any(r.reason == "breaker_open" for r in fast), (
+        f"F: expected breaker_open sheds, got {sorted({r.reason for r in fast})}"
+    )
+    svc_a3 = LinkageService(
+        QueryEngine(load_index(idx_path)), deadline_ms=None, name="host-a"
+    )
+    svc_a3.engine.warmup()
+    server_a = WireServer(svc_a3, port=port_a, name="host-a").start()
+    _await_recovery(rep_a, wave[0], "F breaker recovery")
+    assert rep_a.breaker.state == "closed", "F: handshake must close breaker"
+    print("wire F ok: breaker opened, failed fast, reconnect probe closed it")
+
+    # ---- steady state: zero recompiles after all that chaos -------------
+    c0 = compile_requests()
+    steady = _drive(router, records[:40])
+    assert not any(r.shed for r in steady), "steady-state wave must serve"
+    c1 = compile_requests()
+    assert c1 - c0 == 0, (
+        f"steady state performed {c1 - c0} recompile(s) post-recovery"
+    )
+    print("wire steady-state ok: 40 queries, 0 recompiles")
+
+    for closer in (rep_a, rep_b, router, hedged):
+        closer.close()
+    server_a.kill()
+    server_b.close()
+    svc_a3.close()
+    svc_b.close()
+
+    # ---- the JSONL record must tell the whole story ---------------------
+    sink.close()
+    events = read_events(events_path)
+    by_type = {}
+    for e in events:
+        by_type[e.get("type")] = by_type.get(e.get("type"), 0) + 1
+    for expected in ("wire_connect", "wire_disconnect", "wire_shed",
+                     "wire_reconnect", "wire_partition_heal", "fault"):
+        assert by_type.get(expected), (
+            f"missing {expected} events in the JSONL record: {by_type}"
+        )
+    sheds = [e for e in events if e.get("type") == "wire_shed"]
+    assert all(e.get("reason") for e in sheds), "sheds must carry reasons"
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(
+        "wire-chaos-smoke OK: 6 scenarios, every future resolved, no "
+        "exception escaped, events recorded: "
+        + ", ".join(f"{k}={v}" for k, v in sorted(by_type.items())
+                    if k and k.startswith("wire_") or k == "fault")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -374,6 +374,44 @@ def summarize_events(events: list[dict]) -> str:
                     f"{a.get('long_window_s') or 0}s"
                 )
 
+    # ---- wire tier (serve/wire.py + serve/remote.py) ---------------------
+    wire_types = ("wire_connect", "wire_disconnect", "wire_reconnect",
+                  "wire_shed", "wire_partition_heal")
+    wire = [e for e in events if e.get("type") in wire_types]
+    if wire:
+        counts = {t: sum(1 for e in wire if e["type"] == t)
+                  for t in wire_types}
+        lines.append("")
+        lines.append(
+            f"wire tier: {counts['wire_connect']} connect(s), "
+            f"{counts['wire_disconnect']} disconnect(s), "
+            f"{counts['wire_reconnect']} reconnect(s), "
+            f"{counts['wire_shed']} shed burst(s), "
+            f"{counts['wire_partition_heal']} partition heal(s)"
+        )
+        # sheds aggregate per (replica, reason); n is or-0 against torn
+        # records (a shed burst with n genuinely 0 is never emitted)
+        shed_by: dict = {}
+        for ev in wire:
+            if ev["type"] == "wire_shed":
+                key = (ev.get("replica") or "?", ev.get("reason") or "?")
+                shed_by[key] = shed_by.get(key, 0) + (_or0(ev.get("n")) or 0)
+        for (replica, reason), n in sorted(shed_by.items()):
+            lines.append(f"  shed {replica}: {n} x {reason}")
+        for ev in wire:
+            if ev["type"] == "wire_reconnect":
+                lines.append(
+                    f"  reconnect {ev.get('replica') or '?'}: "
+                    f"{_or0(ev.get('attempts'))} attempt(s), "
+                    f"{_or0(ev.get('downtime_s'))}s down"
+                )
+            elif ev["type"] == "wire_partition_heal":
+                lines.append(
+                    f"  partition heal {ev.get('server') or '?'}: "
+                    f"{_or0(ev.get('duration_s'))}s, "
+                    f"{_or0(ev.get('dropped'))} connection(s) dropped"
+                )
+
     # ---- resilience events ----------------------------------------------
     # serve-tier events (health transitions, breaker state changes, index
     # hot-swaps, worker restarts, brown-out boundaries, drift alerts)

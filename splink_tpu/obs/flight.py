@@ -62,6 +62,10 @@ TRANSITION_TYPES = (
     "perf_alert",
     "perf_clear",
     "perf_window",
+    # a completed background reconnect is a link-state transition: the
+    # incident ring must show when a remote came back, not just the
+    # sheds while it was gone (serve/remote.py)
+    "wire_reconnect",
 )
 
 _RECORDERS: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()

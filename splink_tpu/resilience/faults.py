@@ -18,6 +18,8 @@ Plan grammar (comma-separated events)::
     resident_em@kind=oom                  device OOM entering the resident path
     segment@iter=10:kind=transient        error at a segmented-EM boundary
     serve_batch@batch=1:kind=slow:delay_ms=400   stall one serve batch 400ms
+    wire_response@kind=net_torn_frame     cut one wire reply mid-frame
+    wire_accept@kind=net_partition:delay_ms=500  drop + refuse conns 500ms
 
 Sites are the hook names the execution stack calls (`fire`); ``iter`` /
 ``batch`` constrain when the event matches (omitted = any). ``times``
@@ -75,7 +77,10 @@ logger = logging.getLogger("splink_tpu")
 
 ENV_VAR = "SPLINK_TPU_FAULTS"
 
-_KINDS = ("transient", "oom", "kill", "slow")
+_KINDS = (
+    "transient", "oom", "kill", "slow",
+    "net_drop", "net_delay", "net_torn_frame", "net_partition",
+)
 
 DEFAULT_SLOW_DELAY_MS = 250
 
@@ -88,6 +93,23 @@ SERVE_SITES = ("serve_worker", "serve_batch", "swap_load", "swap_validate")
 # the spill emission driver and the out-of-core index build.
 BUILD_SITES = ("emit_segment", "build_chunk")
 
+# The wire-tier injection points (serve/wire.py; exercised end to end by
+# ``scripts/wire_chaos_smoke.py`` / ``make wire-smoke``). The net_* kinds
+# model link failures rather than compute failures:
+#
+#     net_drop        the connection dies abruptly at the site (server
+#                     closes the socket with no reply; the client must
+#                     resolve every in-flight future as a shed)
+#     net_delay       the link stalls delay_ms then continues — drives the
+#                     hedger and deadline propagation, like kind=slow
+#     net_torn_frame  a frame is cut mid-write (length prefix promises
+#                     more bytes than arrive) — the reader must reject it
+#                     without poisoning the connection state
+#     net_partition   the host becomes unreachable for delay_ms: every
+#                     live connection drops AND new connects are refused
+#                     until the partition heals
+WIRE_SITES = ("wire_accept", "wire_request", "wire_response")
+
 
 class InjectedFault(RuntimeError):
     """A deliberately injected failure.
@@ -98,10 +120,16 @@ class InjectedFault(RuntimeError):
     message for transient).
     """
 
-    def __init__(self, site: str, kind: str, coords: dict):
+    def __init__(
+        self, site: str, kind: str, coords: dict,
+        delay_ms: int = DEFAULT_SLOW_DELAY_MS,
+    ):
         self.site = site
         self.kind = kind
         self.coords = dict(coords)
+        # net_partition repurposes delay_ms as the partition duration; the
+        # wire server reads it off the caught fault to schedule the heal
+        self.delay_ms = delay_ms
         marker = (
             "RESOURCE_EXHAUSTED: injected device OOM"
             if kind == "oom"
@@ -191,7 +219,7 @@ class FaultPlan:
                 from ..obs.events import publish
 
                 publish("fault", site=site, kind=ev.kind, coords=dict(coords))
-                if ev.kind == "slow":
+                if ev.kind in ("slow", "net_delay"):
                     logger.warning(
                         "fault injection: stalling %s %s for %dms",
                         site, coords, ev.delay_ms,
@@ -203,7 +231,7 @@ class FaultPlan:
                         "fault injection: SIGKILL self at %s %s", site, coords
                     )
                     os.kill(os.getpid(), signal.SIGKILL)
-                raise InjectedFault(site, ev.kind, coords)
+                raise InjectedFault(site, ev.kind, coords, ev.delay_ms)
 
 
 # One live plan per spec string: event budgets (``times``) must be shared

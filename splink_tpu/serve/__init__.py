@@ -27,6 +27,15 @@ hot-swap with parity probes and rollback
 worker-thread death. ``make chaos-smoke`` drives every registered serve
 fault site against those guarantees.
 
+Multi-host (docs/serving.md#multi-host): :class:`WireServer` puts a
+service behind a stdlib-only length-prefixed TCP protocol and
+:class:`RemoteReplica` wraps the far end back into the :class:`Replica`
+duck-type, so the SAME router routes, hedges and fails over across hosts
+— connection pools with bounded-backoff reconnect, per-remote circuit
+breakers, deadline propagation and piggybacked health included. ``make
+wire-smoke`` drives the network fault kinds (drop, delay, torn frame,
+partition) against the same no-hang / no-escape guarantees.
+
 See docs/serving.md for the artifact format, bucket policy and latency
 tuning knobs, and ``python -m splink_tpu.serve`` for the CLI.
 """
@@ -45,8 +54,16 @@ from .index import (
     build_index,
     load_index,
 )
-from .router import ReplicaRouter
+from .remote import RemoteReplica
+from .router import Replica, ReplicaRouter
 from .service import LinkageService, QueryResult
+from .wire import (
+    CorruptFrame,
+    FrameTooLarge,
+    TornFrame,
+    WireError,
+    WireServer,
+)
 
 __all__ = [
     "AotStore",
@@ -64,7 +81,14 @@ __all__ = [
     "load_index",
     "LinkageService",
     "QueryResult",
+    "Replica",
     "ReplicaRouter",
+    "RemoteReplica",
+    "WireServer",
+    "WireError",
+    "FrameTooLarge",
+    "TornFrame",
+    "CorruptFrame",
     "HealthMonitor",
     "HEALTHY",
     "DEGRADED",

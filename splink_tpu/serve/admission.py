@@ -47,11 +47,16 @@ class CircuitBreaker:
     Thread-safe: the worker, the watchdog probe and ``health()`` all read
     it."""
 
-    def __init__(self, threshold: int = 3, cooldown_s: float = 1.0):
+    def __init__(
+        self, threshold: int = 3, cooldown_s: float = 1.0, name: str = ""
+    ):
         if threshold < 1:
             raise ValueError("breaker threshold must be >= 1")
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
+        # which breaker this is (the wire tier runs one per remote next
+        # to the engine's own; snapshots must say whose state they are)
+        self.name = str(name)
         self._lock = threading.Lock()
         self._state = BREAKER_CLOSED
         self._failures = 0
@@ -115,6 +120,7 @@ class CircuitBreaker:
     def snapshot(self) -> dict:
         with self._lock:
             return {
+                **({"name": self.name} if self.name else {}),
                 "state": self._state,
                 "consecutive_failures": self._failures,
                 "opened_total": self.opened_total,

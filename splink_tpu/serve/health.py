@@ -195,3 +195,14 @@ class HealthMonitor:
 def health_rank(state: str) -> int:
     """healthy=0 < degraded=1 < broken=2 (router ordering key)."""
     return _RANK.get(state, _RANK[BROKEN])
+
+
+def worse(a: str, b: str) -> str:
+    """The sicker of two states (max by rank; unknown reads as broken).
+
+    The wire tier folds two views into one replica state with it: the
+    remote's piggybacked self-assessment and the local link view — a
+    healthy host behind a dead link is still unreachable, and a reachable
+    host that reports degraded must not be promoted by the link being
+    fine (:class:`~.remote.RemoteReplica.health_state`)."""
+    return a if health_rank(a) >= health_rank(b) else b

@@ -31,12 +31,53 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from typing import Protocol, runtime_checkable
 
 from .health import health_rank
 
 logger = logging.getLogger("splink_tpu")
 
 _DEFAULT_HEDGE_FLOOR_MS = 20.0
+
+
+@runtime_checkable
+class Replica(Protocol):
+    """The replica duck-type, pinned.
+
+    Three implementations ride this shape and must not drift apart:
+    :class:`~.service.LinkageService` (in-process),
+    :class:`~.remote.RemoteReplica` (another host over the wire tier),
+    and the test fakes the router's unit suite drives failover with —
+    ``tests/test_serve_resilience.py`` asserts conformance for all three.
+
+    The contract behind the signatures:
+
+    * ``submit`` NEVER raises and the returned future ALWAYS resolves —
+      with a :class:`~.service.QueryResult`, shed results carrying a
+      machine-readable ``reason``. (The router treats a raising replica
+      as a shed, but that is a mercy, not a licence.)
+    * ``health_state`` is a cheap property (``healthy`` / ``degraded`` /
+      ``broken``) read on every routing decision — no locks held long,
+      no I/O.
+    * ``latency_summary()`` reports at least ``p95_ms`` once it has
+      samples (the hedger's trigger delay keys on it).
+
+    Two optional members extend the shape without breaking it: a
+    truthy class attribute ``accepts_trace`` admits the router-minted
+    ``trace=`` keyword on submit, and ``close()`` lets
+    :meth:`ReplicaRouter.close` tear the replica down.
+    """
+
+    def submit(self, record: dict, deadline_ms: float | None = None):
+        """-> Future[QueryResult]; never raises, always resolves."""
+        ...  # pragma: no cover - Protocol signature
+
+    @property
+    def health_state(self) -> str:
+        ...  # pragma: no cover - Protocol signature
+
+    def latency_summary(self) -> dict:
+        ...  # pragma: no cover - Protocol signature
 
 
 class ReplicaRouter:

@@ -111,6 +111,36 @@ class QueryResult:
     approx: bool = False
     reason: str | None = None
 
+    # -- wire round-trip (serve/wire.py envelope "result" field) --------
+    # JSON float serialisation is exact (repr round-trips every double),
+    # so a result that crosses the wire is bit-identical to the local one
+    # — the parity contract make wire-smoke asserts.
+
+    def to_payload(self) -> dict:
+        return {
+            "matches": [[uid, p] for uid, p in self.matches],
+            "n_candidates": int(self.n_candidates),
+            "shed": bool(self.shed),
+            "latency_ms": self.latency_ms,
+            "degraded": bool(self.degraded),
+            "approx": bool(self.approx),
+            "reason": self.reason,
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict) -> "QueryResult":
+        return cls(
+            matches=[
+                (m[0], m[1]) for m in (payload.get("matches") or [])
+            ],
+            n_candidates=int(payload.get("n_candidates") or 0),
+            shed=bool(payload.get("shed")),
+            latency_ms=payload.get("latency_ms"),
+            degraded=bool(payload.get("degraded")),
+            approx=bool(payload.get("approx")),
+            reason=payload.get("reason"),
+        )
+
 
 class LinkageService:
     """Micro-batching query front-end over a :class:`~.engine.QueryEngine`

@@ -94,6 +94,10 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "serve_trace_sample_rate",
     "obs_exposition_port",
     "obs_flight_records",
+    "wire_port",
+    "wire_connect_timeout_ms",
+    "wire_max_frame_bytes",
+    "wire_remote_hosts",
     "quality_profile",
     "drift_sketch_bins",
     "drift_window_s",

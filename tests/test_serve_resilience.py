@@ -36,8 +36,11 @@ from splink_tpu.serve import (
     LinkageService,
     QueryEngine,
     QueryResult,
+    RemoteReplica,
+    Replica,
     ReplicaRouter,
     WaitEstimator,
+    WireServer,
     build_index,
 )
 from splink_tpu.utils.logging_utils import DegradationWarning
@@ -363,6 +366,34 @@ def _service(engine, **over):
               breaker_cooldown_s=0.2)
     kw.update(over)
     return LinkageService(engine, **kw)
+
+
+def test_replica_protocol_conformance(engine):
+    """Everything the router routes over satisfies the Replica Protocol —
+    the local service, the wire-tier remote client, and the test fakes —
+    structurally (isinstance via runtime_checkable) AND behaviourally
+    (submit returns a Future resolving to a QueryResult; health_state is
+    a known rank; latency_summary carries the p95_ms the hedger reads)."""
+    svc = _service(engine, deadline_ms=None)
+    server = WireServer(svc).start()
+    remote = RemoteReplica(("127.0.0.1", server.port), pool_size=1)
+    fake = FakeReplica("fake")
+    try:
+        record = {"first_name": "amelia", "surname": "smith", "dob": "1970"}
+        for rep in (svc, remote, fake):
+            assert isinstance(rep, Replica), type(rep).__name__
+            fut = rep.submit(dict(record), deadline_ms=None)
+            res = fut.result(timeout=WAIT)
+            assert isinstance(res, QueryResult)
+            assert not res.shed, (type(rep).__name__, res.reason)
+            assert rep.health_state in (HEALTHY, DEGRADED, BROKEN)
+            assert "p95_ms" in rep.latency_summary()
+        # a bare object is not mistaken for a replica
+        assert not isinstance(object(), Replica)
+    finally:
+        remote.close()
+        server.close()
+        svc.close()
 
 
 def test_warmup_covers_brownout_shapes(trained):

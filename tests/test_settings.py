@@ -519,3 +519,45 @@ def test_perf_observatory_key_types_validated():
             validate_settings(_minimal(**bad))
     # valid values pass (perf_alert_ratio=0 disables the watch entirely)
     validate_settings(_minimal(perf_alert_ratio=0, perf_window_s=2.5))
+
+
+def test_wire_defaults_filled():
+    """The wire-tier keys complete from the schema: no wire serving by
+    default (port 0), a 500 ms dial budget, a 4 MiB frame cap and no
+    remote hosts."""
+    s = complete_settings_dict(_minimal())
+    assert s["wire_port"] == 0
+    assert s["wire_connect_timeout_ms"] == 500
+    assert s["wire_max_frame_bytes"] == 4 * 1024 * 1024
+    assert s["wire_remote_hosts"] == []
+
+
+def test_wire_key_types_validated():
+    """Type/bound violations on the wire-tier keys are rejected by the
+    schema validator (the established key-validation pattern)."""
+    for bad in (
+        {"wire_port": -1},
+        {"wire_port": 65536},
+        {"wire_port": "auto"},
+        {"wire_port": 8080.5},
+        {"wire_connect_timeout_ms": 0},
+        {"wire_connect_timeout_ms": -200},
+        {"wire_connect_timeout_ms": "fast"},
+        {"wire_max_frame_bytes": 4095},
+        {"wire_max_frame_bytes": "4MB"},
+        {"wire_max_frame_bytes": 1.5},
+        {"wire_remote_hosts": "host:9000"},
+        {"wire_remote_hosts": [9000]},
+        {"wire_remote_hosts": [["host", 9000]]},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    # valid values pass (the timeout is a number: floats allowed)
+    validate_settings(
+        _minimal(
+            wire_port=9400,
+            wire_connect_timeout_ms=250.5,
+            wire_max_frame_bytes=65536,
+            wire_remote_hosts=["10.0.0.2:9400", "10.0.0.3:9400"],
+        )
+    )
