@@ -1,19 +1,24 @@
-.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke wire-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke wire-smoke thread-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
 	python -m pytest tests/ -x -q
 
-# Static analysis gate: jaxlint AST pass over the package + jaxpr audit of
-# the kernel registry + SPMD partition-safety audit of the shard registry
-# on the forced 8-virtual-device CPU mesh (splink_tpu/analysis/). Exit 1 on
-# any unsuppressed finding, undeclared collective, or cost-budget drift;
-# tests/test_codebase_clean.py enforces the same gate in tier-1. (The CLI
-# pins JAX_PLATFORMS/XLA_FLAGS itself for --shard-audit; set here too so
-# the whole invocation — including the jaxpr audit — runs the same config.)
+# Static analysis gate — all five layers (splink_tpu/analysis/):
+#   1  jaxlint      AST pass over the package (JL001-JL012)
+#   2  trace audit  jaxpr audit of the kernel registry
+#   3  shard audit  SPMD partition-safety + cost budgets on the 8-device mesh
+#   4  perf audit   measured runtime/memory budgets (--list-perf-kernels here;
+#                   the measured gate runs in perf-smoke)
+#   5  threadlint   concurrency-safety audit of the serve/obs thread fleet
+#                   (TL001-TL005; dynamic half: thread-smoke)
+# Exit 1 on any unsuppressed finding, undeclared collective, cost-budget
+# drift, or thread-safety hazard; tests/test_codebase_clean.py enforces the
+# same gates in tier-1. (The CLI pins JAX_PLATFORMS/XLA_FLAGS itself for
+# --shard-audit; set here too so the whole invocation runs the same config.)
 lint:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-		python -m splink_tpu.analysis splink_tpu/ --audit --shard-audit
+		python -m splink_tpu.analysis splink_tpu/ --audit --shard-audit --thread-audit
 	JAX_PLATFORMS=cpu python -m splink_tpu.analysis --list-perf-kernels
 
 # Intentional refresh of the committed per-kernel cost/collective budgets
@@ -71,6 +76,19 @@ chaos-smoke:
 # (docs/serving.md#multi-host).
 wire-smoke:
 	python scripts/wire_chaos_smoke.py
+
+# Thread-safety smoke: the dynamic half of analysis layer 5. Every fleet
+# lock is created through the lockwatch instrumented factories
+# (SPLINK_TPU_LOCKWATCH=1), sys.setswitchinterval is lowered ~1000x, and
+# a real engine + service + wire server + hedged router fleet is driven
+# by concurrent submit threads, stats/health pollers and injected
+# connection drops. Gates: a seeded A->B/B->A inversion IS detected
+# (lock_inversion event + flight dump + lock_order_graph.json artifact),
+# the real fleet shows ZERO inversions, the observed-union-declared lock
+# graph stays acyclic, every future resolves, counters stay consistent,
+# and steady state performs ZERO recompiles (docs/static_analysis.md#layer-5).
+thread-smoke:
+	python scripts/thread_smoke.py
 
 # Device-blocking smoke: device<->host pair-set parity (the host join is
 # the oracle) over sequential/null/asymmetric rules with budgeted chunked
@@ -151,4 +169,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke wire-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench
+all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke wire-smoke thread-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench

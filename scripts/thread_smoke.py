@@ -1,0 +1,384 @@
+"""Thread-safety smoke (`make thread-smoke`): the serve/obs thread fleet
+under instrumented locks, contended scheduling, and injected faults.
+
+Dynamic half of analysis layer 5 (static half: ``python -m
+splink_tpu.analysis --thread-audit``). Every lock in the registered fleet
+is created through :mod:`splink_tpu.analysis.lockwatch` (env
+``SPLINK_TPU_LOCKWATCH`` is set before any import below), so the smoke
+observes the REAL acquisition order the fleet exhibits under load, with
+``sys.setswitchinterval`` lowered ~1000x and per-acquire jitter to drive
+the scheduler into the interleavings a quiet CI run never hits.
+
+Phases:
+
+  0  static gate          -> the registered fleet audits clean and its
+                             declared lock graph is acyclic
+  1  seeded inversion     -> two scratch locks acquired in opposite
+                             orders: lockwatch must detect the cycle,
+                             publish a `lock_inversion` event, trip a
+                             flight-recorder dump, and the
+                             lock_order_graph.json artifact must carry
+                             the inversion (falsifiability: the detector
+                             detects)
+  2  fleet storm          -> a real engine + service + wire server +
+                             RemoteReplica + hedged ReplicaRouter driven
+                             by concurrent submit threads, stats/health/
+                             Prometheus pollers and injected connection
+                             drops. Gates: every future resolves (no
+                             deadlock), ZERO observed inversions, the
+                             observed-union-static lock graph stays
+                             acyclic, counters stay consistent
+                             (served + shed == submitted on the direct
+                             service; every router result accounted),
+                             and steady state performs ZERO recompiles.
+
+Publishes one `thread_audit` summary event and renders the event log
+through `obs summarize` (the satellite rendering contract). Exits
+nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Before ANY splink_tpu import: lockwatch instruments at lock CREATION.
+os.environ["SPLINK_TPU_LOCKWATCH"] = "1"
+os.environ.setdefault("SPLINK_TPU_LOCKWATCH_JITTER_US", "50")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WAVE_TIMEOUT_S = 60
+
+
+def _settings():
+    return {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.surname = r.surname"],
+        "max_iterations": 4,
+        "serve_top_k": 32,
+        "serve_query_buckets": [16, 64],
+        "serve_candidate_buckets": [64, 256],
+        "serve_probe_queries": 8,
+        "serve_queue_depth": 512,
+    }
+
+
+def _corpus(n=160, seed=11):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    return pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+
+
+def _await(predicate, what, budget_s=10.0):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
+    import json
+    import warnings
+
+    from splink_tpu import Splink
+    from splink_tpu.analysis import lockwatch
+    from splink_tpu.analysis.threadlint import graph_cycles, run_thread_audit
+    from splink_tpu.obs.cli import summarize_events
+    from splink_tpu.obs.events import (
+        EventSink,
+        publish,
+        read_events,
+        register_ambient,
+    )
+    from splink_tpu.obs.flight import FlightRecorder
+    from splink_tpu.obs.metrics import (
+        compile_requests,
+        install_compile_monitor,
+    )
+    from splink_tpu.resilience import faults
+    from splink_tpu.resilience.retry import RetryPolicy
+    from splink_tpu.serve import (
+        LinkageService,
+        QueryEngine,
+        RemoteReplica,
+        ReplicaRouter,
+        WireServer,
+        load_index,
+    )
+
+    install_compile_monitor()
+    warnings.simplefilter("ignore")
+    faults.reset_plans()
+    os.environ.pop(faults.ENV_VAR, None)
+    tmp = tempfile.mkdtemp(prefix="splink_thread_smoke_")
+    events_path = os.path.join(tmp, "thread_events.jsonl")
+    sink = EventSink(events_path, run_id="thread-smoke")
+    register_ambient(sink)
+
+    # ---- 0: static gate -------------------------------------------------
+    findings, audited, static_graph = run_thread_audit()
+    assert not findings, "\n".join(f.format() for f in findings)
+    assert graph_cycles(static_graph) == []
+    print(
+        f"thread 0 ok: {audited} classes audit clean, static graph "
+        f"acyclic ({len(static_graph['edges'])} declared edges)"
+    )
+
+    # ---- 1: seeded inversion (the detector must detect) -----------------
+    recorder = FlightRecorder(
+        capacity=64, dump_dir=os.path.join(tmp, "flight"),
+        name="thread-smoke", min_dump_interval_s=0.0,
+    )
+    register_ambient(recorder)
+    lockwatch.reset()
+    a = lockwatch.new_lock("SeededA.lock")
+    b = lockwatch.new_lock("SeededB.lock")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # opposite order: the seeded latent deadlock
+            pass
+    inv = lockwatch.inversions()
+    assert len(inv) == 1, f"seeded inversion not detected: {inv}"
+    assert any(
+        {"SeededA.lock", "SeededB.lock"} <= set(c)
+        for c in lockwatch.cycles()
+    ), "seeded cycle missing from the observed graph"
+    # the inversion publishes from a fresh thread -> poll for the event
+    # in the sink and the triggered flight dump
+    _await(
+        lambda: any(
+            e.get("type") == "lock_inversion" for e in read_events(events_path)
+        ),
+        "lock_inversion event in the sink",
+    )
+    _await(lambda: recorder.dumps, "flight dump on lock_inversion")
+    graph_path = os.path.join(tmp, "flight", "lock_order_graph.json")
+    lockwatch.dump_graph(graph_path, static_edges=static_graph["edges"])
+    with open(graph_path, encoding="utf-8") as fh:
+        artifact = json.load(fh)
+    assert artifact["inversions"], "artifact must carry the inversion"
+    assert artifact["union_cycles"], "artifact must carry the cycle"
+    print(
+        "thread 1 ok: seeded inversion detected, lock_inversion event + "
+        f"flight dump fired, artifact at {graph_path}"
+    )
+    recorder.close()
+    lockwatch.reset()  # scratch edges must not pollute the fleet gate
+
+    # ---- 2: fleet storm -------------------------------------------------
+    df = _corpus()
+    linker = Splink(_settings(), df=df)
+    linker.estimate_parameters()
+    idx_path = os.path.join(tmp, "idx")
+    linker.export_index(idx_path)
+
+    def _stack(name):
+        engine = QueryEngine(load_index(idx_path))
+        engine.warmup()
+        svc = LinkageService(engine, deadline_ms=None, name=name)
+        server = WireServer(svc, name=name).start()
+        return svc, server
+
+    svc_a, server_a = _stack("host-a")
+    svc_b, server_b = _stack("host-b")
+
+    def _remote(server):
+        return RemoteReplica(
+            ("127.0.0.1", server.port),
+            pool_size=2,
+            retry_policy=RetryPolicy(base_delay=0.05, max_delay=0.5),
+            breaker_threshold=4,
+            breaker_cooldown_s=0.2,
+            connect_timeout_ms=500.0,
+            request_timeout_ms=WAVE_TIMEOUT_S * 1000.0,
+        )
+
+    rep_a, rep_b = _remote(server_a), _remote(server_b)
+    router = ReplicaRouter([rep_a, rep_b], hedge_ms=30.0)
+    records = df.head(120).to_dict(orient="records")
+
+    # one clean warm wave so steady state is established before the storm
+    warm = [router.submit(dict(r)) for r in records[:20]]
+    assert all(
+        not f.result(timeout=WAVE_TIMEOUT_S).shed for f in warm
+    ), "warm wave shed"
+
+    # inject occasional connection drops so the storm also exercises the
+    # conn-lost / reconnect / failover lock paths
+    faults.reset_plans()
+    os.environ[faults.ENV_VAR] = "wire_request@kind=net_drop:times=3"
+
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)  # ~1000x more preemption points
+    errors: list = []
+    results: list = []
+    res_lock = threading.Lock()
+    stop = threading.Event()
+    baseline_compiles = None  # set after the first storm wave settles
+
+    def storm_router(k):
+        try:
+            futs = [router.submit(dict(r)) for r in records]
+            out = [f.result(timeout=WAVE_TIMEOUT_S) for f in futs]
+            with res_lock:
+                results.extend(out)
+        except Exception as e:  # noqa: BLE001 - the gate is "no exception escapes"
+            errors.append(("router", k, e))
+
+    n_direct = 200
+
+    def storm_direct():
+        try:
+            futs = [
+                svc_a.submit(dict(records[i % len(records)]))
+                for i in range(n_direct)
+            ]
+            for f in futs:
+                f.result(timeout=WAVE_TIMEOUT_S)
+        except Exception as e:  # noqa: BLE001
+            errors.append(("direct", 0, e))
+
+    def poller():
+        try:
+            while not stop.is_set():
+                svc_a.health()
+                svc_b.latency_summary()
+                svc_a.prometheus_samples()
+                server_a.stats()
+                server_b.prometheus_samples()
+                rep_a.health_state
+                rep_b.latency_summary()
+                router.health()
+                time.sleep(0.002)
+        except Exception as e:  # noqa: BLE001
+            errors.append(("poller", 0, e))
+
+    direct_before = svc_a.latency_summary()
+    threads = (
+        [threading.Thread(target=storm_router, args=(k,)) for k in range(3)]
+        + [threading.Thread(target=storm_direct)]
+        + [threading.Thread(target=poller, daemon=True) for _ in range(2)]
+    )
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        if not t.daemon:
+            t.join(timeout=WAVE_TIMEOUT_S * 3)
+            assert not t.is_alive(), "storm thread hung: deadlock"
+    stop.set()
+    wall = time.monotonic() - t0
+    sys.setswitchinterval(old_interval)
+    faults.reset_plans()
+    os.environ.pop(faults.ENV_VAR, None)
+
+    assert not errors, f"exceptions escaped the storm: {errors}"
+    assert len(results) == 3 * len(records), "router futures lost"
+    served = sum(1 for r in results if not r.shed)
+    shed = len(results) - served
+    assert served > 0, "storm served nothing"
+    for r in results:
+        assert not r.shed or r.reason, "shed without a machine-readable reason"
+    print(
+        f"thread 2 storm ok: {len(results)} routed ({served} served, "
+        f"{shed} shed) + {n_direct} direct in {wall:.1f}s, no hang"
+    )
+
+    # counter consistency: the direct service accounts for every submit
+    direct_after = svc_a.latency_summary()
+    d_served = direct_after["served"] - direct_before["served"]
+    d_shed = direct_after["shed"] - direct_before["shed"]
+    assert d_served + d_shed >= n_direct, (
+        f"counter drift: {d_served} served + {d_shed} shed < {n_direct} "
+        "submitted (a torn counter under contention)"
+    )
+    # router accounting: every dispatch is a dispatch, hedges included
+    rh = router.health()
+    assert rh["dispatched"] >= 3 * len(records)
+    assert rh["hedge_wins"] <= rh["hedges"] <= rh["dispatched"]
+
+    # no inversion, and the union of observed + declared order is acyclic
+    inv = lockwatch.inversions()
+    assert not inv, f"lock inversion under storm: {inv}"
+    union_cycles = lockwatch.cycles(extra_edges=static_graph["edges"])
+    assert union_cycles == [], (
+        f"observed order contradicts the declared graph: {union_cycles}"
+    )
+    observed = lockwatch.observed_graph()
+    print(
+        f"thread 2 graph ok: {len(observed['edges'])} observed edges over "
+        f"{len(observed['nodes'])} locks, 0 inversions, union acyclic"
+    )
+
+    # zero steady-state recompiles: a post-storm wave compiles nothing
+    baseline_compiles = compile_requests()
+    settle = [router.submit(dict(r)) for r in records[:30]]
+    assert all(
+        not f.result(timeout=WAVE_TIMEOUT_S).shed for f in settle
+    ), "post-storm wave shed"
+    assert compile_requests() == baseline_compiles, (
+        "steady-state serving recompiled under the thread storm"
+    )
+    print("thread 2 compile ok: 0 steady-state compile requests")
+
+    # artifact + summary event + rendering contract
+    lockwatch.dump_graph(
+        os.path.join(tmp, "lock_order_graph.json"),
+        static_edges=static_graph["edges"],
+    )
+    publish(
+        "thread_audit",
+        classes=audited,
+        findings=0,
+        observed_edges=len(observed["edges"]),
+        inversions=0,
+        cycles=0,
+        storm_wall_s=round(wall, 2),
+    )
+    for target in (rep_a, rep_b, router, server_a, server_b, svc_a, svc_b):
+        target.close()
+    sink.close()
+    events = read_events(events_path)
+    rendered = summarize_events(events)
+    assert "lock inversion" in rendered and "thread audit" in rendered, (
+        "obs summarize must render the concurrency section"
+    )
+    print("thread 3 ok: thread_audit event published, summarize renders:")
+    print("  " + next(
+        ln for ln in rendered.splitlines() if ln.startswith("concurrency")
+    ))
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    print("THREAD SMOKE PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

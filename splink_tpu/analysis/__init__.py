@@ -1,6 +1,7 @@
-"""Static-analysis + jaxpr/SPMD-audit + measured-perf framework gating CI.
+"""Static-analysis + jaxpr/SPMD-audit + measured-perf + concurrency-audit
+framework gating CI.
 
-Four layers, one finding model:
+Five layers, one finding model:
 
   * :mod:`.jaxlint` — AST lint pass over JAX hazard classes (host calls and
     syncs on traced values, Python branches on tracers, unpinned dtypes,
@@ -18,10 +19,17 @@ Four layers, one finding model:
     registered kernel at 1-3 shapes and gates compile/execute wall +
     memory against committed per-``(tier, kernel, shape)`` baselines
     (``perf_baselines.json``; one-sided bands, median-of-K noise guard).
+  * :mod:`.threadlint` — concurrency-safety audit of the registered
+    serve/obs thread-fleet classes (mixed-guard attribute access, blocking
+    calls and callback escapes under locks, lock-order cycles, thread
+    lifecycle), with ``# threadlint: disable=RULE`` suppressions; its
+    dynamic half is :mod:`.lockwatch` (opt-in instrumented locks recording
+    the observed acquisition order, gated by ``make thread-smoke``).
 
 CLI: ``python -m splink_tpu.analysis splink_tpu/ [--audit] [--shard-audit]
-[--perf-audit] [--json]``; ``make lint`` runs the static layers (plus the
-perf-plan listing), ``make perf-smoke`` runs the measured layer, and
+[--perf-audit] [--thread-audit] [--json]``; ``make lint`` runs the static
+layers (plus the perf-plan listing), ``make perf-smoke`` runs the measured
+layer, ``make thread-smoke`` the dynamic lock-order gate, and
 tests/test_codebase_clean.py gates tier-1 on a clean static run.
 """
 
@@ -35,6 +43,14 @@ from .shard_audit import (
     register_shard_kernel,
     run_shard_audit,
     update_baselines,
+)
+from .threadlint import (
+    THREAD_REGISTRY,
+    TL_RULES,
+    audit_source,
+    build_lock_graph,
+    graph_cycles,
+    run_thread_audit,
 )
 from .trace_audit import REGISTRY, audit_kernel, register_kernel, run_audit
 
@@ -56,4 +72,10 @@ __all__ = [
     "update_baselines",
     "perf_plan",
     "run_perf_audit",
+    "THREAD_REGISTRY",
+    "TL_RULES",
+    "audit_source",
+    "build_lock_graph",
+    "graph_cycles",
+    "run_thread_audit",
 ]

@@ -94,6 +94,23 @@ def main(argv=None) -> int:
         "perf_baselines.json (implies --perf-audit)",
     )
     parser.add_argument(
+        "--thread-audit",
+        action="store_true",
+        help="also run the concurrency-safety audit (layer 5) over the "
+        "registered thread-fleet classes (pure AST, no backend)",
+    )
+    parser.add_argument(
+        "--thread-classes",
+        help="comma-separated class names to thread-audit (implies "
+        "--thread-audit)",
+    )
+    parser.add_argument(
+        "--lock-graph",
+        metavar="PATH",
+        help="write the static lock-order acquisition graph as JSON "
+        "(implies --thread-audit)",
+    )
+    parser.add_argument(
         "--list-perf-kernels",
         action="store_true",
         help="print the perf-audit measurement plan (kernels, shapes, "
@@ -109,10 +126,17 @@ def main(argv=None) -> int:
     perf_requested = (
         args.perf_audit or args.perf_kernels or args.update_perf_baselines
     )
+    thread_requested = (
+        args.thread_audit or args.thread_classes or args.lock_graph
+    )
 
     if args.list_rules:
         for spec in sorted(RULES.values(), key=lambda s: s.id):
             print(f"{spec.id}  {spec.title}\n       {spec.doc}")
+        from .threadlint import TL_RULES
+
+        for rule_id, (title, doc) in sorted(TL_RULES.items()):
+            print(f"{rule_id}  {title}\n       {doc}")
         return 0
 
     if args.list_perf_kernels:
@@ -122,7 +146,11 @@ def main(argv=None) -> int:
         return 0
 
     if not args.paths and not (
-        args.audit or args.audit_kernels or shard_requested or perf_requested
+        args.audit
+        or args.audit_kernels
+        or shard_requested
+        or perf_requested
+        or thread_requested
     ):
         parser.print_usage(sys.stderr)
         print(
@@ -217,6 +245,27 @@ def main(argv=None) -> int:
             return 2
         report.extend(perf_findings)
         report.perf_shapes_audited = perf_shapes
+
+    if thread_requested:
+        from .threadlint import run_thread_audit, write_lock_graph
+
+        thread_classes = (
+            [c.strip() for c in args.thread_classes.split(",") if c.strip()]
+            if args.thread_classes
+            else None
+        )
+        try:
+            thread_findings, audited, graph = run_thread_audit(
+                thread_classes
+            )
+        except (FileNotFoundError, KeyError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        report.extend(thread_findings)
+        report.thread_classes_audited = audited
+        if args.lock_graph:
+            write_lock_graph(args.lock_graph, graph)
+            print(f"wrote lock graph to {args.lock_graph}", file=sys.stderr)
 
     print(report.format_json() if args.json else report.format_text())
     return 0 if report.clean else 1

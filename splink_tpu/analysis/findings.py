@@ -41,6 +41,7 @@ class Report:
     kernels_audited: int = 0
     shard_kernels_audited: int = 0
     perf_shapes_audited: int = 0
+    thread_classes_audited: int = 0
 
     def extend(self, findings) -> None:
         self.findings.extend(findings)
@@ -66,6 +67,10 @@ class Report:
             tail += (
                 f", {self.perf_shapes_audited} perf shape(s) measured"
             )
+        if self.thread_classes_audited:
+            tail += (
+                f", {self.thread_classes_audited} thread class(es) audited"
+            )
         lines.append(tail)
         return "\n".join(lines)
 
@@ -77,6 +82,7 @@ class Report:
                 "kernels_audited": self.kernels_audited,
                 "shard_kernels_audited": self.shard_kernels_audited,
                 "perf_shapes_audited": self.perf_shapes_audited,
+                "thread_classes_audited": self.thread_classes_audited,
                 "clean": self.clean,
             },
             indent=2,

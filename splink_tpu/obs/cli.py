@@ -412,6 +412,31 @@ def summarize_events(events: list[dict]) -> str:
                     f"{_or0(ev.get('dropped'))} connection(s) dropped"
                 )
 
+    # ---- concurrency audit (analysis/lockwatch.py + thread-smoke) --------
+    inversions = [e for e in events if e.get("type") == "lock_inversion"]
+    audits = [e for e in events if e.get("type") == "thread_audit"]
+    if inversions or audits:
+        lines.append("")
+        lines.append(
+            f"concurrency: {len(inversions)} lock inversion(s), "
+            f"{len(audits)} thread audit(s)"
+        )
+        for ev in inversions:
+            cycle = ev.get("cycle") or []
+            lines.append(
+                f"  INVERSION {' -> '.join(str(c) for c in cycle) or '?'} "
+                f"at {ev.get('site') or '?'} "
+                f"(thread {ev.get('thread') or '?'})"
+            )
+        for ev in audits:
+            lines.append(
+                f"  audit: {_or0(ev.get('classes'))} class(es), "
+                f"{_or0(ev.get('findings'))} finding(s), "
+                f"{_or0(ev.get('observed_edges'))} observed edge(s), "
+                f"{_or0(ev.get('inversions'))} inversion(s), "
+                f"{_or0(ev.get('cycles'))} union cycle(s)"
+            )
+
     # ---- resilience events ----------------------------------------------
     # serve-tier events (health transitions, breaker state changes, index
     # hot-swaps, worker restarts, brown-out boundaries, drift alerts)

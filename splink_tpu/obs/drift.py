@@ -67,6 +67,8 @@ import threading
 import time
 from collections import deque
 
+from ..analysis import lockwatch
+
 import numpy as np
 
 logger = logging.getLogger("splink_tpu")
@@ -200,7 +202,7 @@ class ServeSketch:
         self._acc = None  # device int32 accumulator
         self._layout = index.layout
         self._cols = cols
-        self._lock = threading.Lock()  # host counters only
+        self._lock = lockwatch.new_lock("ServeSketch._lock")  # host counters only
         self._counters = self._zero_counters()
         self._last_drain = time.monotonic()
 
@@ -384,7 +386,7 @@ class DriftMonitor:
         self.alert_psi = float(alert_psi)
         self.long_window_s = self.window_s * long_factor
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("DriftMonitor._lock")
         self._ring: deque = deque()
         self.windows_observed = 0
 
@@ -402,6 +404,10 @@ class DriftMonitor:
             horizon = window.t - self.long_window_s
             while self._ring and self._ring[0].t < horizon:
                 self._ring.popleft()
+
+    def _windows_observed_snapshot(self) -> int:
+        with self._lock:
+            return self.windows_observed
 
     def _aggregate(self, window_s: float):
         """Summed histograms + counters over the trailing window."""
@@ -594,7 +600,7 @@ class DriftMonitor:
             "reference_pairs": self.profile.n_pairs,
             "reference_matched_pairs": self.profile.n_matched_pairs,
             "alert_psi": self.alert_psi,
-            "windows_observed": self.windows_observed,
+            "windows_observed": self._windows_observed_snapshot(),
             "short": short,
             "long": long_,
             "alerts": self.alerts(short, long_),

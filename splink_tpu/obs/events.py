@@ -27,6 +27,8 @@ import os
 import threading
 import time
 
+from ..analysis import lockwatch
+
 logger = logging.getLogger("splink_tpu")
 
 SCHEMA_VERSION = 1
@@ -63,7 +65,7 @@ class EventSink:
         self.path = os.fspath(path)
         self.run_id = run_id
         self.tags = dict(tags or {})
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("EventSink._lock")
         self._failed = False
         parent = os.path.dirname(self.path)
         if parent:

@@ -41,6 +41,8 @@ import time
 import weakref
 from collections import deque
 
+from ..analysis import lockwatch
+
 from .events import _sanitise, unregister_ambient
 
 logger = logging.getLogger("splink_tpu")
@@ -66,6 +68,12 @@ TRANSITION_TYPES = (
     # incident ring must show when a remote came back, not just the
     # sheds while it was gone (serve/remote.py)
     "wire_reconnect",
+    # concurrency audit events (analysis/lockwatch.py + thread-smoke): an
+    # observed lock-order inversion is exactly the kind of one-in-a-
+    # thousand incident the ring exists for, and the audit summary stamps
+    # the timeline with what the fleet looked like when it was checked
+    "lock_inversion",
+    "thread_audit",
 )
 
 _RECORDERS: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
@@ -131,7 +139,7 @@ class FlightRecorder:
         self.dump_dir = dump_dir or default_dump_dir()
         self.min_dump_interval_s = float(min_dump_interval_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("FlightRecorder._lock")
         self._ring: deque = deque(maxlen=max(self.capacity, 1))
         self._last_dump: dict[str, float] = {}
         self._dump_seq = 0
@@ -192,6 +200,14 @@ class FlightRecorder:
             # around that moment (which queries, which health state, any
             # swap that landed) is exactly the retraining post-mortem
             return "drift_alert"
+        if type == "lock_inversion":
+            # an observed acquisition-order inversion is a latent
+            # deadlock: dump the ring NOW, while the traffic that drove
+            # the two threads into opposite orders is still in it. The
+            # event has no replica identity (locks are process-wide), so
+            # every recorder in the process dumps — a deadlock candidate
+            # is worth N artifacts.
+            return "lock_inversion"
         if type == "perf_alert":
             # the serving kernels got slower: the event carries the
             # KernelWatch window snapshot, so the dump holds both the

@@ -51,6 +51,8 @@ import threading
 import time
 from collections import deque
 
+from ..analysis import lockwatch
+
 from .reqtrace import _quantile
 
 #: cold observations discarded per phase before the anchor forms (first
@@ -119,7 +121,7 @@ class KernelWatch:
         self.long_window_s = self.window_s * long_factor
         self.ewma_alpha = float(ewma_alpha)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("KernelWatch._lock")
         self._phases: dict[str, _PhaseSeries] = {}
 
     # -- feed ------------------------------------------------------------

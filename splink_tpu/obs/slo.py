@@ -31,6 +31,8 @@ import threading
 import time
 from collections import deque
 
+from ..analysis import lockwatch
+
 #: (long_window_s, short_window_s, burn_threshold) pairs for the classic
 #: two-window alert: fire only when BOTH windows burn past the threshold
 #: (the long window proves it matters, the short one proves it is still
@@ -60,7 +62,7 @@ class SLOTracker:
             raise ValueError("SLOTracker needs at least one window")
         self.bucket_s = float(bucket_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("SLOTracker._lock")
         # ring of [bucket_index, good, bad], ascending bucket index
         self._buckets: deque = deque()
         self._max_buckets = (
@@ -154,11 +156,16 @@ class SLOTracker:
                 if total
                 else 0.0,
             }
+        # totals under the lock: observe() bumps both concurrently and a
+        # scrape mid-bump must not report a torn good/bad pair
+        with self._lock:
+            total_good = self.total_good
+            total_bad = self.total_bad
         return {
             "objective": self.objective,
             "error_budget": round(1.0 - self.objective, 6),
-            "total_good": self.total_good,
-            "total_bad": self.total_bad,
+            "total_good": total_good,
+            "total_bad": total_bad,
             "windows": windows,
             "alerts": self.alerts(),
         }

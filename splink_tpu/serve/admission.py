@@ -32,6 +32,8 @@ import math
 import threading
 import time
 
+from ..analysis import lockwatch
+
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
 BREAKER_HALF_OPEN = "half_open"
@@ -57,7 +59,7 @@ class CircuitBreaker:
         # which breaker this is (the wire tier runs one per remote next
         # to the engine's own; snapshots must say whose state they are)
         self.name = str(name)
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("CircuitBreaker._lock")
         self._state = BREAKER_CLOSED
         self._failures = 0
         self._opened_at = 0.0
@@ -145,7 +147,7 @@ class WaitEstimator:
 
     def __init__(self, alpha: float = 0.3, prior_ms: float = 0.0):
         self.alpha = float(alpha)
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("WaitEstimator._lock")
         self._batch_ms = float(prior_ms)
         self._observed = prior_ms > 0
 

@@ -44,6 +44,8 @@ import os
 import threading
 import time
 
+from ..analysis import lockwatch
+
 import numpy as np
 
 from ..utils.logging_utils import warn_degraded
@@ -488,11 +490,11 @@ class QueryEngine:
         # serializes batch dispatch against index hot-swap: a dispatch in
         # flight finishes on the index it started on (graceful drain), and
         # the swap flip is atomic with respect to the next dispatch
-        self._swap_lock = threading.RLock()
+        self._swap_lock = lockwatch.new_rlock("QueryEngine._swap_lock")
         # serializes swap_index against ITSELF (the dispatch lock must stay
         # free during a swap's long validation, so it cannot do this job):
         # without it two concurrent swaps both "commit", one silently lost
-        self._swap_mutex = threading.Lock()
+        self._swap_mutex = lockwatch.new_lock("QueryEngine._swap_mutex")
         self._probes = None  # (query df, recorded answer arrays)
         self._generation = 0
         # float64 serving needs process-wide x64, same semantics as the
@@ -514,6 +516,7 @@ class QueryEngine:
 
     # -- kernel ---------------------------------------------------------
 
+    # threadlint: holds=_swap_lock (query/warmup/save_aot enter locked)
     def _build_kernel(self, k: int):
         """One jitted fused program for one top-k. ``capacity`` is a
         static argument; the engine compiles each (capacity, shapes)
@@ -565,6 +568,7 @@ class QueryEngine:
             jax.jit, static_argnums=(0,), donate_argnums=donate
         )(fused)
 
+    # threadlint: holds=_swap_lock (query/warmup/save_aot enter locked)
     def _jit_kernel(self, kind: str):
         """The jitted program for one tier (stable identity; lowered per
         shape by :meth:`_ensure_exec`, never called directly)."""
@@ -578,6 +582,7 @@ class QueryEngine:
             jfn = self._jits[kind] = self._build_kernel(k)
         return jfn
 
+    # threadlint: holds=_swap_lock (query/warmup/save_aot enter locked)
     def _arg_structs(self, q_pad: int):
         """ShapeDtypeStruct pytree of one dispatch's dynamic arguments at
         query bucket ``q_pad`` — what ``.lower()`` needs instead of real
@@ -612,6 +617,7 @@ class QueryEngine:
             tuple(S(a.shape, dt) for a in tf_dev["log"]),
         )
 
+    # threadlint: holds=_swap_lock (query/warmup/save_aot enter locked)
     def _ensure_exec(self, kind: str, q_pad: int, capacity: int):
         """The compiled executable for one exact shape combination:
         dispatch-table hit, else AOT-sidecar restore (zero backend
@@ -649,6 +655,7 @@ class QueryEngine:
 
     # -- AOT executable sidecar -----------------------------------------
 
+    # threadlint: holds=_swap_lock (query/warmup/save_aot enter locked)
     def _aot_binding(self) -> dict:
         """The strict-invalidation identity every sidecar executable is
         bound to (serve/aot.py adds the environment half: jax/jaxlib
@@ -677,6 +684,7 @@ class QueryEngine:
             "tf": bool(self.tf_spec),
         }
 
+    # threadlint: holds=_swap_lock (query/warmup/save_aot enter locked)
     def _aot_ready_store(self):
         """The validated sidecar store, memoised; None when no sidecar is
         configured, present, or valid (every invalidation reason emits one
@@ -700,9 +708,15 @@ class QueryEngine:
         ``aot_dir``), bound to the index fingerprint, settings hash, shape
         menu and environment. Call after :meth:`warmup` so the sidecar
         holds the full bucket menu. Returns the sidecar meta path."""
+        # the whole save runs under the swap lock (reentrant): a swap
+        # committing mid-iteration would mix two menus into one sidecar
+        with self._swap_lock:
+            return self._save_aot_locked(directory or self._aot_dir)
+
+    # threadlint: holds=_swap_lock
+    def _save_aot_locked(self, directory) -> str:
         from .aot import AotStore
 
-        directory = directory or self._aot_dir
         if not directory:
             raise ValueError(
                 "no sidecar directory: pass save_aot(directory) or "
@@ -744,7 +758,8 @@ class QueryEngine:
 
     def encode(self, df):
         """Host-side query encode (see LinkageIndex.encode_queries)."""
-        return self.index.encode_queries(df)
+        with self._swap_lock:  # reentrant: the batch path enters locked
+            return self.index.encode_queries(df)
 
     def query_arrays(self, df, *, degraded: bool = False, profile=None,
                      approx_out: list | None = None):
@@ -811,6 +826,7 @@ class QueryEngine:
             assert pos == batch.n
             return out_p, out_rows, out_valid, out_ncand
 
+    # threadlint: holds=_swap_lock (only query_arrays calls this, locked)
     def _run_chunk(self, batch, start: int, stop: int, q_pad: int, *,
                    degraded: bool = False, profile=None):
         """One bucketed device dispatch: pad the chunk to ``q_pad`` queries
@@ -931,25 +947,30 @@ class QueryEngine:
         import pandas as pd
 
         approx_out: list = []
-        top_p, top_rows, top_valid, n_cand = self.query_arrays(
-            df, approx_out=approx_out
-        )
-        approx_used = approx_out[0]
-        ref_uid = self.index.unique_id
-        q_idx, rank = np.nonzero(top_valid)
-        uid_col = self.index.settings["unique_id_column_name"]
-        query_uid = self._query_uids(df)
-        out = {
-            f"{uid_col}_q": query_uid[q_idx],
-            f"{uid_col}_m": ref_uid[top_rows[q_idx, rank]],
-            "rank": rank.astype(np.int64),
-            "match_probability": top_p[q_idx, rank],
-            "n_candidates": n_cand[q_idx],
-        }
-        if self.index.approx is not None:
-            out["approx"] = approx_used[q_idx]
+        # one lock span across scoring AND the uid mapping: a hot-swap
+        # committing between them would map row indices scored on the old
+        # index through the new index's unique_id column
+        with self._swap_lock:
+            top_p, top_rows, top_valid, n_cand = self.query_arrays(
+                df, approx_out=approx_out
+            )
+            approx_used = approx_out[0]
+            ref_uid = self.index.unique_id
+            q_idx, rank = np.nonzero(top_valid)
+            uid_col = self.index.settings["unique_id_column_name"]
+            query_uid = self._query_uids(df)
+            out = {
+                f"{uid_col}_q": query_uid[q_idx],
+                f"{uid_col}_m": ref_uid[top_rows[q_idx, rank]],
+                "rank": rank.astype(np.int64),
+                "match_probability": top_p[q_idx, rank],
+                "n_candidates": n_cand[q_idx],
+            }
+            if self.index.approx is not None:
+                out["approx"] = approx_used[q_idx]
         return pd.DataFrame(out)
 
+    # threadlint: holds=_swap_lock (only query() calls this, locked)
     def _query_uids(self, df) -> np.ndarray:
         uid_col = self.index.settings["unique_id_column_name"]
         if uid_col in df.columns:
@@ -997,13 +1018,13 @@ class QueryEngine:
             ]
             for q_pad, capacity in brownout_combos:
                 self._warm_one(q_pad, capacity, degraded=True)
-        if self.sketch is not None:
-            # pre-compile the drift-sketch program for every query bucket
-            # (one dummy all-invalid dispatch per shape), so sketching
-            # adds zero steady-state recompiles. These compiles are ON
-            # TOP of the scoring combinations — sketch-on replicas show
-            # compiles > combinations here, never in steady state.
-            with self._swap_lock:
+        # pre-compile the drift-sketch program for every query bucket
+        # (one dummy all-invalid dispatch per shape), so sketching
+        # adds zero steady-state recompiles. These compiles are ON
+        # TOP of the scoring combinations — sketch-on replicas show
+        # compiles > combinations here, never in steady state.
+        with self._swap_lock:
+            if self.sketch is not None:
                 for q_pad in self.policy.query_buckets:
                     self.sketch.warm(q_pad, self.top_k)
         s1 = compile_stats()
@@ -1080,11 +1101,13 @@ class QueryEngine:
         """The (query_bucket, candidate_bucket) combinations compiled so
         far (full-service program; the brown-out program's shapes are in
         ``warmed_brownout_shapes``)."""
-        return set(self._warmed)
+        with self._swap_lock:
+            return set(self._warmed)
 
     @property
     def warmed_brownout_shapes(self) -> set:
-        return set(self._warmed_brownout)
+        with self._swap_lock:
+            return set(self._warmed_brownout)
 
     def probe(self) -> None:
         """Execute the smallest warmed shape end to end (kernel + device +
@@ -1098,20 +1121,29 @@ class QueryEngine:
     @property
     def generation(self) -> int:
         """How many hot-swaps this engine has committed."""
-        return self._generation
+        with self._swap_lock:
+            return self._generation
 
     @property
     def tf_active(self) -> bool:
         """Whether this engine folds the term-frequency u-probability
         adjustment into its served scores (settings gate on AND the index
         carries the fold data)."""
-        return bool(self.tf_spec)
+        with self._swap_lock:
+            return bool(self.tf_spec)
 
     # -- drift sketch drain ---------------------------------------------
 
     def drift_drain_due(self, cadence_s: float) -> bool:
         """Whether the drift accumulator is due a drain (no lock, no
-        device work — a cheap poll for the service worker/watchdog)."""
+        device work — a cheap poll for the service worker/watchdog).
+
+        Deliberately lock-free: the swap lock is held for entire batch
+        dispatches, and the watchdog must never stall its tick budget on
+        a serving batch. ``sketch`` only flips on a hot-swap; racing one
+        at worst answers the poll for the outgoing sketch (off-by-one
+        tick, self-correcting next poll)."""
+        # threadlint: disable=TL001 (atomic reference read, see docstring)
         return self.sketch is not None and self.sketch.drain_due(cadence_s)
 
     def drain_drift(self):
@@ -1120,9 +1152,9 @@ class QueryEngine:
         off. The sketch's ONLY device fetch — called between batches by
         the service worker or from the watchdog when idle, never inside a
         dispatch."""
-        if self.sketch is None:
-            return None
         with self._swap_lock:
+            if self.sketch is None:
+                return None
             return self.sketch.drain()
 
     # -- parity probes & index hot-swap ---------------------------------
@@ -1143,7 +1175,13 @@ class QueryEngine:
 
     @property
     def probe_count(self) -> int:
-        return 0 if self._probes is None else len(self._probes[0])
+        """Stat-only accessor, deliberately lock-free: ``_probes`` is an
+        atomically-assigned tuple reference and the swap lock can be held
+        for a whole batch dispatch — a health poll must not stall on it.
+        A read racing capture/swap returns the count of either the old or
+        the new probe set, both truthful answers."""
+        probes = self._probes  # threadlint: disable=TL001 (see docstring)
+        return 0 if probes is None else len(probes[0])
 
     def swap_index(self, source, *, refresh_probes: bool = False) -> dict:
         """Hot-swap to a new :class:`LinkageIndex` with validation and
@@ -1177,8 +1215,9 @@ class QueryEngine:
         from .index import LinkageIndex, load_index
 
         t0 = time.perf_counter()
-        plan = active_plan(self.index.settings)
-        generation = self._generation + 1
+        with self._swap_lock:
+            plan = active_plan(self.index.settings)
+            generation = self._generation + 1
         try:
             plan.fire("swap_load", generation=generation)
             if isinstance(source, LinkageIndex):
@@ -1198,7 +1237,8 @@ class QueryEngine:
             ) from e
         probes_checked = 0
         new_probes = None
-        probes = self._probes  # snapshot: validation runs against THIS set
+        with self._swap_lock:
+            probes = self._probes  # snapshot: validation runs on THIS set
         try:
             # a candidate loaded from disk may ship its own AOT sidecar
             # (<dir>/aot) — the pending engine's pre-warm restores from it
@@ -1265,9 +1305,10 @@ class QueryEngine:
                 # probe set from post-swap traffic
                 self._probes = None
             self._generation = generation
+            n_rows = self.index.n_rows
         stats = {
             "generation": generation,
-            "n_rows": self.index.n_rows,
+            "n_rows": n_rows,
             "warmup_combinations": warm["combinations"],
             "warmup_compiles": warm["compiles"],
             "probes_checked": probes_checked,
@@ -1277,7 +1318,7 @@ class QueryEngine:
         logger.info(
             "serving index hot-swapped: generation %d, %d rows, "
             "%d probe(s) parity-checked, %.3fs",
-            generation, self.index.n_rows, probes_checked, stats["elapsed_s"],
+            generation, n_rows, probes_checked, stats["elapsed_s"],
         )
         return stats
 

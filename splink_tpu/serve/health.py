@@ -31,6 +31,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ..analysis import lockwatch
+
 HEALTHY = "healthy"
 DEGRADED = "degraded"
 BROKEN = "broken"
@@ -80,7 +82,7 @@ class HealthMonitor:
         self.broken_shed_rate = float(broken_shed_rate)
         self.degraded_p95_ms = degraded_p95_ms
         self.recover_ticks = int(recover_ticks)
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("HealthMonitor._lock")
         self._state = HEALTHY
         self._since = time.monotonic()
         self._better_streak = 0

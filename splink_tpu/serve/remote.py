@@ -52,6 +52,8 @@ import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
 
+from ..analysis import lockwatch
+
 from ..obs.events import publish
 from ..resilience.retry import RetryPolicy
 from .admission import CircuitBreaker
@@ -92,8 +94,8 @@ class _RemoteConn:
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
-        self.wlock = threading.Lock()
-        self.plock = threading.Lock()
+        self.wlock = lockwatch.new_lock("_RemoteConn.wlock")
+        self.plock = lockwatch.new_lock("_RemoteConn.plock")
         self.pending: dict[int, _Pending] = {}
         self.alive = True
         self.lost = False  # _conn_lost ran (exactly-once accounting)
@@ -113,7 +115,11 @@ class _RemoteConn:
         with self.wlock:
             if not self.alive:
                 raise BrokenPipeError("connection already closed")
-            self.sock.sendall(frame)
+            # Serializing whole-frame writes is wlock's entire job: two
+            # threads interleaving partial sendall()s would corrupt the
+            # stream. wlock is a leaf (never wraps another acquisition),
+            # so blocking under it cannot deadlock — only queue writers.
+            self.sock.sendall(frame)  # threadlint: disable=TL002 (leaf write lock; see comment)
 
     def register(self, req_id: int, p: _Pending) -> None:
         with self.plock:
@@ -204,7 +210,7 @@ class RemoteReplica:
         self.retry_policy = retry_policy or RetryPolicy(
             base_delay=0.05, max_delay=2.0
         )
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("RemoteReplica._lock")
         self._conns: list[_RemoteConn] = []
         self._rr = 0
         self._req_ids = itertools.count(1)
@@ -257,6 +263,14 @@ class RemoteReplica:
             if env is None or env.get("v") != WIRE_VERSION:
                 raise ConnectionError(
                     f"liveness handshake failed: {env!r}"
+                )
+            if env.get("kind") == "error":
+                # the server answered the dial itself with a shed (e.g.
+                # `server_overloaded` past wire_max_connections): the
+                # socket is already dead, surface the reason verbatim
+                raise ConnectionError(
+                    "remote refused connection: "
+                    f"{env.get('reason') or 'error'}"
                 )
             self._remote_health = env.get("health") or self._remote_health
             sock.settimeout(None)
@@ -511,8 +525,6 @@ class RemoteReplica:
     # -- sweeper --------------------------------------------------------
 
     def _ensure_sweeper(self) -> None:
-        if self._sweeper is not None and self._sweeper.is_alive():
-            return
         with self._lock:
             if self._closed or (
                 self._sweeper is not None and self._sweeper.is_alive()
@@ -561,7 +573,9 @@ class RemoteReplica:
     ) -> Future:
         """Enqueue one query on the remote host; never raises, always
         resolves (module docstring for the shed taxonomy)."""
-        if self._closed:
+        with self._lock:
+            closed = self._closed
+        if closed:
             return self._shed_now("closed", trace)
         if deadline_ms is not None and deadline_ms <= 0:
             return self._shed_now("deadline", trace)
@@ -624,9 +638,10 @@ class RemoteReplica:
         (:func:`~.health.worse`)."""
         with self._lock:
             any_alive = any(c.alive for c in self._conns)
+            closed = self._closed
         link = (
             BROKEN
-            if self._closed or self.breaker.state == "open" or not any_alive
+            if closed or self.breaker.state == "open" or not any_alive
             else HEALTHY
         )
         return worse(self._remote_health or HEALTHY, link)
@@ -634,17 +649,19 @@ class RemoteReplica:
     def health(self) -> dict:
         """A live round-trip health snapshot from the remote (falls back
         to the local link view when the wire is down)."""
+        with self._lock:
+            n_conns = len(self._conns)
+            reconnects = self.reconnects
+            conns = [c for c in self._conns if c.alive]
         local = {
             "replica": self.name,
             "state": self.health_state,
             "link": {
                 "breaker": self.breaker.snapshot(),
-                "connections": len(self._conns),
-                "reconnects": self.reconnects,
+                "connections": n_conns,
+                "reconnects": reconnects,
             },
         }
-        with self._lock:
-            conns = [c for c in self._conns if c.alive]
         if not conns:
             return local
         fut: Future = Future()
@@ -681,13 +698,14 @@ class RemoteReplica:
         with self._lock:
             lats = sorted(self._latencies)
             served, sheds = self.served, self.sheds
+            reconnects = self.reconnects
         elapsed = max(time.monotonic() - self._t_start, 1e-9)
         out = {
             "replica": self.name,
             "served": served,
             "shed": sheds,
             "queries_per_sec": served / elapsed,
-            "reconnects": self.reconnects,
+            "reconnects": reconnects,
             "breaker_state": self.breaker.state,
             "health": self.health_state,
         }

@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Protocol, runtime_checkable
 
+from ..analysis import lockwatch
+
 from .health import health_rank
 
 logger = logging.getLogger("splink_tpu")
@@ -116,7 +118,7 @@ class ReplicaRouter:
             trace_sample_rate = settings.get("serve_trace_sample_rate", 0.0)
         self._tracer = ServeTracer(trace_sample_rate or 0.0, service="router")
         self._obs = telemetry
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("ReplicaRouter._lock")
         self._rr = 0
         self.dispatched = 0
         self.hedges = 0
@@ -229,22 +231,37 @@ class _HedgedCall:
         self.hedge_delay_ms = hedge_delay_ms
         self.trace = trace  # shared-root context; one child per attempt
         self.out: Future = Future()
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("_HedgedCall._lock")
         self._next = 0
         self._inflight = 0
         self._hedge_idx = None  # the exact attempt index the hedge dispatched
         self._last_shed = None
+        # the resolution claim: flipped exactly once, under _lock, by the
+        # attempt that wins the right to resolve ``out`` — set_result
+        # itself then runs OUTSIDE the lock (done-callbacks are foreign
+        # code and must not execute under it)
+        self._resolved = False
         self._timer: threading.Timer | None = None
         self._t0 = time.monotonic()
 
     def start(self) -> None:
         self._dispatch_next()
-        if self.hedge_delay_ms is not None and self._next < len(self.order):
-            self._timer = threading.Timer(
-                self.hedge_delay_ms / 1000.0, self._hedge
-            )
-            self._timer.daemon = True
-            self._timer.start()
+        timer = None
+        with self._lock:
+            # arm under the lock: the first attempt may resolve on another
+            # thread before we get here, and ITS cancel must see the timer
+            if (
+                self.hedge_delay_ms is not None
+                and not self._resolved
+                and self._next < len(self.order)
+            ):
+                timer = threading.Timer(
+                    self.hedge_delay_ms / 1000.0, self._hedge
+                )
+                timer.daemon = True
+                self._timer = timer
+        if timer is not None:
+            timer.start()
 
     def _dispatch_next(self, hedge: bool = False) -> int | None:
         """Dispatch to the next replica in the order; returns its attempt
@@ -253,7 +270,7 @@ class _HedgedCall:
         the win accounting cannot race a synchronously resolving
         replica."""
         with self._lock:
-            if self.out.done() or self._next >= len(self.order):
+            if self._resolved or self._next >= len(self.order):
                 return None
             idx = self._next
             self._next += 1
@@ -299,33 +316,48 @@ class _HedgedCall:
         self._finish_attempt(idx, res)
 
     def _finish_attempt(self, idx: int, res) -> None:
+        # Decide under the lock, act after releasing it: the winner claims
+        # `_resolved` inside the critical section, then resolves `out`
+        # (whose done-callbacks may grab the router's counter lock or run
+        # user code) and cancels the timer with no lock held.
+        win = hedge_won = False
+        timer = None
         with self._lock:
             self._inflight -= 1
-            if self.out.done():
+            if self._resolved:
                 return
             if res is not None and not res.shed:
-                self.out.set_result(res)
-                if self._timer is not None:
-                    self._timer.cancel()
-                if idx == self._hedge_idx:  # the hedge dispatch itself won
-                    self.router._bump("hedge_wins")
-                return
-            if res is not None:
-                self._last_shed = res
-            exhausted = self._next >= len(self.order)
-            settle = exhausted and self._inflight == 0
+                self._resolved = True
+                win = True
+                hedge_won = idx == self._hedge_idx  # the hedge itself won
+                timer = self._timer
+            else:
+                if res is not None:
+                    self._last_shed = res
+                exhausted = self._next >= len(self.order)
+                settle = exhausted and self._inflight == 0
+        if win:
+            self.out.set_result(res)
+            if timer is not None:
+                timer.cancel()
+            if hedge_won:
+                self.router._bump("hedge_wins")
+            return
         if not exhausted:
             self.router._bump("failovers")
             if self._dispatch_next() is None:
                 with self._lock:
-                    settle = self._inflight == 0 and not self.out.done()
-        if settle and not self.out.done():
-            from .service import QueryResult
+                    settle = self._inflight == 0 and not self._resolved
+        if not settle:
+            return
+        from .service import QueryResult
 
+        with self._lock:
+            if self._resolved:  # lost the settle race to a late winner
+                return
+            self._resolved = True
             last = self._last_shed or QueryResult(shed=True, reason="no_replica")
-            try:
-                self.out.set_result(last)
-            except Exception:  # noqa: BLE001 - lost a resolution race
-                pass
-            if self._timer is not None:
-                self._timer.cancel()
+            timer = self._timer
+        self.out.set_result(last)
+        if timer is not None:
+            timer.cancel()

@@ -79,6 +79,8 @@ from concurrent.futures import Future, InvalidStateError
 from concurrent.futures import TimeoutError as FuturesTimeout
 from dataclasses import dataclass, field
 
+from ..analysis import lockwatch
+
 import numpy as np
 
 from ..resilience.faults import active_plan
@@ -205,7 +207,7 @@ class LinkageService:
         )
         self._settings = settings
         self._obs = telemetry
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("LinkageService._lock")
         self._nonempty = threading.Condition(self._lock)
         # (record, future, t_enqueue, deadline, trace) — trace is None for
         # unsampled requests, so the tracing-off path costs one tuple slot
@@ -235,7 +237,7 @@ class LinkageService:
         # health-window marks (consumed by _health_signals deltas; the
         # watchdog and on-demand health() calls share them, so updates go
         # through _signals_lock)
-        self._signals_lock = threading.Lock()
+        self._signals_lock = lockwatch.new_lock("LinkageService._signals_lock")
         self._hw_served = 0
         self._hw_shed = 0
         self._stall_accum = 0.0
@@ -362,9 +364,13 @@ class LinkageService:
             self._nonempty.notify_all()
         for entry in to_shed:
             self._resolve_shed(entry[1], "closed", entry[4])
-        if self._thread is not None:
-            self._thread.join(timeout=30)
+        # take the worker handle under the lock: a concurrent close must
+        # not race this read/None write (close is documented idempotent)
+        with self._lock:
+            worker = self._thread
             self._thread = None
+        if worker is not None:
+            worker.join(timeout=30)
         # a submit racing the shutdown can enqueue after the worker's last
         # batch — and a worker that DIED mid-batch leaves in-flight entries
         # — resolve all stragglers shed so no future hangs forever
@@ -381,10 +387,15 @@ class LinkageService:
             self._exposition.close()
             self._exposition = None
         self._flight.close()  # unregister; the ring stays dump-able
-        if self._obs is not None and not self._summary_recorded:
-            # once per lifetime: close() is idempotent and must not emit
-            # duplicate serve_latency records on repeated calls
+        # once per lifetime: close() is idempotent and must not emit
+        # duplicate serve_latency records on repeated calls (the
+        # check-and-set is atomic so concurrent closes cannot both record)
+        with self._lock:
+            record_summary = (
+                self._obs is not None and not self._summary_recorded
+            )
             self._summary_recorded = True
+        if record_summary:
             self._obs.record("serve_latency", self.latency_summary())
 
     def __enter__(self) -> "LinkageService":
@@ -445,7 +456,6 @@ class LinkageService:
             if reason is not None:
                 self._shed_count += 1
                 shed_total = self._shed_count
-                fut.set_result(QueryResult(shed=True, reason=reason))
             else:
                 deadline = (
                     None
@@ -459,8 +469,9 @@ class LinkageService:
                 )
                 self._nonempty.notify()
                 return fut
-        # outside the lock: warn_degraded publishes + warns, both of which
-        # may run user hooks
+        # outside the lock: resolving the future runs done-callbacks, and
+        # warn_degraded publishes + warns — all of which may run user hooks
+        fut.set_result(QueryResult(shed=True, reason=reason))
         self._slo.observe(False)
         self._tracer.close(trace, "shed", reason=reason)
         warn_degraded(
@@ -535,8 +546,10 @@ class LinkageService:
                 # fault site OUTSIDE the batch try-block: a raise here
                 # kills the worker thread — the failure mode the watchdog
                 # recovers from (resilience/faults.py SERVE_SITES)
+                with self._lock:
+                    batch_no = self._batches
                 active_plan(self._settings).fire(
-                    "serve_worker", batch=self._batches
+                    "serve_worker", batch=batch_no
                 )
                 batch = self._take_batch()
                 if batch is None:
@@ -649,7 +662,10 @@ class LinkageService:
             )
             self._clear_inflight()
             return
-        q_fill = self._take_fill
+        with self._lock:
+            q_fill = self._take_fill
+            batch_no = self._batches
+            swap_overlapped = self._swap_in_progress
         degraded = brownout_active(
             q_fill,
             self._health.state,
@@ -671,11 +687,10 @@ class LinkageService:
             from ..obs.reqtrace import PhaseProfile
 
             profile = PhaseProfile()
-        swap_overlapped = self._swap_in_progress
         t0 = time.perf_counter()
         try:
             active_plan(self._settings).fire(
-                "serve_batch", batch=self._batches
+                "serve_batch", batch=batch_no
             )
             df = pd.DataFrame.from_records(records)
             if self._obs is not None:
@@ -711,7 +726,9 @@ class LinkageService:
             self._clear_inflight()
             return
         batch_ms = (time.perf_counter() - t0) * 1000.0
-        if profile is not None and (swap_overlapped or self._swap_in_progress):
+        with self._lock:
+            swap_overlapped = swap_overlapped or self._swap_in_progress
+        if profile is not None and swap_overlapped:
             # the compile split reads the PROCESS-global compile counter: a
             # concurrent swap_index pre-warm (which deliberately compiles
             # outside the dispatch lock while the old index keeps serving)
@@ -819,14 +836,17 @@ class LinkageService:
                 self._probe_buffer = []
 
     def _note_brownout(self, active: bool, q_fill: float) -> None:
-        if active == self._brownout_active:
-            return
-        self._brownout_active = active
+        # edge-detect and count under the lock (health() reads both);
+        # publish/warn after releasing it — they run subscriber hooks
+        with self._lock:
+            if active == self._brownout_active:
+                return
+            self._brownout_active = active
+            if active:
+                self._brownout_episodes += 1
         from ..obs.events import publish
 
         if active:
-            with self._lock:
-                self._brownout_episodes += 1
             warn_degraded(
                 "serve_brownout",
                 "active",
@@ -1196,8 +1216,9 @@ class LinkageService:
         snap = self._health.snapshot()
         snap["breaker"] = self.breaker.snapshot()
         snap["generation"] = self.engine.generation
-        snap["worker_crashes"] = self._worker_crashes
-        snap["brownout_episodes"] = self._brownout_episodes
+        with self._lock:
+            snap["worker_crashes"] = self._worker_crashes
+            snap["brownout_episodes"] = self._brownout_episodes
         return snap
 
     @property
@@ -1216,13 +1237,15 @@ class LinkageService:
         signal."""
         from ..obs.metrics import compile_totals
 
-        self._swap_in_progress = True
+        with self._lock:
+            self._swap_in_progress = True
         try:
             stats = self.engine.swap_index(
                 source, refresh_probes=refresh_probes
             )
         finally:
-            self._swap_in_progress = False
+            with self._lock:
+                self._swap_in_progress = False
             with self._signals_lock:
                 self._last_compile_s = compile_totals()[1]
                 self._stall_accum = 0.0
@@ -1252,20 +1275,27 @@ class LinkageService:
     def latency_summary(self) -> dict:
         """p50/p95/p99 request latency (ms), counts, throughput and the
         resilience counters over the service's lifetime."""
-        # snapshot under the lock: the worker appends concurrently and
-        # deque iteration raises on mutation
+        # snapshot under the lock: the worker appends concurrently (deque
+        # iteration raises on mutation) and bumps every counter below
         with self._lock:
             lats = np.asarray(self._latencies, np.float64)
+            served = self._served
+            shed = self._shed_count
+            batches = self._batches
+            degraded_served = self._degraded_served
+            timeouts = self._timeouts
+            brownout_episodes = self._brownout_episodes
+            worker_crashes = self._worker_crashes
         elapsed = max(time.monotonic() - self._t_start, 1e-9)
         out = {
-            "served": self._served,
-            "shed": self._shed_count,
-            "batches": self._batches,
-            "queries_per_sec": self._served / elapsed,
-            "degraded_served": self._degraded_served,
-            "timeouts": self._timeouts,
-            "brownout_episodes": self._brownout_episodes,
-            "worker_crashes": self._worker_crashes,
+            "served": served,
+            "shed": shed,
+            "batches": batches,
+            "queries_per_sec": served / elapsed,
+            "degraded_served": degraded_served,
+            "timeouts": timeouts,
+            "brownout_episodes": brownout_episodes,
+            "worker_crashes": worker_crashes,
             "breaker_state": self.breaker.state,
             "breaker_opened_total": self.breaker.opened_total,
             "health": self._health.state,
@@ -1306,6 +1336,8 @@ class LinkageService:
 
         replica = {"replica": self.name}
         summary = self.latency_summary()
+        with self._lock:
+            queue_len = len(self._queue)
         out = [
             Sample("splink_serve_served_total", summary["served"], replica,
                    "counter", "Requests delivered with matches"),
@@ -1325,7 +1357,7 @@ class LinkageService:
                    summary["queries_per_sec"], replica, "gauge",
                    "Lifetime served throughput"),
             Sample("splink_serve_queue_fill",
-                   (len(self._queue) / self.queue_depth)
+                   (queue_len / self.queue_depth)
                    if self.queue_depth else 0.0,
                    replica, "gauge", "Bounded-queue occupancy 0..1"),
             Sample("splink_serve_health_rank",

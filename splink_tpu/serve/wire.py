@@ -72,6 +72,8 @@ import struct
 import threading
 import time
 
+from ..analysis import lockwatch
+
 from ..obs.events import _sanitise, publish
 from ..resilience.faults import InjectedFault, active_plan
 
@@ -83,6 +85,12 @@ WIRE_VERSION = 1
 
 #: Default cap on one frame's payload (settings key ``wire_max_frame_bytes``).
 DEFAULT_MAX_FRAME_BYTES = 4 * 1024 * 1024
+
+#: Default cap on simultaneously open connections (settings key
+#: ``wire_max_connections``). Past the cap a connection is accepted,
+#: answered with ONE ``server_overloaded`` error envelope and closed — a
+#: machine-readable shed, not a silent drop.
+DEFAULT_MAX_CONNECTIONS = 64
 
 _HEADER = struct.Struct(">I")
 _RECV_CHUNK = 1 << 16  # bounded per-recv read; never trust the prefix
@@ -179,14 +187,18 @@ class _ServerConn:
     def __init__(self, sock: socket.socket, peer):
         self.sock = sock
         self.peer = f"{peer[0]}:{peer[1]}" if isinstance(peer, tuple) else str(peer)
-        self.wlock = threading.Lock()
+        self.wlock = lockwatch.new_lock("_ServerConn.wlock")
         self.alive = True
 
     def send(self, frame: bytes) -> None:
         with self.wlock:
             if not self.alive:
                 raise BrokenPipeError("connection already closed")
-            self.sock.sendall(frame)
+            # Serializing whole-frame writes is wlock's entire job: two
+            # threads interleaving partial sendall()s would corrupt the
+            # stream. wlock is a leaf (never wraps another acquisition),
+            # so blocking under it cannot deadlock — only queue writers.
+            self.sock.sendall(frame)  # threadlint: disable=TL002 (leaf write lock; see comment)
 
     def abort(self) -> None:
         """Hard-close from any thread; unblocks a reader mid-recv."""
@@ -227,6 +239,7 @@ class WireServer:
         host: str = "127.0.0.1",
         port: int | None = None,
         max_frame_bytes: int | None = None,
+        max_connections: int | None = None,
         name: str | None = None,
     ):
         settings = getattr(
@@ -245,9 +258,19 @@ class WireServer:
             else settings.get("wire_max_frame_bytes", DEFAULT_MAX_FRAME_BYTES)
             or DEFAULT_MAX_FRAME_BYTES
         )
+        self.max_connections = int(
+            max_connections
+            if max_connections is not None
+            else settings.get("wire_max_connections", DEFAULT_MAX_CONNECTIONS)
+            or DEFAULT_MAX_CONNECTIONS
+        )
+        if self.max_connections < 1:
+            raise ValueError(
+                f"wire_max_connections must be >= 1, got {self.max_connections}"
+            )
         self.name = name or f"wire:{getattr(service, 'name', 'serve')}"
         self._settings = settings
-        self._lock = threading.Lock()
+        self._lock = lockwatch.new_lock("WireServer._lock")
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._conns: list[_ServerConn] = []
@@ -260,19 +283,21 @@ class WireServer:
         self.requests_total = 0
         self.errors_total = 0
         self.partitions_total = 0
+        self.overloaded_total = 0
 
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> "WireServer":
-        if self._listener is not None:
-            return self
-        lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        lst.bind((self.host, self._port_requested))
-        lst.listen(128)
-        self._listener = lst
-        self._stop = False
-        self.port = lst.getsockname()[1]
+        with self._lock:
+            if self._listener is not None:
+                return self
+            lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lst.bind((self.host, self._port_requested))
+            lst.listen(128)
+            self._listener = lst
+            self._stop = False
+            self.port = lst.getsockname()[1]
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name=f"{self.name}-accept", daemon=True
         )
@@ -282,7 +307,11 @@ class WireServer:
 
     @property
     def address(self) -> str:
-        return f"{self.host}:{self.port}"
+        # port is assigned exactly once, inside start()'s lock, before the
+        # accept thread or any client exists; every later read sees the
+        # final value. stats() also reads this while holding _lock, so
+        # taking the (non-reentrant) lock here would self-deadlock.
+        return f"{self.host}:{self.port}"  # threadlint: disable=TL001 (write-once at startup)
 
     def close(self) -> None:
         """Graceful stop: no new connections, live ones close, threads
@@ -355,20 +384,26 @@ class WireServer:
         logger.info("wire server %s partition healed", self.name)
 
     def _partitioned(self) -> bool:
-        return time.monotonic() < self._partition_until
+        with self._lock:
+            until = self._partition_until
+        return time.monotonic() < until
 
     # -- accept / connection loops --------------------------------------
 
     def _accept_loop(self) -> None:
         while True:
-            listener = self._listener
-            if listener is None or self._stop:
+            with self._lock:
+                listener = self._listener
+                stop = self._stop
+            if listener is None or stop:
                 return
             try:
                 sock, peer = listener.accept()
             except OSError:
                 return  # listener closed
-            if self._stop or self._partitioned():
+            with self._lock:
+                stop = self._stop
+            if stop or self._partitioned():
                 # a partitioned host is unreachable: the accepted socket
                 # dies before a single byte, so the client's liveness
                 # handshake reads EOF and treats the connect as failed
@@ -376,6 +411,18 @@ class WireServer:
                     sock.close()
                 except OSError:
                     pass
+                continue
+            with self._lock:
+                overloaded = len(self._conns) >= self.max_connections
+                if overloaded:
+                    self.overloaded_total += 1
+            if overloaded:
+                # Explicit shed, not a silent drop: the client reads ONE
+                # machine-readable error envelope (reason
+                # `server_overloaded`) before EOF, so it can tell "this
+                # host is full, fail over" apart from a partition or a
+                # crash — and must not burn its reconnect backoff on it.
+                self._refuse_overloaded(sock, peer)
                 continue
             wc = _ServerConn(sock, peer)
             with self._lock:
@@ -400,6 +447,42 @@ class WireServer:
             self._threads.append(t)
             t.start()
 
+    def _refuse_overloaded(self, sock: socket.socket, peer) -> None:
+        """Answer an over-cap connection with one ``server_overloaded``
+        error envelope and close it (module cap docstring)."""
+        peer_s = f"{peer[0]}:{peer[1]}" if isinstance(peer, tuple) else str(peer)
+        try:
+            sock.sendall(
+                encode_frame(
+                    {
+                        "v": WIRE_VERSION,
+                        "kind": "error",
+                        "id": None,
+                        "reason": "server_overloaded",
+                        "health": getattr(
+                            self.service, "health_state", "degraded"
+                        ),
+                    },
+                    self.max_frame_bytes,
+                )
+            )
+        except OSError:
+            pass  # the peer already gave up; the close below still counts
+        try:
+            sock.close()
+        except OSError:
+            pass
+        publish(
+            "wire_overload",
+            server=self.name,
+            peer=peer_s,
+            max_connections=self.max_connections,
+        )
+        logger.warning(
+            "wire server %s refused %s: %d connections open (cap %d)",
+            self.name, peer_s, self.max_connections, self.max_connections,
+        )
+
     def _net_fault(self, wc: _ServerConn, fault: InjectedFault) -> None:
         """Apply an injected network fault to a connection: every net kind
         (and any other injected raise at a wire site) ends in an abrupt
@@ -416,7 +499,8 @@ class WireServer:
         with self._lock:
             if wc in self._conns:
                 self._conns.remove(wc)
-        if not self._stop:
+            stop = self._stop
+        if not stop:
             publish(
                 "wire_disconnect",
                 server=self.name,
@@ -598,6 +682,8 @@ class WireServer:
     # -- introspection --------------------------------------------------
 
     def stats(self) -> dict:
+        # _partitioned() takes _lock itself — resolve it before entering
+        partitioned = self._partitioned()
         with self._lock:
             return {
                 "server": self.name,
@@ -607,7 +693,9 @@ class WireServer:
                 "requests_total": self.requests_total,
                 "errors_total": self.errors_total,
                 "partitions_total": self.partitions_total,
-                "partitioned": self._partitioned(),
+                "overloaded_total": self.overloaded_total,
+                "max_connections": self.max_connections,
+                "partitioned": partitioned,
             }
 
     def prometheus_samples(self) -> list:
@@ -629,4 +717,7 @@ class WireServer:
                    "Wire protocol errors (bad frame/version/kind)"),
             Sample("splink_wire_partitions_total", s["partitions_total"],
                    labels, "counter", "Injected/observed partitions"),
+            Sample("splink_wire_overloaded_total", s["overloaded_total"],
+                   labels, "counter",
+                   "Connections refused past the wire_max_connections cap"),
         ]

@@ -97,6 +97,7 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "wire_port",
     "wire_connect_timeout_ms",
     "wire_max_frame_bytes",
+    "wire_max_connections",
     "wire_remote_hosts",
     "quality_profile",
     "drift_sketch_bins",

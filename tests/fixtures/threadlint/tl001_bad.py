@@ -1,0 +1,23 @@
+"""TL001 bad twin: a counter guarded in one method, bare in another.
+
+The suppressed copy proves the annotation machinery silences exactly the
+annotated line and nothing else.
+"""
+
+import threading
+
+
+class MixedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def bump_fast(self):
+        self._count += 1  # TL001: unguarded write to a guarded attribute
+
+    def bump_suppressed(self):
+        self._count += 1  # threadlint: disable=TL001 (fixture: justified)

@@ -1,0 +1,21 @@
+"""TL002 bad twin: a blocking call inside the lock span stalls every
+thread queued on the lock for the full duration of the block."""
+
+import threading
+import time
+
+
+class SleepyHolder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def slow(self):
+        with self._lock:
+            time.sleep(0.1)  # TL002: blocking while holding the lock
+            self._n += 1
+
+    def slow_suppressed(self):
+        with self._lock:
+            time.sleep(0.1)  # threadlint: disable=TL002 (fixture: justified)
+            self._n += 1

@@ -1,0 +1,17 @@
+"""TL002 good twin: decide under the lock, block after releasing it."""
+
+import threading
+import time
+
+
+class PatientHolder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def slow(self):
+        with self._lock:
+            self._n += 1
+            due = self._n % 10 == 0
+        if due:
+            time.sleep(0.1)  # no lock held: other threads proceed

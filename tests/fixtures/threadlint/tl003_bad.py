@@ -1,0 +1,22 @@
+"""TL003 bad twin: a stored caller-supplied callback invoked while the
+lock is held — foreign code runs inside the critical section and may
+re-enter or grab another lock (lock-order hazard by proxy)."""
+
+import threading
+
+
+class Notifier:
+    def __init__(self, on_change):
+        self._lock = threading.Lock()
+        self.on_change = on_change
+        self._state = 0
+
+    def set(self, v):
+        with self._lock:
+            self._state = v
+            self.on_change(v)  # TL003: callback escapes under the lock
+
+    def set_suppressed(self, v):
+        with self._lock:
+            self._state = v
+            self.on_change(v)  # threadlint: disable=TL003 (fixture: justified)

@@ -1,0 +1,17 @@
+"""TL003 good twin: decide under the lock, notify after releasing it."""
+
+import threading
+
+
+class QuietNotifier:
+    def __init__(self, on_change):
+        self._lock = threading.Lock()
+        self.on_change = on_change
+        self._state = 0
+
+    def set(self, v):
+        with self._lock:
+            changed = self._state != v
+            self._state = v
+        if changed:
+            self.on_change(v)  # no lock held: re-entry is safe

@@ -1,0 +1,20 @@
+"""TL004 bad twin: two locks acquired in conflicting orders — the
+textbook deadlock the moment both paths run concurrently."""
+
+import threading
+
+
+class Tangled:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass

@@ -1,0 +1,19 @@
+"""TL004 good twin: one global acquisition order (a before b, always)."""
+
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def also_ab(self):
+        with self._a:
+            with self._b:
+                pass

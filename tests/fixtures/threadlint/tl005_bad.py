@@ -1,0 +1,22 @@
+"""TL005 bad twin: a non-daemon worker spawned with no join in any
+closer — interpreter shutdown hangs on the leaked thread."""
+
+import threading
+
+
+class Leaky:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._run)  # TL005: leaked
+        self._t.start()
+
+    def start_suppressed(self):
+        # threadlint: disable=TL005 (fixture: justified)
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass

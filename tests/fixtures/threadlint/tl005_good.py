@@ -1,0 +1,25 @@
+"""TL005 good twin: the worker is joined by close() (and a daemon spawn
+is fine too — it cannot block interpreter shutdown)."""
+
+import threading
+
+
+class Tidy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def start_background(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def close(self):
+        if self._t is not None:
+            self._t.join(timeout=5.0)
+
+    def _run(self):
+        pass

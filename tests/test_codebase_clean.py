@@ -91,3 +91,33 @@ def test_bad_shard_fixtures_fail_the_gate():
     findings, _ = run_shard_audit(registry=registry, baselines={})
     fired = {f.rule for f in findings}
     assert fired >= {"SA-SPEC", "SA-COLL", "SA-PAD", "SA-COST"}
+
+
+def test_thread_fleet_audits_clean():
+    # layer 5: the registered serve/obs thread fleet holds TL001-TL005
+    # (mixed-guard access, blocking under a lock, callback escape,
+    # lock-order cycles, thread lifecycle) — any unjustified concurrency
+    # hazard in the fleet fails the suite, not just `make lint`
+    from splink_tpu.analysis import run_thread_audit
+    from splink_tpu.analysis.threadlint import THREAD_REGISTRY, graph_cycles
+
+    findings, audited, graph = run_thread_audit()
+    assert audited == len(THREAD_REGISTRY) >= 15
+    assert not findings, "\n" + "\n".join(f.format() for f in findings)
+    assert graph_cycles(graph) == []
+
+
+def test_bad_thread_fixtures_fail_the_gate():
+    # falsifiability for layer 5: each bad twin trips exactly its rule
+    from splink_tpu.analysis.threadlint import TL_RULES, audit_source
+
+    fixtures = os.path.join(
+        os.path.dirname(__file__), "fixtures", "threadlint"
+    )
+    fired = set()
+    for rule in TL_RULES:
+        path = os.path.join(fixtures, f"{rule.lower()}_bad.py")
+        with open(path, encoding="utf-8") as fh:
+            findings, _ = audit_source(path, fh.read())
+        fired |= {f.rule for f in findings}
+    assert fired == set(TL_RULES)

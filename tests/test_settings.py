@@ -529,6 +529,7 @@ def test_wire_defaults_filled():
     assert s["wire_port"] == 0
     assert s["wire_connect_timeout_ms"] == 500
     assert s["wire_max_frame_bytes"] == 4 * 1024 * 1024
+    assert s["wire_max_connections"] == 64
     assert s["wire_remote_hosts"] == []
 
 
@@ -546,6 +547,10 @@ def test_wire_key_types_validated():
         {"wire_max_frame_bytes": 4095},
         {"wire_max_frame_bytes": "4MB"},
         {"wire_max_frame_bytes": 1.5},
+        {"wire_max_connections": 0},
+        {"wire_max_connections": -4},
+        {"wire_max_connections": "many"},
+        {"wire_max_connections": 8.5},
         {"wire_remote_hosts": "host:9000"},
         {"wire_remote_hosts": [9000]},
         {"wire_remote_hosts": [["host", 9000]]},
@@ -558,6 +563,7 @@ def test_wire_key_types_validated():
             wire_port=9400,
             wire_connect_timeout_ms=250.5,
             wire_max_frame_bytes=65536,
+            wire_max_connections=4,
             wire_remote_hosts=["10.0.0.2:9400", "10.0.0.3:9400"],
         )
     )

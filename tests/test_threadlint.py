@@ -1,0 +1,415 @@
+"""Analysis layer 5 (threadlint + lockwatch): rule fixtures, suppression
+machinery, the ``holds=`` annotation, the lock-order graph, the CLI
+surface, the dynamic instrumented-lock half, and the satellite hammer
+regressions (SLOTracker / FlightRecorder snapshot-vs-writer races).
+
+The fixture corpus mirrors ``tests/fixtures/jaxlint``: one bad/good twin
+pair per rule, where the bad twin also carries a suppressed copy of the
+same hazard — proving suppressions silence exactly the annotated line.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from splink_tpu.analysis import lockwatch
+from splink_tpu.analysis.threadlint import (
+    THREAD_REGISTRY,
+    TL_RULES,
+    audit_source,
+    build_lock_graph,
+    graph_cycles,
+    run_thread_audit,
+    write_lock_graph,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "threadlint")
+
+
+def _fixture(name: str):
+    path = os.path.join(FIXTURES, name)
+    with open(path, encoding="utf-8") as fh:
+        return path, fh.read()
+
+
+# -- fixture twins ------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", sorted(TL_RULES))
+def test_bad_twin_fires_exactly_its_rule(rule):
+    path, src = _fixture(f"{rule.lower()}_bad.py")
+    findings, _ = audit_source(path, src)
+    assert findings, f"{rule} bad twin produced no findings"
+    assert {f.rule for f in findings} == {rule}
+
+
+@pytest.mark.parametrize("rule", sorted(TL_RULES))
+def test_good_twin_is_silent(rule):
+    path, src = _fixture(f"{rule.lower()}_good.py")
+    findings, _ = audit_source(path, src)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_suppressed_copy_is_silenced_not_the_original():
+    # tl001_bad.py carries the same hazard twice: once bare, once with a
+    # disable comment — exactly one finding must survive
+    path, src = _fixture("tl001_bad.py")
+    findings, _ = audit_source(path, src)
+    assert len(findings) == 1
+    assert "disable" not in src.splitlines()[findings[0].line - 1]
+
+
+# -- suppression machinery ---------------------------------------------
+
+_MIXED = """\
+import threading
+
+
+class Mixed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def a(self):
+        with self._lock:
+            self._n += 1
+
+    def b(self):
+        self._n += 1
+"""
+
+
+def test_suppress_line_above():
+    src = _MIXED.replace(
+        "    def b(self):\n        self._n += 1\n",
+        "    def b(self):\n"
+        "        # threadlint: disable=TL001 (test)\n"
+        "        self._n += 1\n",
+    )
+    findings, _ = audit_source("x.py", src)
+    assert findings == []
+
+
+def test_suppress_file_level_and_all():
+    for directive in ("TL001", "all"):
+        src = f"# threadlint: disable-file={directive}\n" + _MIXED
+        findings, _ = audit_source("x.py", src)
+        assert findings == [], directive
+
+
+def test_wrong_rule_suppression_does_not_silence():
+    src = _MIXED.replace(
+        "    def b(self):\n        self._n += 1\n",
+        "    def b(self):\n"
+        "        self._n += 1  # threadlint: disable=TL002 (wrong rule)\n",
+    )
+    findings, _ = audit_source("x.py", src)
+    assert [f.rule for f in findings] == ["TL001"]
+
+
+def test_holds_annotation_seeds_the_held_set():
+    src = """\
+import threading
+
+
+class Helper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def outer(self):
+        with self._lock:
+            self._n += 1
+            self._locked_bump()
+
+    # threadlint: holds=_lock
+    def _locked_bump(self):
+        self._n += 1
+"""
+    findings, _ = audit_source("x.py", src)
+    assert findings == []
+    # and without the annotation the same shape fires TL001
+    bare = src.replace("    # threadlint: holds=_lock\n", "")
+    findings, _ = audit_source("x.py", bare)
+    assert [f.rule for f in findings] == ["TL001"]
+
+
+# -- lock graph ---------------------------------------------------------
+
+
+def test_fixture_cycle_shows_in_graph_and_tl004():
+    path, src = _fixture("tl004_bad.py")
+    findings, graph = audit_source(path, src)
+    assert [f.rule for f in findings] == ["TL004"]
+    assert {"Tangled._a", "Tangled._b"} <= set(graph["nodes"])
+    cycles = graph_cycles(graph)
+    assert any({"Tangled._a", "Tangled._b"} <= set(c) for c in cycles)
+
+
+def test_write_lock_graph_artifact(tmp_path):
+    _, src = _fixture("tl004_bad.py")
+    _, graph = audit_source("tl004_bad.py", src)
+    out = tmp_path / "lock_order_graph.json"
+    write_lock_graph(str(out), graph)
+    payload = json.loads(out.read_text())
+    assert payload["nodes"] and payload["edges"]
+    assert payload["cycles"], "the seeded cycle must land in the artifact"
+
+
+# -- the registered fleet ----------------------------------------------
+
+
+def test_registry_audits_clean_and_acyclic():
+    findings, audited, graph = run_thread_audit()
+    assert audited == len(THREAD_REGISTRY) >= 15
+    assert not findings, "\n" + "\n".join(f.format() for f in findings)
+    assert graph_cycles(graph) == [], "HEAD lock graph must be acyclic"
+
+
+def test_unknown_class_raises_keyerror():
+    with pytest.raises(KeyError):
+        run_thread_audit(classes=["NoSuchClass"])
+
+
+def test_class_filter_narrows_the_audit():
+    findings, audited, _ = run_thread_audit(classes=["SLOTracker"])
+    assert audited == 1
+    assert not findings
+
+
+# -- CLI ----------------------------------------------------------------
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "splink_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_cli_thread_audit_clean_and_fast():
+    t0 = time.monotonic()
+    proc = _cli("--thread-audit")
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "thread class(es) audited" in proc.stdout
+    assert elapsed < 30.0, f"--thread-audit took {elapsed:.1f}s"
+
+
+def test_cli_list_rules_includes_tl():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in TL_RULES:
+        assert rule in proc.stdout
+
+
+def test_cli_unknown_class_exits_2():
+    proc = _cli("--thread-classes", "NoSuchClass")
+    assert proc.returncode == 2
+
+
+def test_cli_lock_graph_artifact(tmp_path):
+    out = tmp_path / "graph.json"
+    proc = _cli("--lock-graph", str(out))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(out.read_text())
+    assert payload["nodes"]
+    assert payload["cycles"] == []
+
+
+# -- lockwatch (dynamic half) ------------------------------------------
+
+
+@pytest.fixture
+def watch(monkeypatch):
+    monkeypatch.setenv(lockwatch.ENV_VAR, "1")
+    monkeypatch.delenv(lockwatch.JITTER_ENV_VAR, raising=False)
+    lockwatch.reset()
+    yield
+    lockwatch.reset()
+
+
+def test_disabled_returns_plain_primitives(monkeypatch):
+    monkeypatch.delenv(lockwatch.ENV_VAR, raising=False)
+    assert type(lockwatch.new_lock("X.l")) is type(threading.Lock())
+    assert type(lockwatch.new_rlock("X.r")) is type(threading.RLock())
+
+
+def test_watched_lock_records_nested_edges(watch):
+    a = lockwatch.new_lock("A.lock")
+    b = lockwatch.new_lock("B.lock")
+    with a:
+        with b:
+            pass
+    graph = lockwatch.observed_graph()
+    assert {"A.lock", "B.lock"} <= set(graph["nodes"])
+    assert any(
+        e["from"] == "A.lock" and e["to"] == "B.lock" and e["count"] == 1
+        for e in graph["edges"]
+    )
+    assert lockwatch.cycles() == []
+
+
+def test_inversion_detected_and_counted(watch):
+    a = lockwatch.new_lock("A.lock")
+    b = lockwatch.new_lock("B.lock")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # closes the cycle: inversion
+            pass
+    inv = lockwatch.inversions()
+    assert len(inv) == 1
+    assert set(inv[0]["cycle"]) >= {"A.lock", "B.lock"}
+    assert any(
+        {"A.lock", "B.lock"} <= set(c) for c in lockwatch.cycles()
+    )
+
+
+def test_rlock_reentry_records_no_self_edge(watch):
+    r = lockwatch.new_rlock("R.lock")
+    with r:
+        with r:  # depth, not a new acquisition
+            pass
+    graph = lockwatch.observed_graph()
+    assert all(e["from"] != e["to"] for e in graph["edges"])
+    assert lockwatch.inversions() == []
+
+
+def test_condition_over_watched_lock(watch):
+    lk = lockwatch.new_lock("C.lock")
+    cond = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                cond.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        hits.append(1)
+        cond.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+
+
+def test_union_cycles_with_static_edges(watch):
+    a = lockwatch.new_lock("A.lock")
+    b = lockwatch.new_lock("B.lock")
+    with a:
+        with b:
+            pass
+    # observed A->B alone is acyclic; a static B->A edge closes it
+    assert lockwatch.cycles() == []
+    union = lockwatch.cycles(
+        extra_edges=[{"from": "B.lock", "to": "A.lock", "site": "static"}]
+    )
+    assert any({"A.lock", "B.lock"} <= set(c) for c in union)
+
+
+def test_dump_graph_artifact(watch, tmp_path):
+    a = lockwatch.new_lock("A.lock")
+    b = lockwatch.new_lock("B.lock")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    out = tmp_path / "lock_order_graph.json"
+    lockwatch.dump_graph(str(out))
+    payload = json.loads(out.read_text())
+    assert payload["inversions"]
+    assert payload["union_cycles"]
+
+
+# -- satellite: snapshot-vs-writer hammers -----------------------------
+
+
+def test_slo_tracker_snapshot_hammer():
+    from splink_tpu.obs.slo import SLOTracker
+
+    slo = SLOTracker()
+    n_threads, per_thread = 8, 500
+    torn = []
+    stop = threading.Event()
+
+    def writer():
+        for i in range(per_thread):
+            slo.observe(i % 3 != 0)
+
+    def reader():
+        while not stop.is_set():
+            snap = slo.snapshot()
+            # good and bad are bumped under one lock: a torn pair would
+            # let total drift from the sum of its parts
+            if snap["total_good"] + snap["total_bad"] > n_threads * per_thread:
+                torn.append(snap)
+            slo.prometheus_samples() if hasattr(slo, "prometheus_samples") else None
+
+    readers = [threading.Thread(target=reader, daemon=True) for _ in range(2)]
+    writers = [threading.Thread(target=writer) for _ in range(n_threads)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join(timeout=30.0)
+    stop.set()
+    for t in readers:
+        t.join(timeout=5.0)
+    assert not torn
+    snap = slo.snapshot()
+    assert snap["total_good"] + snap["total_bad"] == n_threads * per_thread
+
+
+def test_flight_recorder_dump_hammer(tmp_path):
+    from splink_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(
+        capacity=64, dump_dir=str(tmp_path), name="hammer",
+        min_dump_interval_s=0.0,
+    )
+    try:
+        n_threads, per_thread = 6, 200
+        errors = []
+
+        def writer(k):
+            try:
+                for i in range(per_thread):
+                    rec.emit("health", replica="hammer", seq=i, src=k)
+            except Exception as e:  # noqa: BLE001 - the hammer asserts no raise
+                errors.append(e)
+
+        def dumper():
+            try:
+                for _ in range(20):
+                    rec.dump("hammer_trigger")
+                    rec.snapshot()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(k,)) for k in range(n_threads)
+        ] + [threading.Thread(target=dumper) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors
+        # every dump written mid-storm must be parseable JSONL
+        for path in rec.dumps:
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    json.loads(line)
+    finally:
+        rec.close()

@@ -633,3 +633,105 @@ def test_remote_answers_bit_identical_to_local(trained):
         rep.close()
         server.close()
         svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Connection cap (wire_max_connections)
+# ---------------------------------------------------------------------------
+
+
+def _handshake(sock, req_id=0):
+    """One health exchange: proves the server fully registered the
+    connection (the accept loop admits sequentially)."""
+    sock.sendall(encode_frame(
+        {"v": WIRE_VERSION, "kind": "health", "id": req_id}))
+    env = read_frame(sock)
+    assert env is not None and env.get("v") == WIRE_VERSION
+    return env
+
+
+def test_connection_cap_sheds_with_error_frame():
+    """The (cap+1)-th connection is answered with ONE machine-readable
+    `server_overloaded` error envelope and closed — an explicit shed a
+    client can distinguish from a partition or a crash."""
+    svc = FakeService()
+    server = WireServer(svc, max_connections=2).start()
+    socks = []
+    try:
+        for _ in range(2):
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=WAIT)
+            _handshake(s)
+            socks.append(s)
+        over = socket.create_connection(("127.0.0.1", server.port),
+                                        timeout=WAIT)
+        socks.append(over)
+        over.settimeout(WAIT)
+        env = read_frame(over)
+        assert env == {
+            "v": WIRE_VERSION, "kind": "error", "id": None,
+            "reason": "server_overloaded", "health": "healthy",
+        }
+        assert read_frame(over) is None  # then EOF: the socket is closed
+        deadline = time.monotonic() + WAIT
+        while server.stats()["overloaded_total"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        stats = server.stats()
+        assert stats["overloaded_total"] == 1
+        assert stats["max_connections"] == 2
+        assert stats["connections_active"] == 2  # the refused conn never joined
+        # the refusal rides the Prometheus exposition too
+        samples = {s.name: s.value for s in server.prometheus_samples()}
+        assert samples["splink_wire_overloaded_total"] == 1
+        # a slot freed by a disconnect re-admits the next dial
+        socks[0].close()
+        deadline = time.monotonic() + WAIT
+        while server.stats()["connections_active"] >= 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        again = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=WAIT)
+        socks.append(again)
+        _handshake(again)
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        server.close()
+
+
+def test_remote_replica_sheds_past_the_cap():
+    """A RemoteReplica dialing a full server fails its liveness handshake
+    on the error envelope (no half-dead pooled socket) and submits shed
+    machine-readably instead of hanging."""
+    svc = FakeService()
+    server = WireServer(svc, max_connections=1).start()
+    holder = None
+    rep = None
+    try:
+        holder = socket.create_connection(("127.0.0.1", server.port),
+                                          timeout=WAIT)
+        _handshake(holder)
+        rep = _remote(server, eager_connect=False)
+        res = rep.submit({"tag": "over"}).result(timeout=WAIT)
+        assert res.shed and res.reason == "remote_unreachable"
+        assert server.stats()["overloaded_total"] >= 1
+        # the slot frees -> the same replica recovers on a later submit
+        holder.close()
+        holder = None
+        deadline = time.monotonic() + WAIT
+        while time.monotonic() < deadline:
+            res = rep.submit({"tag": "retry"}).result(timeout=WAIT)
+            if not res.shed:
+                break
+            time.sleep(0.05)
+        assert not res.shed and res.matches == [("retry", 0.5)]
+    finally:
+        if rep is not None:
+            rep.close()
+        if holder is not None:
+            holder.close()
+        server.close()
