@@ -1,4 +1,4 @@
-.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke wire-smoke thread-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke wire-smoke thread-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke fleet-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
@@ -162,6 +162,15 @@ tf-smoke:
 scale-smoke:
 	python scripts/scale_smoke.py
 
+# Fleet observability smoke: two wire hosts + a tracing router on
+# loopback under net_delay/net_partition faults — stitched cross-host
+# waterfalls telescope inside the client wall, metric federation is
+# bit-exact against the raw per-host exports, a partition burst
+# produces one correlated incident bundle, and steady state with
+# stitching on performs zero recompiles (docs/observability.md#fleet-observability).
+fleet-smoke:
+	python scripts/fleet_smoke.py
+
 bench:
 	python bench.py
 
@@ -169,4 +178,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke wire-smoke thread-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke bench
+all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke wire-smoke thread-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke fleet-smoke bench

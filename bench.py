@@ -1562,6 +1562,202 @@ def bench_wire():
     )
 
 
+def bench_fleet():
+    """Fleet observability benchmark (`python bench.py fleet`, round 17):
+    what the stitched cross-host observability plane costs.
+
+    ONE trained index behind ONE loopback WireServer serves two tracing
+    routers over separate RemoteReplica links — ``stitched`` (wire v2
+    span piggyback + clock-offset graft, the default) and ``flat``
+    (``fleet_stitching`` off: same tracing, same wire, no graft). The
+    tiers run INTERLEAVED best-of-N open bursts; the headline is the
+    stitched throughput plus the flat/stitched ratio (the price of the
+    waterfall). Alongside: the per-hop decomposition of the loopback
+    wire overhead (serialize / network / server_queue / server_execute /
+    deserialize, from the stitched link's KernelWatch), and the cost of
+    one federation scrape + /metrics render over the live remotes.
+    Gates: every stitched burst query closes with a grafted remote span,
+    and ZERO steady-state compile requests — the observability plane
+    never touches the compile cache."""
+    tier = _probe_device_init()
+    import jax
+
+    from splink_tpu.obs.events import register_ambient, unregister_ambient
+    from splink_tpu.obs.exposition import render_samples
+    from splink_tpu.obs.fleet import FleetAggregator
+    from splink_tpu.obs.metrics import (
+        compile_requests,
+        install_compile_monitor,
+    )
+    from splink_tpu import Splink
+    from splink_tpu.serve import (
+        LinkageService,
+        QueryEngine,
+        RemoteReplica,
+        ReplicaRouter,
+        WireServer,
+    )
+
+    install_compile_monitor()
+    n_rows = int(os.environ.get("SPLINK_TPU_BENCH_FLEET_ROWS", 200_000))
+    n_queries = int(os.environ.get("SPLINK_TPU_BENCH_FLEET_QUERIES", 2000))
+    repeats = int(os.environ.get("SPLINK_TPU_BENCH_FLEET_REPEATS", 5))
+    n_scrapes = int(os.environ.get("SPLINK_TPU_BENCH_FLEET_SCRAPES", 200))
+    rng = np.random.default_rng(0)
+    df = _make_df(rng, n_rows)
+
+    settings = dict(SETTINGS)
+    settings["max_iterations"] = 5
+    settings["serve_top_k"] = 5
+    settings["serve_queue_depth"] = n_queries
+    linker = Splink(settings, df=df)
+    t0 = time.perf_counter()
+    linker.estimate_parameters()
+    train_s = time.perf_counter() - t0
+    index = linker.export_index()
+
+    engine = QueryEngine(index)
+    t0 = time.perf_counter()
+    warm = engine.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    records = df.sample(
+        n=min(n_queries, len(df)), replace=n_queries > len(df),
+        random_state=0,
+    ).to_dict(orient="records")
+    while len(records) < n_queries:
+        records.extend(records[: n_queries - len(records)])
+
+    svc = LinkageService(engine, deadline_ms=None, name="fleet-host")
+    server = WireServer(svc, name="fleet-host").start()
+    rep_on = RemoteReplica(
+        ("127.0.0.1", server.port), pool_size=2,
+        request_timeout_ms=120_000.0,
+    )
+    rep_off = RemoteReplica(
+        ("127.0.0.1", server.port), pool_size=2,
+        request_timeout_ms=120_000.0,
+        settings={"fleet_stitching": False},
+    )
+    router_on = ReplicaRouter([rep_on], hedge_ms=0, trace_sample_rate=1.0)
+    router_off = ReplicaRouter([rep_off], hedge_ms=0, trace_sample_rate=1.0)
+
+    class _StitchCount:
+        def __init__(self):
+            self.stitched = 0
+            self.flat = 0
+
+        def emit(self, type, **fields):
+            if type != "request_trace":
+                return
+            if isinstance(fields.get("remote_span"), dict):
+                self.stitched += 1
+            else:
+                self.flat += 1
+
+    counter = _StitchCount()
+    register_ambient(counter)
+
+    # warm both links (connection pools, anchor samples) off the clock
+    for r in records[:64]:
+        router_on.submit(dict(r)).result(timeout=120)
+        router_off.submit(dict(r)).result(timeout=120)
+
+    # steady state starts HERE
+    c_warm = compile_requests()
+    tiers_fn = {
+        "stitched": router_on,
+        "flat": router_off,
+    }
+    best = {name: 0.0 for name in tiers_fn}
+    for rep in range(repeats):
+        order = (
+            tuple(tiers_fn) if rep % 2 == 0 else tuple(reversed(tiers_fn))
+        )
+        for name in order:
+            target = tiers_fn[name]
+            t0 = time.perf_counter()
+            futs = [target.submit(dict(r)) for r in records]
+            for f in futs:
+                res = f.result(timeout=600)
+                assert not res.shed, (name, res.reason)
+            best[name] = max(
+                best[name], n_queries / (time.perf_counter() - t0)
+            )
+    c_end = compile_requests()
+
+    # per-hop attribution of the loopback wire overhead (stitched link)
+    hops = {}
+    for hop, st in sorted(rep_on.wire_phases().items()):
+        short = st.get("short") or {}
+        hops[hop] = {
+            "p50_ms": round(float(short.get("p50_ms", 0.0) or 0.0), 4),
+            "p95_ms": round(float(short.get("p95_ms", 0.0) or 0.0), 4),
+            "observations": int(st.get("observations", 0)),
+        }
+    link = rep_on.latency_summary()
+
+    # federation scrape + /metrics render cost over the live remotes
+    agg = FleetAggregator(
+        local=None, remotes=[rep_on, rep_off], min_scrape_interval_s=0.0
+    )
+    scrape_ms = []
+    for _ in range(n_scrapes):
+        t0 = time.perf_counter()
+        merged = agg.scrape(force=True)
+        scrape_ms.append((time.perf_counter() - t0) * 1000.0)
+        assert merged is not None
+    t0 = time.perf_counter()
+    metrics_text = render_samples(agg.prometheus_samples())
+    render_ms = (time.perf_counter() - t0) * 1000.0
+    scrape_pcts = np.percentile(np.asarray(scrape_ms), [50, 95])
+
+    unregister_ambient(counter)
+    for closer in (rep_on, rep_off, router_on, router_off):
+        closer.close()
+    server.close()
+    svc.close()
+
+    qps_on, qps_off = best["stitched"], best["flat"]
+    burst_total = n_queries * repeats
+    print(json.dumps({
+        "metric": "fleet_stitched_queries_per_sec",
+        "value": round(qps_on, 1),
+        "unit": "queries/sec",
+        "flat_queries_per_sec": round(qps_off, 1),
+        "stitched_over_flat": round(qps_on / qps_off, 3),
+        "stitched_traces_delivered": counter.stitched,
+        "flat_traces_delivered": counter.flat,
+        "wire_hop_ms": hops,
+        "server_share_p50_ms": round(
+            float(link.get("server", {}).get("p50_ms", 0.0)), 3
+        ),
+        "network_share_p50_ms": round(
+            float(link.get("network", {}).get("p50_ms", 0.0)), 3
+        ),
+        "federation_scrape_p50_ms": round(float(scrape_pcts[0]), 3),
+        "federation_scrape_p95_ms": round(float(scrape_pcts[1]), 3),
+        "metrics_render_ms": round(render_ms, 3),
+        "metrics_bytes": len(metrics_text.encode("utf-8")),
+        "n_reference_rows": n_rows,
+        "n_queries": n_queries,
+        "repeats": repeats,
+        "n_scrapes": n_scrapes,
+        "train_seconds": round(train_s, 3),
+        "warmup_seconds": round(warmup_s, 3),
+        "warmup_combinations": warm["combinations"],
+        "steady_state_compiles": c_end - c_warm,
+        "device": str(jax.devices()[0]),
+        **tier,
+    }))
+    assert counter.stitched >= burst_total, (
+        f"only {counter.stitched}/{burst_total} stitched traces delivered"
+    )
+    assert c_end - c_warm == 0, (
+        f"fleet bench steady state performed {c_end - c_warm} recompiles"
+    )
+
+
 def bench_scale():
     """Offline-scale benchmark (`python bench.py scale`, BENCHMARKS.md
     round 15): (a) resident vs out-of-core index build — wall and
@@ -1950,6 +2146,8 @@ if __name__ == "__main__":
         sys.exit(_scale_child(sys.argv[i + 1], sys.argv[i + 2], sys.argv[i + 3]))
     elif "wire" in sys.argv[1:]:
         bench_wire()
+    elif "fleet" in sys.argv[1:]:
+        bench_fleet()
     elif "scale" in sys.argv[1:]:
         bench_scale()
     else:

@@ -1,0 +1,440 @@
+"""Fleet observability smoke (`make fleet-smoke`): cross-host trace
+stitching, metric federation and correlated incident bundles, end to
+end on real engines.
+
+Two real LinkageServices behind WireServers on loopback, fronted by
+RemoteReplica clients and a tracing ReplicaRouter — the multi-host
+deployment shape, minus the second machine — driven under injected
+``net_delay`` and ``net_partition`` faults. Every scenario asserts the
+fleet observability contract:
+
+  1. stitched waterfalls land: delivered request traces carry the far
+     server's span tree grafted under the client attempt, offset-
+     corrected onto the local clock, telescoping inside the client
+     wall, with the wire overhead decomposed per hop;
+  2. federation totals are BIT-exact: the FleetAggregator merge of N
+     hosts' exports equals the arithmetic union of the raw snapshots —
+     integer counters and histogram counts exactly, sums to the exact
+     float of the merge's own summation order;
+  3. an injected partition triggers ONE correlated incident bundle
+     containing the local flight ring, every reachable remote's ring,
+     the stitched-trace window, the lock graph and a manifest that
+     names the unreachable host;
+  4. steady state with stitching ON performs ZERO recompiles — the
+     observability plane never touches the compile cache;
+  5. the JSONL record + `obs summarize`/`attribute` tell the story.
+
+Exits nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WAVE_TIMEOUT_S = 60  # generous: the contract is "never hangs", not "fast"
+HOPS = ("serialize", "network", "server_queue", "server_execute",
+        "deserialize")
+
+
+def _settings():
+    return {
+        "link_type": "dedupe_only",
+        "comparison_columns": [
+            {"col_name": "first_name", "num_levels": 3},
+            {
+                "col_name": "surname",
+                "num_levels": 2,
+                "comparison": {"kind": "exact"},
+            },
+        ],
+        "blocking_rules": ["l.dob = r.dob", "l.surname = r.surname"],
+        "max_iterations": 4,
+        "serve_top_k": 64,
+        "serve_query_buckets": [16, 128],
+        "serve_candidate_buckets": [64, 256],
+        "serve_queue_depth": 256,
+        "serve_trace_sample_rate": 1.0,
+    }
+
+
+def _corpus(n=200, seed=7):
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    firsts = ["amelia", "oliver", "isla", "george", "ava", "noah", "emily"]
+    lasts = ["smith", "jones", "taylor", "brown", "wilson", "evans"]
+    return pd.DataFrame(
+        {
+            "unique_id": range(n),
+            "first_name": [str(rng.choice(firsts)) for _ in range(n)],
+            "surname": [str(rng.choice(lasts)) for _ in range(n)],
+            "dob": [f"19{rng.integers(40, 99)}" for _ in range(n)],
+        }
+    )
+
+
+def _drive(target, records, timeout=WAVE_TIMEOUT_S):
+    futures = [target.submit(dict(r)) for r in records]
+    return [f.result(timeout=timeout) for f in futures]
+
+
+def _await_recovery(rep, record, what, budget_s=20):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        res = rep.submit(dict(record)).result(timeout=WAVE_TIMEOUT_S)
+        if not res.shed:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"{what}: remote never recovered")
+
+
+def _set_plan(spec):
+    from splink_tpu.resilience import faults
+
+    faults.reset_plans()
+    if spec:
+        os.environ[faults.ENV_VAR] = spec
+    else:
+        os.environ.pop(faults.ENV_VAR, None)
+
+
+def _stitched(events, service):
+    """Delivered client-side request traces for one remote, stitched."""
+    return [
+        e for e in events
+        if e.get("type") == "request_trace"
+        and e.get("service") == service
+        and e.get("outcome") == "delivered"
+        and isinstance(e.get("remote_span"), dict)
+    ]
+
+
+def _assert_telescopes(ev, label):
+    """The grafted remote interval must nest inside the client wall
+    after offset correction (loopback: both clocks are the same clock,
+    so the tolerance is the handshake RTT, not seconds of skew)."""
+    tol = 0.1
+    t0 = float(ev["t0"])
+    t1 = t0 + float(ev["wall_ms"]) / 1e3
+    span = ev["remote_span"]
+    rt0 = float(span["t0"])
+    rt1 = rt0 + sum(float(d or 0.0) for d in span["phases_ms"].values()) / 1e3
+    assert t0 - tol <= rt0, f"{label}: remote starts before the client"
+    assert rt1 <= t1 + tol, f"{label}: remote ends after the client wall"
+    assert abs(float(ev.get("clock_offset_s", 1e9))) < 0.25, (
+        f"{label}: loopback clock offset must be ~0"
+    )
+    wire = ev.get("wire_ms") or {}
+    assert set(HOPS) <= set(wire), f"{label}: wire_ms hops {sorted(wire)}"
+    assert all(float(v) >= 0.0 for v in wire.values()), (
+        f"{label}: negative hop in {wire}"
+    )
+
+
+def main() -> int:  # noqa: PLR0915 - a linear scenario script reads best flat
+    import warnings
+
+    from splink_tpu import Splink
+    from splink_tpu.obs.cli import (
+        attribute_events,
+        parse_prometheus_text,
+        render_fleet_dash,
+        summarize_events,
+    )
+    from splink_tpu.obs.events import (
+        EventSink,
+        read_events,
+        register_ambient,
+        unregister_ambient,
+    )
+    from splink_tpu.obs.exposition import render_samples
+    from splink_tpu.obs.fleet import FleetAggregator, FleetIncidentReporter
+    from splink_tpu.obs.flight import FlightRecorder
+    from splink_tpu.obs.metrics import compile_requests, install_compile_monitor
+    from splink_tpu.obs.tracer import chrome_trace_from_events
+    from splink_tpu.resilience.retry import RetryPolicy
+    from splink_tpu.serve import (
+        LinkageService,
+        QueryEngine,
+        RemoteReplica,
+        ReplicaRouter,
+        WireServer,
+        load_index,
+    )
+
+    install_compile_monitor()
+    warnings.simplefilter("ignore")  # degradations are asserted via events
+    _set_plan("")
+    tmp = tempfile.mkdtemp(prefix="splink_fleet_")
+    events_path = os.path.join(tmp, "fleet_events.jsonl")
+    sink = EventSink(events_path, run_id="fleet-smoke")
+    register_ambient(sink)
+
+    df = _corpus()
+    linker = Splink(_settings(), df=df)
+    linker.estimate_parameters()
+    idx_path = os.path.join(tmp, "idx")
+    linker.export_index(idx_path)
+
+    def _stack(name):
+        engine = QueryEngine(load_index(idx_path))
+        engine.warmup()
+        svc = LinkageService(engine, deadline_ms=None, name=name)
+        server = WireServer(svc, name=name).start()
+        return svc, server
+
+    def _remote(server, **over):
+        kw = dict(
+            pool_size=2,
+            retry_policy=RetryPolicy(base_delay=0.05, max_delay=0.5),
+            breaker_threshold=2,
+            breaker_cooldown_s=0.2,
+            connect_timeout_ms=300.0,
+            request_timeout_ms=WAVE_TIMEOUT_S * 1000.0,
+        )
+        kw.update(over)
+        return RemoteReplica(("127.0.0.1", server.port), **kw)
+
+    svc_a, server_a = _stack("host-a")
+    svc_b, server_b = _stack("host-b")
+    rep_a = _remote(server_a)
+    rep_b = _remote(server_b)
+    assert rep_a.peer_version == 2 and rep_b.peer_version == 2
+
+    local_flight = FlightRecorder(
+        256, dump_dir=os.path.join(tmp, "flight"), name="router-host"
+    )
+    register_ambient(local_flight)
+    reporter = FleetIncidentReporter(
+        local_flight=local_flight,
+        remotes=[rep_a, rep_b],
+        bundle_dir=os.path.join(tmp, "incidents"),
+        interval_s=5.0,
+        partition_burst=2,
+        burst_window_s=10.0,
+    )
+    router = ReplicaRouter(
+        [rep_a, rep_b],
+        hedge_ms=0,
+        trace_sample_rate=1.0,
+        incident_reporter=reporter,
+    )
+
+    records = df.head(100).to_dict(orient="records")
+    wave = records[:20]
+
+    # ---- A: stitched waterfalls on every delivered request --------------
+    results = _drive(router, records[:30])
+    assert not any(r.shed for r in results), "A: warm wave must serve"
+    events = read_events(events_path)  # the sink flushes per event
+    stitched = _stitched(events, rep_a.name) + _stitched(events, rep_b.name)
+    assert len(stitched) >= 20, (
+        f"A: only {len(stitched)} stitched trace(s) for 30 delivered"
+    )
+    for ev in stitched:
+        _assert_telescopes(ev, "A")
+        span = ev["remote_span"]
+        assert span.get("service") in ("host-a", "host-b")
+        assert "t0_remote" in span, "A: raw far-clock t0 must survive"
+        assert span.get("phases_ms"), "A: remote phase partition missing"
+    chrome = chrome_trace_from_events(events)
+    remote_rows = [
+        t for t in chrome["traceEvents"]
+        if t.get("cat") == "remote" and t.get("ph") == "X"
+    ]
+    assert remote_rows, "A: chrome trace must render the stitched row"
+    assert any(
+        t.get("args", {}).get("name") == "remote (stitched)"
+        for t in chrome["traceEvents"] if t.get("ph") == "M"
+    ), "A: stitched row must be named"
+    print(f"fleet A ok: {len(stitched)} stitched waterfall(s), "
+          f"{len(remote_rows)} remote slices in the chrome trace")
+
+    # ---- B: batched envelopes are bit-identical to per-record -----------
+    single = _drive(rep_b, wave)
+    batched = rep_b.submit_many([dict(r) for r in wave])
+    batched = [f.result(timeout=WAVE_TIMEOUT_S) for f in batched]
+    assert len(batched) == len(single)
+    timing = ("latency_ms", "queue_ms", "execute_ms")
+    for s, b in zip(single, batched):
+        assert not s.shed and not b.shed
+        ps = {k: v for k, v in s.to_payload().items() if k not in timing}
+        pb = {k: v for k, v in b.to_payload().items() if k not in timing}
+        assert ps == pb, (
+            "B: batched answer differs from per-record answer "
+            "(beyond per-call timings)"
+        )
+    print(f"fleet B ok: {len(batched)} batched answers bit-identical")
+
+    # ---- C: net_delay -> the slow link shows up in the decomposition ----
+    _set_plan("wire_request@kind=net_delay:delay_ms=250:times=6")
+    slow = _drive(rep_a, records[30:36])
+    _set_plan("")
+    assert not any(r.shed for r in slow), "C: delayed wave must still serve"
+    summary = rep_a.latency_summary()
+    assert summary["server"]["n"] >= 6 and summary["network"]["n"] >= 6
+    attributed = summary["server"]["p95_ms"] + summary["network"]["p95_ms"]
+    assert attributed >= 150.0, (
+        f"C: a 250ms stall must dominate the split, got {attributed:.1f}ms"
+    )
+    phases = rep_a.wire_phases()
+    for hop in HOPS:
+        assert phases.get(hop, {}).get("observations", 0) > 0, (
+            f"C: no observations for hop {hop}"
+        )
+    print(f"fleet C ok: 250ms stall attributed "
+          f"({attributed:.0f}ms across server+network p95)")
+
+    # ---- D: federation totals bit-exact ---------------------------------
+    agg = FleetAggregator(
+        local=None, remotes=[rep_a, rep_b], min_scrape_interval_s=0.0
+    )
+    merged = agg.scrape(force=True)
+    raw = agg.raw_snapshots()
+    assert merged and len(raw) == 2, "D: both hosts must be scraped"
+    for key in merged["counters"]:
+        total = sum(int(s.get("counters", {}).get(key, 0)) for s in raw)
+        assert merged["counters"][key] == total, (
+            f"D: counter {key}: merged {merged['counters'][key]} != {total}"
+        )
+    slo = merged["slo"]
+    assert slo["total_good"] == sum(s["slo"]["total_good"] for s in raw)
+    assert slo["total_bad"] == sum(s["slo"]["total_bad"] for s in raw)
+    checked_phases = 0
+    for phase, h in (merged.get("perf", {}).get("phases") or {}).items():
+        parts = [
+            s["perf"]["phases"][phase]
+            for s in raw
+            if phase in s.get("perf", {}).get("phases", {})
+        ]
+        width = max(len(p["counts"]) for p in parts)
+        for i in range(width):
+            total = sum(
+                p["counts"][i] for p in parts if i < len(p["counts"])
+            )
+            assert h["counts"][i] == total, (
+                f"D: {phase} bucket {i}: {h['counts'][i]} != {total}"
+            )
+        assert h["n"] == sum(p["n"] for p in parts), f"D: {phase} n"
+        folded = 0.0
+        for p in parts:  # the merge's own left-fold order: exact, not fsum
+            folded += float(p["sum"])
+        assert h["sum"] == folded, (
+            f"D: {phase} sum {h['sum']!r} != {folded!r} (bit-exact gate)"
+        )
+        checked_phases += 1
+    assert checked_phases >= 1, "D: no perf histograms federated"
+    text = render_samples(agg.prometheus_samples())
+    assert "splink_fleet_hosts 2" in text, "D: /metrics must count hosts"
+    assert "splink_fleet_phase_seconds_bucket" in text
+    dash = render_fleet_dash(parse_prometheus_text(text))
+    assert "federated hosts: 2" in dash, "D: fleet dash must render"
+    print(f"fleet D ok: {checked_phases} phase histogram(s) + "
+          f"{len(merged['counters'])} counter(s) merged bit-exactly "
+          f"across {len(raw)} hosts")
+
+    # ---- E: partition -> ONE correlated incident bundle -----------------
+    # park requests on both pooled connections behind a server-side
+    # stall, then drop the link: the in-flight sheds are the partition
+    # burst the reporter correlates into a bundle
+    _set_plan("wire_request@kind=net_delay:delay_ms=800:times=4")
+    parked = [rep_a.submit(dict(r)) for r in records[40:44]]
+    time.sleep(0.25)
+    server_a.partition(2.0)
+    dead = [f.result(timeout=WAVE_TIMEOUT_S) for f in parked]
+    _set_plan("")
+    assert any(
+        r.shed and r.reason == "connection_lost" for r in dead
+    ), f"E: partition must shed in-flight, got {[r.reason for r in dead]}"
+    deadline = time.monotonic() + 15
+    while not reporter.bundles and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert reporter.bundles, "E: the partition burst must trigger a bundle"
+    bundle = reporter.bundles[0]
+    with open(os.path.join(bundle, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["trigger"] == "partition"
+    for fname in manifest["files"]:
+        assert os.path.exists(os.path.join(bundle, fname)), (
+            f"E: manifest lists missing file {fname}"
+        )
+    assert "flight_local.jsonl" in manifest["files"], "E: local ring missing"
+    remote_rings = [
+        f for f in manifest["files"]
+        if f.startswith("flight_") and f != "flight_local.jsonl"
+    ]
+    assert remote_rings, "E: the reachable remote's ring must be pulled"
+    with open(os.path.join(bundle, remote_rings[0])) as fh:
+        header = json.loads(fh.readline())
+    assert header["type"] == "flight_header" and header["records"] >= 1
+    assert "stitched_traces.jsonl" in manifest["files"], (
+        "E: the in-flight trace window must ride the bundle"
+    )
+    assert "lock_graph.json" in manifest["files"]
+    assert any("host-a" in u or "remote:" in u for u in manifest["unreachable"]), (
+        f"E: the partitioned host must be named unreachable, "
+        f"got {manifest['unreachable']}"
+    )
+    _await_recovery(rep_a, wave[0], "E heal")
+    print(f"fleet E ok: partition burst -> 1 bundle, "
+          f"{len(manifest['files'])} file(s), "
+          f"unreachable={manifest['unreachable']}")
+
+    # ---- steady state: stitching ON costs zero recompiles ---------------
+    c0 = compile_requests()
+    steady = _drive(router, records[:40])
+    assert not any(r.shed for r in steady), "steady-state wave must serve"
+    c1 = compile_requests()
+    assert c1 - c0 == 0, (
+        f"steady state performed {c1 - c0} recompile(s) with stitching on"
+    )
+    print("fleet steady-state ok: 40 stitched queries, 0 recompiles")
+
+    reporter.close()
+    for closer in (rep_a, rep_b, router):
+        closer.close()
+    server_a.close()
+    server_b.close()
+    svc_a.close()
+    svc_b.close()
+    unregister_ambient(local_flight)
+
+    # ---- the JSONL record must tell the whole story ---------------------
+    sink.close()
+    unregister_ambient(sink)
+    events = read_events(events_path)
+    by_type = {}
+    for e in events:
+        by_type[e.get("type")] = by_type.get(e.get("type"), 0) + 1
+    for expected in ("request_trace", "wire_shed", "fleet_scrape",
+                     "incident_bundle", "fault"):
+        assert by_type.get(expected), (
+            f"missing {expected} events in the JSONL record: {by_type}"
+        )
+    text = summarize_events(events)
+    assert "federation scrape" in text, "summarize must render the fleet"
+    assert "BUNDLE" in text, "summarize must point at the bundle"
+    assert "stitched" in text, "summarize must report wire overhead"
+    attr = attribute_events(events)
+    assert "wire decomposition" in attr, (
+        "attribute must decompose the stitched wire overhead"
+    )
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(
+        "fleet-smoke OK: stitched waterfalls telescoped, federation "
+        "bit-exact, partition produced one correlated bundle, zero "
+        "steady-state compiles"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

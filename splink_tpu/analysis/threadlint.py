@@ -197,6 +197,8 @@ THREAD_REGISTRY: tuple[ClassSpec, ...] = (
     ClassSpec("splink_tpu/obs/slo.py", "SLOTracker"),
     ClassSpec("splink_tpu/obs/flight.py", "FlightRecorder"),
     ClassSpec("splink_tpu/obs/events.py", "EventSink"),
+    ClassSpec("splink_tpu/obs/fleet.py", "FleetAggregator"),
+    ClassSpec("splink_tpu/obs/fleet.py", "FleetIncidentReporter"),
 )
 
 

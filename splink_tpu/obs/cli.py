@@ -1,5 +1,5 @@
 """CLI: ``python -m splink_tpu.obs
-summarize|export-trace|attribute|drift|serve-dash``.
+summarize|export-trace|attribute|drift|serve-dash|fleet-dash``.
 
 ``summarize`` renders a per-stage / per-iteration report of one run's
 telemetry record; ``export-trace`` converts it to Chrome trace-event JSON
@@ -412,6 +412,73 @@ def summarize_events(events: list[dict]) -> str:
                     f"{_or0(ev.get('dropped'))} connection(s) dropped"
                 )
 
+    # ---- fleet observability (obs/fleet.py) ------------------------------
+    fleet_types = ("fleet_scrape", "fleet_net_alert", "fleet_net_clear",
+                   "incident_bundle")
+    fleet = [e for e in events if e.get("type") in fleet_types]
+    stitched = [e for e in events
+                if e.get("type") == "request_trace"
+                and e.get("remote_span") is not None]
+    if fleet or stitched:
+        counts = {t: sum(1 for e in fleet if e["type"] == t)
+                  for t in fleet_types}
+        lines.append("")
+        lines.append(
+            f"fleet: {counts['fleet_scrape']} federation scrape(s), "
+            f"{counts['fleet_net_alert']} network alert(s), "
+            f"{counts['incident_bundle']} incident bundle(s), "
+            f"{len(stitched)} stitched trace(s)"
+        )
+        scrapes = [e for e in fleet if e["type"] == "fleet_scrape"]
+        if scrapes:
+            last = scrapes[-1]
+            # torn-record or-0: hosts/served genuinely 0 only on an
+            # unreachable fleet, which IS what the line should say
+            lines.append(
+                f"  last scrape: {_or0(last.get('hosts'))} host(s), "
+                f"served={_or0(last.get('served'))}"
+                + (f", unreachable: {', '.join(last['unreachable'])}"
+                   if last.get("unreachable") else "")
+            )
+        for ev in fleet:
+            if ev["type"] == "fleet_net_alert":
+                for a in ev.get("alerts") or []:
+                    lines.append(
+                        f"  NET ALERT {ev.get('replica') or '?'}: "
+                        f"p95 {_or0(a.get('short_p95_ms'))}/"
+                        f"{_or0(a.get('long_p95_ms'))}ms vs anchor "
+                        f"{_or0(a.get('anchor_ms'))}ms "
+                        f"({_or0(a.get('ratio'))}x)"
+                    )
+            elif ev["type"] == "fleet_net_clear":
+                lines.append(
+                    f"  net alert cleared ({ev.get('replica') or '?'})"
+                )
+            elif ev["type"] == "incident_bundle":
+                lines.append(
+                    f"  BUNDLE [{ev.get('trigger') or '?'}] "
+                    f"{ev.get('path') or '?'}: "
+                    f"{len(ev.get('files') or [])} file(s)"
+                    + (f", unreachable: {', '.join(ev['unreachable'])}"
+                       if ev.get("unreachable") else "")
+                )
+        if stitched:
+            offsets = [
+                e.get("clock_offset_s") for e in stitched
+                if isinstance(e.get("clock_offset_s"), (int, float))
+            ]
+            wires = [e.get("wire_ms") or {} for e in stitched]
+            nets = sorted(
+                float(w.get("network") or 0.0) for w in wires
+            )
+            lines.append(
+                f"  stitched wire overhead: network p50="
+                f"{_quantile(nets, 0.5):.3f}ms "
+                f"p99={_quantile(nets, 0.99):.3f}ms"
+                + (f", clock offset ~{offsets[-1]:+.4f}s"
+                   if offsets else "")
+            )
+
     # ---- concurrency audit (analysis/lockwatch.py + thread-smoke) --------
     inversions = [e for e in events if e.get("type") == "lock_inversion"]
     audits = [e for e in events if e.get("type") == "thread_audit"]
@@ -542,6 +609,26 @@ def attribute_events(events: list[dict]) -> str:
     lines.append(
         f"{'(sum)':<12}{'':>10}{'':>10}{'':>12}{covered:>11.1%}"
     )
+    # stitched remote attempts (obs/fleet.py): the wire-overhead
+    # decomposition of every delivered trace that carries a grafted
+    # remote span — where a remote round trip actually went
+    remote = [e for e in delivered if e.get("wire_ms")]
+    if remote:
+        lines.append("")
+        lines.append(
+            f"wire decomposition over {len(remote)} stitched remote "
+            "attempt(s), mean ms per hop:"
+        )
+        hops = ("serialize", "network", "server_queue",
+                "server_execute", "deserialize")
+        for hop in hops:
+            vals = [
+                float((e.get("wire_ms") or {}).get(hop) or 0.0)
+                for e in remote
+            ]
+            lines.append(
+                f"  {hop:<16}{sum(vals) / len(vals):>10.3f}"
+            )
     shed = [
         e for e in events
         if e.get("type") == "request_trace" and e.get("outcome") == "shed"
@@ -965,9 +1052,85 @@ def render_dash(rows: list[tuple[str, dict, float]]) -> str:
     return "\n".join(lines)
 
 
-def serve_dash(url: str, interval: float, count: int | None) -> int:
+def render_fleet_dash(rows: list[tuple[str, dict, float]]) -> str:
+    """One terminal frame of the fleet dashboard from the federation
+    endpoint's merged ``splink_fleet_*`` samples (obs/fleet.py)."""
+
+    def get(name, **labels):
+        for n, ls, v in rows:
+            if n == name and all(ls.get(k) == str(v2)
+                                 for k, v2 in labels.items()):
+                return v
+        return None
+
+    def fmt(v, spec="{:.0f}", missing="-"):
+        return spec.format(v) if v is not None else missing
+
+    hosts = get("splink_fleet_hosts")
+    lines = [
+        f"splink_tpu fleet dashboard  ({time.strftime('%H:%M:%S')})",
+        "",
+        f"federated hosts: {fmt(hosts)}",
+    ]
+    counters = sorted({
+        n for n, _ls, _v in rows
+        if n.startswith("splink_fleet_") and n.endswith("_total")
+        and not n.startswith("splink_fleet_slo_")
+    })
+    if counters:
+        lines.append("  " + "  ".join(
+            f"{n[len('splink_fleet_'):-len('_total')]}={fmt(get(n))}"
+            for n in counters
+        ))
+    good, bad = get("splink_fleet_slo_good_total"), get("splink_fleet_slo_bad_total")
+    if good is not None or bad is not None:
+        windows = sorted(
+            {ls.get("window_s") for n, ls, _ in rows
+             if n == "splink_fleet_slo_burn_rate"},
+            key=lambda w: int(w) if w and w.isdigit() else 0,
+        )
+        lines.append(
+            f"  slo: good={fmt(good)} bad={fmt(bad)}"
+            + ("  burn: " + "  ".join(
+                f"{w}s={fmt(get('splink_fleet_slo_burn_rate', window_s=w), '{:.2f}')}"
+                for w in windows
+            ) if windows else "")
+        )
+    replicas = sorted({
+        ls.get("replica") for n, ls, _ in rows
+        if n == "splink_fleet_host_health_rank" and ls.get("replica")
+    })
+    for rep in replicas:
+        rank = get("splink_fleet_host_health_rank", replica=rep)
+        state = {0: "healthy", 1: "degraded", 2: "broken"}.get(
+            int(rank) if rank is not None else -1, "?"
+        )
+        lines.append(f"  host {rep}: {state}")
+    phases = sorted({
+        ls.get("phase") for n, ls, _ in rows
+        if n == "splink_fleet_phase_seconds_count" and ls.get("phase")
+    })
+    if phases:
+        lines.append("")
+        lines.append(f"  {'phase':<16}{'count':>10}{'mean ms':>10}")
+        for p in phases:
+            n = get("splink_fleet_phase_seconds_count", phase=p)
+            s = get("splink_fleet_phase_seconds_sum", phase=p)
+            mean = (s / n * 1e3) if n else None
+            lines.append(
+                f"  {p:<16}{fmt(n):>10}{fmt(mean, '{:.3f}'):>10}"
+            )
+    if hosts is None:
+        lines.append("(no splink_fleet_* series at this endpoint)")
+    return "\n".join(lines)
+
+
+def serve_dash(url: str, interval: float, count: int | None,
+               renderer=render_dash) -> int:
     """Poll ``url`` and render frames until interrupted (or ``count``
-    frames, for scripting/tests)."""
+    frames, for scripting/tests). ``renderer`` picks the view —
+    :func:`render_dash` (one host) or :func:`render_fleet_dash` (the
+    federation endpoint)."""
     import urllib.request
 
     frames = 0
@@ -975,7 +1138,7 @@ def serve_dash(url: str, interval: float, count: int | None) -> int:
         try:
             with urllib.request.urlopen(url, timeout=5) as resp:
                 text = resp.read().decode("utf-8", "replace")
-            frame = render_dash(parse_prometheus_text(text))
+            frame = renderer(parse_prometheus_text(text))
         except Exception as e:  # noqa: BLE001 - a dead endpoint is a frame, not a crash
             frame = f"splink_tpu serve dashboard\n\n(endpoint {url}: {e})"
         print("\x1b[2J\x1b[H" + frame if count is None else frame,
@@ -1047,10 +1210,27 @@ def main(argv=None) -> int:
         "--count", type=int, default=None,
         help="render N frames then exit (default: until interrupted)",
     )
+    p_fleet = sub.add_parser(
+        "fleet-dash",
+        help="multi-host dashboard over the federation /metrics endpoint "
+             "(obs/fleet.py FleetAggregator)",
+    )
+    p_fleet.add_argument(
+        "--url", default="http://127.0.0.1:9464/metrics",
+        help="federation exposition endpoint",
+    )
+    p_fleet.add_argument("--interval", type=float, default=1.0)
+    p_fleet.add_argument(
+        "--count", type=int, default=None,
+        help="render N frames then exit (default: until interrupted)",
+    )
     args = parser.parse_args(argv)
 
     if args.command == "serve-dash":
         return serve_dash(args.url, args.interval, args.count)
+    if args.command == "fleet-dash":
+        return serve_dash(args.url, args.interval, args.count,
+                          renderer=render_fleet_dash)
 
     if args.command == "bench-report":
         paths = args.paths or _default_bench_paths(args.dir)

@@ -508,6 +508,27 @@ class DriftMonitor:
             return None
         return agg[2]
 
+    def export_aggregate(self, window_s: float | None = None) -> dict | None:
+        """JSON-serialisable trailing-window aggregate for metric
+        federation (obs/fleet.py): the summed gamma/score count tensors
+        and serve-side counters. Everything is an integer count, so N
+        hosts' exports merge by plain addition into exactly the aggregate
+        a single monitor over the union of traffic would report."""
+        agg = self._aggregate(window_s if window_s is not None else self.window_s)
+        if agg is None:
+            return None
+        gamma, score, score_all, counters = agg
+        return {
+            "window_s": float(window_s if window_s is not None else self.window_s),
+            "gamma": gamma.tolist(),
+            "score": score.tolist(),
+            "score_all": score_all.tolist(),
+            "counters": {
+                **{k: int(v) for k, v in counters.items() if k != "nulls"},
+                "nulls": counters["nulls"].tolist(),
+            },
+        }
+
     def alerts(self, short: dict | None = None,
                long_: dict | None = None) -> list[dict]:
         """Fired two-window drift alerts. A PSI channel alerts only when

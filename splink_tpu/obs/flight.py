@@ -74,6 +74,13 @@ TRANSITION_TYPES = (
     # the timeline with what the fleet looked like when it was checked
     "lock_inversion",
     "thread_audit",
+    # fleet observability (obs/fleet.py): federation sweeps, network-
+    # phase regression edges on a remote link, and the pointer to a
+    # written incident bundle all belong on the incident timeline
+    "fleet_scrape",
+    "fleet_net_alert",
+    "fleet_net_clear",
+    "incident_bundle",
 )
 
 _RECORDERS: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()

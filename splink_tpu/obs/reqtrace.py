@@ -133,6 +133,13 @@ class RequestTrace:
     t_submit: float = field(default_factory=time.monotonic)
     marks: dict = field(default_factory=dict)
     _closed: bool = False
+    #: optional hook invoked with the emitted event dict when this
+    #: attempt's span tree closes. The wire tier uses it to piggyback the
+    #: span on the result envelope (fleet stitching): the service resolves
+    #: the future FIRST and closes the trace immediately after on the same
+    #: worker thread, so the response waits microseconds for the span
+    #: instead of the span missing the response. Never raises outward.
+    on_close: object = field(default=None, repr=False)
 
     @property
     def trace_id(self) -> str:
@@ -295,6 +302,12 @@ class ServeTracer:
         publish("request_trace", **event)
         if self.flight is not None:
             self.flight.note_trace(dict(event, type="request_trace"))
+        cb = trace.on_close
+        if cb is not None:
+            try:
+                cb(event)
+            except Exception as e:  # noqa: BLE001 - a span consumer must not break close
+                logger.warning("trace on_close hook failed: %s", e)
         return event
 
     def phase_summary(self) -> dict:

@@ -169,3 +169,73 @@ class SLOTracker:
             "windows": windows,
             "alerts": self.alerts(),
         }
+
+    def export(self) -> dict:
+        """JSON-serialisable bucket-ring export for metric federation
+        (obs/fleet.py): the raw ``[bucket_index, good, bad]`` ring plus
+        the tracker's shape. Buckets are integer counts keyed by absolute
+        bucket index, so N hosts' exports merge by per-index addition into
+        exactly the ring one tracker over the union of observations would
+        hold (:func:`merge_exports`) — provided the hosts share a clock
+        domain, which federation's per-connection offset estimate
+        corrects for at bucket granularity."""
+        with self._lock:
+            buckets = [list(b) for b in self._buckets]
+            total_good = self.total_good
+            total_bad = self.total_bad
+        return {
+            "objective": self.objective,
+            "bucket_s": self.bucket_s,
+            "windows": list(self.windows),
+            "buckets": buckets,
+            "total_good": total_good,
+            "total_bad": total_bad,
+        }
+
+
+def merge_exports(exports: list[dict]) -> dict | None:
+    """Merge N :meth:`SLOTracker.export` payloads (deterministic input
+    order) into one federated view: buckets add per index, totals add,
+    and the per-window hit/burn rates are recomputed over the merged ring
+    relative to its newest bucket. Exports with mismatched ``bucket_s``
+    merge on the first export's bucket size (indices are absolute, so a
+    mismatch only coarsens attribution, never double-counts). Returns
+    None for an empty input."""
+    exports = [e for e in exports if e]
+    if not exports:
+        return None
+    objective = float(exports[0].get("objective") or 0.999)
+    bucket_s = float(exports[0].get("bucket_s") or 1.0)
+    windows = exports[0].get("windows") or [60.0, 300.0, 1800.0]
+    merged: dict[int, list[int]] = {}
+    total_good = total_bad = 0
+    for e in exports:
+        total_good += int(e.get("total_good") or 0)
+        total_bad += int(e.get("total_bad") or 0)
+        for idx, good, bad in e.get("buckets") or []:
+            slot = merged.setdefault(int(idx), [0, 0])
+            slot[0] += int(good)
+            slot[1] += int(bad)
+    now_idx = max(merged) if merged else 0
+    out_windows = {}
+    for w in windows:
+        first = now_idx - int(math.ceil(float(w) / bucket_s)) + 1
+        good = sum(g for idx, (g, _) in merged.items() if idx >= first)
+        bad = sum(b for idx, (_, b) in merged.items() if idx >= first)
+        total = good + bad
+        out_windows[str(int(w))] = {
+            "total": total,
+            "bad": bad,
+            "hit_rate": round(good / total, 6) if total else None,
+            "burn_rate": round((bad / total) / (1.0 - objective), 4)
+            if total
+            else 0.0,
+        }
+    return {
+        "objective": objective,
+        "bucket_s": bucket_s,
+        "hosts": len(exports),
+        "total_good": total_good,
+        "total_bad": total_bad,
+        "windows": out_windows,
+    }

@@ -87,8 +87,12 @@ class Tracer:
             self.end(sid)
 
 
-# Track rows in the chrome trace, one per span kind.
-_KIND_TID = {"run": 0, "stage": 1, "em_iteration": 2, "request": 3}
+# Track rows in the chrome trace, one per span kind. Row 4 renders the
+# grafted REMOTE half of stitched cross-host traces (obs/fleet.py): the
+# far server's span tree, rebased onto this host's clock by the wire
+# client's offset estimate, directly under the local attempt row.
+_KIND_TID = {"run": 0, "stage": 1, "em_iteration": 2, "request": 3,
+             "remote": 4}
 
 
 def chrome_trace_from_events(events: list[dict]) -> dict:
@@ -134,6 +138,34 @@ def chrome_trace_from_events(events: list[dict]) -> dict:
                     }
                 )
                 t += dur
+            remote = ev.get("remote_span")
+            if isinstance(remote, dict):
+                # the stitched remote waterfall: offset-corrected t0 (the
+                # wire client already rebased it), the server's own phase
+                # partition back-to-back on the "remote" row
+                rt = float(remote.get("t0", 0.0)) * 1e6
+                renv = dict(
+                    envelope,
+                    remote_service=remote.get("service"),
+                    clock_offset_s=ev.get("clock_offset_s"),
+                    wire_ms=ev.get("wire_ms"),
+                )
+                for phase, dur_ms in (remote.get("phases_ms") or {}).items():
+                    dur = max(float(dur_ms or 0.0), 0.0) * 1e3
+                    trace_events.append(
+                        {
+                            "name": f"{phase} [{remote.get('request_id', '?')}"
+                                    f"@{remote.get('service', 'remote')}]",
+                            "cat": "remote",
+                            "ph": "X",
+                            "ts": rt,
+                            "dur": dur,
+                            "pid": pid,
+                            "tid": _KIND_TID["remote"],
+                            "args": dict(renv, phase=phase),
+                        }
+                    )
+                    rt += dur
             continue
         if etype == "span":
             tid = _KIND_TID.get(ev.get("kind", "stage"), 1)
@@ -176,6 +208,6 @@ def chrome_trace_from_events(events: list[dict]) -> dict:
          "args": {"name": row}}
         for pid in sorted(pids)
         for row, tid in (("run", 0), ("stages", 1), ("em / events", 2),
-                         ("requests", 3))
+                         ("requests", 3), ("remote (stitched)", 4))
     ]
     return {"traceEvents": meta + trace_events, "displayTimeUnit": "ms"}

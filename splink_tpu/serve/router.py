@@ -92,7 +92,7 @@ class ReplicaRouter:
     """
 
     def __init__(self, replicas, *, hedge_ms=None, telemetry=None,
-                 trace_sample_rate=None):
+                 trace_sample_rate=None, incident_reporter=None):
         from ..obs.reqtrace import ServeTracer
 
         self._replicas = list(replicas)
@@ -118,6 +118,10 @@ class ReplicaRouter:
             trace_sample_rate = settings.get("serve_trace_sample_rate", 0.0)
         self._tracer = ServeTracer(trace_sample_rate or 0.0, service="router")
         self._obs = telemetry
+        # optional FleetIncidentReporter (obs/fleet.py): the router feeds
+        # it hedge dispatches so a hedge STORM — every primary slow at
+        # once — triggers a correlated incident bundle
+        self._incident = incident_reporter
         self._lock = lockwatch.new_lock("ReplicaRouter._lock")
         self._rr = 0
         self.dispatched = 0
@@ -306,6 +310,11 @@ class _HedgedCall:
             return
         if self._dispatch_next(hedge=True) is not None:
             self.router._bump("hedges")
+            reporter = self.router._incident
+            if reporter is not None:
+                # outside every lock: note_hedge may trigger a bundle
+                # thread and must not serialize the hedge timer
+                reporter.note_hedge()
 
     def _on_done(self, idx: int, fut) -> None:
         try:

@@ -112,6 +112,13 @@ class QueryResult:
     # from the approx LSH fallback bucket path (docs/blocking.md)
     approx: bool = False
     reason: str | None = None
+    # server-side latency split (fleet observability, PR 18): time this
+    # request waited in the replica's queue vs the engine wall it shared.
+    # Always stamped on delivered results — even with fleet features off —
+    # so a wire client can answer "is it the link or the replica?" from
+    # two JSON fields (queue_ms + execute_ms = the server's share of RTT).
+    queue_ms: float | None = None
+    execute_ms: float | None = None
 
     # -- wire round-trip (serve/wire.py envelope "result" field) --------
     # JSON float serialisation is exact (repr round-trips every double),
@@ -127,6 +134,8 @@ class QueryResult:
             "degraded": bool(self.degraded),
             "approx": bool(self.approx),
             "reason": self.reason,
+            "queue_ms": self.queue_ms,
+            "execute_ms": self.execute_ms,
         }
 
     @classmethod
@@ -141,6 +150,8 @@ class QueryResult:
             degraded=bool(payload.get("degraded")),
             approx=bool(payload.get("approx")),
             reason=payload.get("reason"),
+            queue_ms=payload.get("queue_ms"),
+            execute_ms=payload.get("execute_ms"),
         )
 
 
@@ -151,6 +162,11 @@ class LinkageService:
     #: routers check this before forwarding a trace context (duck-typed
     #: replicas without it keep the PR 6 submit signature)
     accepts_trace = True
+
+    #: every attempt this service resolves closes its span tree exactly
+    #: once — the contract the wire tier's v2 span piggyback gates the
+    #: result reply on (serve/wire.py ``_SpanJoin``)
+    closes_traces = True
 
     def __init__(
         self,
@@ -687,6 +703,9 @@ class LinkageService:
             from ..obs.reqtrace import PhaseProfile
 
             profile = PhaseProfile()
+        # queue/execute split stamp (fleet observability): everything up
+        # to here was queueing/coalescing; the engine window follows
+        t_dispatch = time.monotonic()
         t0 = time.perf_counter()
         try:
             active_plan(self._settings).fire(
@@ -765,6 +784,10 @@ class LinkageService:
             res = results[i]
             res.degraded = degraded
             res.latency_ms = (now - t_enq[i]) * 1000.0
+            # per-request queue wait + the shared engine wall: host-side
+            # subtraction on stamps already taken, no new clock reads
+            res.queue_ms = (t_dispatch - t_enq[i]) * 1000.0
+            res.execute_ms = batch_ms
             if fut.done():
                 continue
             try:
@@ -1321,6 +1344,66 @@ class LinkageService:
         """Rolling hit rate + multi-window burn rates
         (:class:`~..obs.slo.SLOTracker`): delivered = good, shed = bad."""
         return self._slo.snapshot()
+
+    def fleet_stats(self) -> dict:
+        """Mergeable, JSON-serialisable stats export for metric federation
+        (:mod:`..obs.fleet`; served over the wire as the ``stats``
+        envelope). Everything here merges by construction: counters add,
+        the kernel watch's log2-bucket histograms add element-wise with
+        an exact ``sum``, the SLO tracker's time-bucketed ring adds per
+        bucket index, and the drift aggregates are integer count tensors
+        — so a :class:`~..obs.fleet.FleetAggregator` merge of N hosts'
+        exports equals the single-tracker view of the union of raw
+        observations bit-exactly (``make fleet-smoke`` gates this)."""
+        with self._lock:
+            served = self._served
+            shed = self._shed_count
+            batches = self._batches
+            timeouts = self._timeouts
+            degraded_served = self._degraded_served
+            worker_crashes = self._worker_crashes
+            brownout_episodes = self._brownout_episodes
+        out = {
+            "replica": self.name,
+            "t_mono": time.monotonic(),
+            "health": self._health.state,
+            "breaker_state": self.breaker.state,
+            "index_generation": self.engine.generation,
+            "counters": {
+                "served": served,
+                "shed": shed,
+                "batches": batches,
+                "timeouts": timeouts,
+                "degraded_served": degraded_served,
+                "worker_crashes": worker_crashes,
+                "brownout_episodes": brownout_episodes,
+            },
+            "slo": self._slo.export(),
+        }
+        kw = self._kwatch
+        if kw is not None:
+            from ..obs.kernelwatch import HIST_EDGES
+
+            phases = {}
+            for phase in kw.phases():
+                hist = kw.histogram(phase)
+                if hist is None:
+                    continue
+                counts, _edges, total, n = hist
+                if n:
+                    phases[phase] = {
+                        "counts": [int(c) for c in counts],
+                        "sum": float(total),
+                        "n": int(n),
+                    }
+            out["perf"] = {"edges": list(HIST_EDGES), "phases": phases}
+        drift = self._drift
+        if drift is not None:
+            try:
+                out["drift"] = drift.export_aggregate()
+            except Exception as e:  # noqa: BLE001 - federation must not break serving
+                logger.warning("drift export failed: %s", e)
+        return out
 
     @property
     def flight_recorder(self):

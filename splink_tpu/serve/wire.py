@@ -17,14 +17,31 @@ that many bytes of UTF-8 JSON (the envelope)::
 
 Envelope — versioned (``"v"``), one dict per frame::
 
-    {"v": 1, "kind": "query",  "id": 7, "record": {...},
+    {"v": 2, "kind": "query",  "id": 7, "record": {...},
      "deadline_ms": 1.8, "trace": {"trace_id": "...", "attempt": 1}}
-    {"v": 1, "kind": "result", "id": 7, "result": {...}, "health": "healthy"}
-    {"v": 1, "kind": "health" | "latency", "id": 8}       (request)
-    {"v": 1, "kind": "health" | "latency", "id": 8, "snapshot": {...},
-     "health": "healthy"}                                 (response)
-    {"v": 1, "kind": "error", "id": 7 | null, "reason": "...",
+    {"v": 2, "kind": "query",  "id": 7, "records": [{...}, ...]}  (batched)
+    {"v": 2, "kind": "result", "id": 7, "result": {...}, "health": "healthy",
+     "server_ms": 1.2, "t_server": 812.44, "span": {...}}
+    {"v": 2, "kind": "result", "id": 7, "results": [{...}, ...]}  (batched)
+    {"v": 2, "kind": "health" | "latency" | "stats", "id": 8}     (request)
+    {"v": 2, "kind": "health" | "latency" | "stats", "id": 8,
+     "snapshot": {...}, "health": "healthy"}                      (response)
+    {"v": 2, "kind": "flight_pull", "id": 9}                      (request)
+    {"v": 2, "kind": "flight", "id": 9, "records": [...]}         (response)
+    {"v": 2, "kind": "error", "id": 7 | null, "reason": "...",
      "health": "healthy"}
+
+Version negotiation (wire v2, fleet observability): the server accepts
+BOTH v1 and v2 request envelopes and every reply echoes the REQUEST's
+version, so a v1 client talking to a v2 server sees pure v1 traffic. The
+v2-only fields — ``server_ms`` + the queue/execute split inside the
+result payload, the ``t_server`` monotonic timestamp (the client's
+RTT-midpoint clock-offset estimate), the piggybacked ``span`` tree
+(cross-host trace stitching), the ``stats`` / ``flight_pull`` kinds and
+batched ``records`` frames — ride only on v2 envelopes. A v2 client
+dialing a v1 server gets ``version_mismatch`` on its handshake and
+re-handshakes at v1 on the same socket (serve/remote.py), degrading to
+the PR 16 flat behaviour.
 
 Contract decisions that carry the robustness weight:
 
@@ -79,9 +96,14 @@ from ..resilience.faults import InjectedFault, active_plan
 
 logger = logging.getLogger("splink_tpu")
 
-#: Envelope schema version; a frame carrying any other value is rejected
-#: per-request (reason ``version_mismatch``), not per-connection.
-WIRE_VERSION = 1
+#: Envelope schema version this build speaks natively; a frame carrying a
+#: version outside :data:`SUPPORTED_VERSIONS` is rejected per-request
+#: (reason ``version_mismatch``), not per-connection.
+WIRE_VERSION = 2
+
+#: Inbound request versions a v2 server answers (each reply echoes the
+#: request's version — module docstring, version negotiation).
+SUPPORTED_VERSIONS = (1, 2)
 
 #: Default cap on one frame's payload (settings key ``wire_max_frame_bytes``).
 DEFAULT_MAX_FRAME_BYTES = 4 * 1024 * 1024
@@ -216,6 +238,66 @@ class _ServerConn:
             pass
 
 
+def _shed_result(reason: str):
+    from .service import QueryResult  # lazy: wire stays import-light
+
+    return QueryResult(shed=True, reason=reason)
+
+
+class _SpanJoin:
+    """Joins a traced request's two completion signals — the future's
+    done-callback (the result payload) and the trace's ``on_close`` hook
+    (the span tree) — and sends ONE combined ``result`` envelope when
+    both have landed (wire v2 stitching).
+
+    The service resolves the future before closing the trace on the same
+    worker thread, so in practice ``note_result`` always arrives first
+    and ``note_span`` sends microseconds later; the tiny lock makes
+    either order (and a foreign service that never closes its traces,
+    via ``cancel``) safe. Sending goes through ``WireServer._reply``,
+    which never raises."""
+
+    __slots__ = ("server", "wc", "req_id", "version", "_lock", "_body",
+                 "_span", "_done")
+
+    def __init__(self, server, wc, req_id, version: int):
+        self.server = server
+        self.wc = wc
+        self.req_id = req_id
+        self.version = version
+        self._lock = threading.Lock()
+        self._body: dict | None = None
+        self._span: dict | None = None
+        self._done = False
+
+    def note_result(self, body: dict) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._body = body
+            if self._span is None:
+                return  # the span closes next; it sends
+            self._done = True
+            body = dict(self._body, span=self._span)
+        self.server._reply(self.wc, body, version=self.version)
+
+    def note_span(self, event: dict) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._span = event
+            if self._body is None:
+                return  # future not resolved yet; note_result sends
+            self._done = True
+            body = dict(self._body, span=self._span)
+        self.server._reply(self.wc, body, version=self.version)
+
+    def cancel(self) -> None:
+        """An error reply already went out; drop whatever arrives."""
+        with self._lock:
+            self._done = True
+
+
 class WireServer:
     """Serves one replica (anything in the :class:`~.router.Replica`
     shape, normally a :class:`~.service.LinkageService`) over the wire
@@ -241,6 +323,7 @@ class WireServer:
         max_frame_bytes: int | None = None,
         max_connections: int | None = None,
         name: str | None = None,
+        protocol_version: int | None = None,
     ):
         settings = getattr(
             getattr(getattr(service, "engine", None), "index", None),
@@ -269,6 +352,16 @@ class WireServer:
                 f"wire_max_connections must be >= 1, got {self.max_connections}"
             )
         self.name = name or f"wire:{getattr(service, 'name', 'serve')}"
+        # ``protocol_version=1`` makes this server behave as a legacy v1
+        # peer (accepts only v1 envelopes, emits none of the v2 fields) —
+        # the degradation tests' stand-in for a pre-fleet build.
+        self.protocol_version = int(protocol_version or WIRE_VERSION)
+        if self.protocol_version not in SUPPORTED_VERSIONS:
+            raise ValueError(
+                f"protocol_version must be one of {SUPPORTED_VERSIONS}, "
+                f"got {self.protocol_version}"
+            )
+        self._stitching = bool(settings.get("fleet_stitching", True))
         self._settings = settings
         self._lock = lockwatch.new_lock("WireServer._lock")
         self._listener: socket.socket | None = None
@@ -455,7 +548,7 @@ class WireServer:
             sock.sendall(
                 encode_frame(
                     {
-                        "v": WIRE_VERSION,
+                        "v": self.protocol_version,
                         "kind": "error",
                         "id": None,
                         "reason": "server_overloaded",
@@ -541,33 +634,64 @@ class WireServer:
 
     def _dispatch(self, wc: _ServerConn, env: dict) -> None:
         req_id = env.get("id")
-        if env.get("v") != WIRE_VERSION:
+        pv = env.get("v")
+        accepted = (
+            SUPPORTED_VERSIONS if self.protocol_version >= 2 else (1,)
+        )
+        if pv not in accepted:
             with self._lock:
                 self.errors_total += 1
             self._reply_error(
                 wc, req_id, "version_mismatch",
-                f"envelope v={env.get('v')!r}, this server speaks "
-                f"v={WIRE_VERSION}",
+                f"envelope v={pv!r}, this server speaks "
+                f"v={self.protocol_version}",
+                version=self.protocol_version,
             )
             return
         kind = env.get("kind")
         if kind == "query":
-            self._handle_query(wc, req_id, env)
+            if isinstance(env.get("records"), list) and pv >= 2:
+                self._handle_batch_query(wc, req_id, env, pv)
+            else:
+                self._handle_query(wc, req_id, env, pv)
         elif kind == "health":
             snap = self._safe_call(self.service.health, {})
-            self._reply(
-                wc, {"kind": "health", "id": req_id, "snapshot": snap}
-            )
+            body = {"kind": "health", "id": req_id, "snapshot": snap}
+            if pv >= 2:
+                # the clock-offset sample: the client brackets this reply
+                # between its send/receive stamps (RTT midpoint)
+                body["t_server"] = time.monotonic()
+            self._reply(wc, body, version=pv)
         elif kind == "latency":
             snap = self._safe_call(self.service.latency_summary, {})
             self._reply(
-                wc, {"kind": "latency", "id": req_id, "snapshot": snap}
+                wc, {"kind": "latency", "id": req_id, "snapshot": snap},
+                version=pv,
+            )
+        elif kind == "stats" and pv >= 2:
+            fn = getattr(self.service, "fleet_stats", None)
+            snap = self._safe_call(fn, {}) if fn is not None else {}
+            self._reply(
+                wc, {"kind": "stats", "id": req_id, "snapshot": snap},
+                version=pv,
+            )
+        elif kind == "flight_pull" and pv >= 2:
+            fr = getattr(self.service, "flight_recorder", None)
+            records = (
+                self._safe_call(fr.snapshot, []) if fr is not None else []
+            )
+            self._reply(
+                wc,
+                {"kind": "flight", "id": req_id, "records": records,
+                 "replica": getattr(self.service, "name", self.name)},
+                version=pv,
             )
         else:
             with self._lock:
                 self.errors_total += 1
             self._reply_error(
-                wc, req_id, "bad_kind", f"unsupported kind {kind!r}"
+                wc, req_id, "bad_kind", f"unsupported kind {kind!r}",
+                version=pv,
             )
 
     @staticmethod
@@ -578,7 +702,10 @@ class WireServer:
             logger.warning("wire introspection call failed: %s", e)
             return default
 
-    def _handle_query(self, wc: _ServerConn, req_id, env: dict) -> None:
+    def _handle_query(
+        self, wc: _ServerConn, req_id, env: dict, pv: int = 1
+    ) -> None:
+        t_recv = time.monotonic()
         with self._lock:
             self.requests_total += 1
             n = self.requests_total
@@ -590,6 +717,23 @@ class WireServer:
         record = env.get("record") or {}
         deadline_ms = env.get("deadline_ms")
         trace = self._inbound_trace(env.get("trace"))
+        # span piggyback (v2 stitching): the service resolves the future
+        # FIRST, then closes the trace on the same worker thread — so the
+        # result send waits for the span via the trace's on_close hook
+        # instead of racing it (obs/reqtrace.py). Both callbacks feed the
+        # join; whichever lands second sends the combined envelope.
+        join = None
+        if (
+            pv >= 2
+            and trace is not None
+            and self._stitching
+            and getattr(self.service, "closes_traces", False)
+        ):
+            # only a service that closes every attempt it resolves
+            # (LinkageService's contract) may gate the reply on the span;
+            # plain duck-typed replicas keep the flat v1-style result
+            join = _SpanJoin(self, wc, req_id, pv)
+            trace.on_close = join.note_span
         try:
             if trace is not None:
                 fut = self.service.submit(
@@ -599,11 +743,84 @@ class WireServer:
                 fut = self.service.submit(record, deadline_ms=deadline_ms)
         except Exception as e:  # noqa: BLE001 - a throwing replica is a shed
             logger.warning("wire submit raised (replied as shed): %s", e)
-            self._reply_error(wc, req_id, "replica_error", str(e)[:300])
+            if join is not None:
+                join.cancel()
+            self._reply_error(
+                wc, req_id, "replica_error", str(e)[:300], version=pv
+            )
             return
         fut.add_done_callback(
-            lambda f, wc=wc, rid=req_id: self._send_result(wc, rid, f)
+            lambda f, wc=wc, rid=req_id, pv=pv, t0=t_recv, j=join:
+            self._send_result(wc, rid, f, pv=pv, t_recv=t0, join=j)
         )
+
+    def _handle_batch_query(
+        self, wc: _ServerConn, req_id, env: dict, pv: int
+    ) -> None:
+        """A batched ``records`` frame (client-side envelope batching):
+        every record is submitted individually — the service's own
+        coalescer amortises dispatch — and ONE ``results`` reply carries
+        the payloads in request order once the last future resolves.
+        Batched frames carry no per-request traces (the amortisation is
+        the point; per-record spans would undo it)."""
+        t_recv = time.monotonic()
+        records = env.get("records") or []
+        with self._lock:
+            self.requests_total += len(records)
+            n = self.requests_total
+        try:
+            active_plan(self._settings).fire("wire_request", request=n)
+        except InjectedFault as f:
+            self._net_fault(wc, f)
+            return
+        deadline_ms = env.get("deadline_ms")
+        count = len(records)
+        if count == 0:
+            self._reply(
+                wc,
+                {"kind": "result", "id": req_id, "results": [],
+                 "server_ms": 0.0, "t_server": time.monotonic()},
+                version=pv,
+            )
+            return
+        payloads: list = [None] * count
+        remaining = [count]
+        rlock = threading.Lock()
+
+        def on_done(i: int, fut) -> None:
+            try:
+                payloads[i] = fut.result().to_payload()
+            except Exception as e:  # noqa: BLE001 - replica futures should not raise
+                logger.warning("wire batched future raised: %s", e)
+                payloads[i] = {"shed": True, "reason": "remote_error"}
+            with rlock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+            if last:
+                now = time.monotonic()
+                self._reply(
+                    wc,
+                    {"kind": "result", "id": req_id, "results": payloads,
+                     "server_ms": (now - t_recv) * 1e3, "t_server": now},
+                    version=pv,
+                )
+
+        for i, record in enumerate(records):
+            try:
+                fut = self.service.submit(
+                    record or {}, deadline_ms=deadline_ms
+                )
+            except Exception as e:  # noqa: BLE001 - a throwing replica is a shed
+                logger.warning("wire batched submit raised: %s", e)
+                from concurrent.futures import Future
+
+                fut = Future()
+                fut.set_result(
+                    _shed_result("replica_error")
+                )
+            fut.add_done_callback(
+                lambda f, i=i: on_done(i, f)
+            )
 
     def _inbound_trace(self, t):
         """Reconstruct the router-minted trace context so the replica that
@@ -624,26 +841,50 @@ class WireServer:
 
     # -- responses ------------------------------------------------------
 
-    def _send_result(self, wc: _ServerConn, req_id, fut) -> None:
+    def _send_result(
+        self, wc: _ServerConn, req_id, fut, pv: int = 1,
+        t_recv: float | None = None, join=None,
+    ) -> None:
         try:
             res = fut.result()
             payload = res.to_payload()
         except Exception as e:  # noqa: BLE001 - replica futures should not raise
             logger.warning("wire replica future raised: %s", e)
-            self._reply_error(wc, req_id, "replica_error", str(e)[:300])
+            if join is not None:
+                join.cancel()
+            self._reply_error(
+                wc, req_id, "replica_error", str(e)[:300], version=pv
+            )
             return
-        self._reply(wc, {"kind": "result", "id": req_id, "result": payload})
+        body = {"kind": "result", "id": req_id, "result": payload}
+        if pv >= 2:
+            now = time.monotonic()
+            body["t_server"] = now
+            if t_recv is not None:
+                body["server_ms"] = (now - t_recv) * 1e3
+        if join is not None:
+            join.note_result(body)
+            return
+        self._reply(wc, body, version=pv)
 
-    def _reply_error(self, wc, req_id, reason: str, detail: str) -> None:
+    def _reply_error(
+        self, wc, req_id, reason: str, detail: str,
+        version: int | None = None,
+    ) -> None:
         self._reply(
             wc,
             {"kind": "error", "id": req_id, "reason": reason,
              "detail": detail},
+            version=version,
         )
 
-    def _reply(self, wc: _ServerConn, body: dict) -> None:
+    def _reply(
+        self, wc: _ServerConn, body: dict, version: int | None = None
+    ) -> None:
         env = {
-            "v": WIRE_VERSION,
+            # echo the request's version (negotiation contract); server-
+            # initiated frames carry this build's native version
+            "v": version if version is not None else self.protocol_version,
             # piggybacked health: one lock-free property read per response
             "health": getattr(self.service, "health_state", None),
             **body,

@@ -99,6 +99,10 @@ _NON_COLUMN_DEFAULT_KEYS = [
     "wire_max_frame_bytes",
     "wire_max_connections",
     "wire_remote_hosts",
+    "fleet_stitching",
+    "fleet_net_alert_ratio",
+    "fleet_bundle_dir",
+    "fleet_incident_interval_s",
     "quality_profile",
     "drift_sketch_bins",
     "drift_window_s",

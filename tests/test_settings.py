@@ -567,3 +567,40 @@ def test_wire_key_types_validated():
             wire_remote_hosts=["10.0.0.2:9400", "10.0.0.3:9400"],
         )
     )
+
+
+def test_fleet_defaults_filled():
+    """The fleet-observability keys complete from the schema: stitching
+    on, network-phase alerting off, a temp bundle dir and a 30 s bundle
+    rate limit."""
+    s = complete_settings_dict(_minimal())
+    assert s["fleet_stitching"] is True
+    assert s["fleet_net_alert_ratio"] == 0
+    assert s["fleet_bundle_dir"] == ""
+    assert s["fleet_incident_interval_s"] == 30.0
+
+
+def test_fleet_key_types_validated():
+    """Type/bound violations on the fleet keys are rejected by the schema
+    validator (the established key-validation pattern)."""
+    for bad in (
+        {"fleet_stitching": "yes"},
+        {"fleet_stitching": 1},
+        {"fleet_net_alert_ratio": -0.5},
+        {"fleet_net_alert_ratio": "strict"},
+        {"fleet_bundle_dir": 7},
+        {"fleet_incident_interval_s": 0},
+        {"fleet_incident_interval_s": -30},
+        {"fleet_incident_interval_s": "fast"},
+    ):
+        with pytest.raises(ValidationError):
+            validate_settings(_minimal(**bad))
+    # valid values pass (ratio 0 disables alerting, not the decomposition)
+    validate_settings(
+        _minimal(
+            fleet_stitching=False,
+            fleet_net_alert_ratio=0,
+            fleet_bundle_dir="/tmp/bundles",
+            fleet_incident_interval_s=2.5,
+        )
+    )
