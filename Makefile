@@ -1,10 +1,10 @@
-.PHONY: test lint shard-baselines perf-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke wire-smoke thread-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke fleet-smoke bench bench-blocking all
+.PHONY: test lint shard-baselines perf-baselines num-baselines tpu-smoke obs-smoke serve-smoke chaos-smoke wire-smoke thread-smoke blocking-smoke approx-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke fleet-smoke num-smoke bench bench-blocking all
 
 # CPU oracle/golden tier: 8 virtual devices, runs anywhere.
 test:
 	python -m pytest tests/ -x -q
 
-# Static analysis gate — all five layers (splink_tpu/analysis/):
+# Static analysis gate — all six layers (splink_tpu/analysis/):
 #   1  jaxlint      AST pass over the package (JL001-JL012)
 #   2  trace audit  jaxpr audit of the kernel registry
 #   3  shard audit  SPMD partition-safety + cost budgets on the 8-device mesh
@@ -12,6 +12,9 @@ test:
 #                   the measured gate runs in perf-smoke)
 #   5  threadlint   concurrency-safety audit of the serve/obs thread fleet
 #                   (TL001-TL005; dynamic half: thread-smoke)
+#   6  numlint      numerical-hygiene AST pass (NL001-NL008, rides the same
+#                   paths invocation; measured half --num-audit runs in
+#                   num-smoke against num_baselines.json)
 # Exit 1 on any unsuppressed finding, undeclared collective, cost-budget
 # drift, or thread-safety hazard; tests/test_codebase_clean.py enforces the
 # same gates in tier-1. (The CLI pins JAX_PLATFORMS/XLA_FLAGS itself for
@@ -35,6 +38,15 @@ shard-baselines:
 perf-baselines:
 	JAX_PLATFORMS=cpu \
 		python -m splink_tpu.analysis --perf-audit --update-perf-baselines
+
+# Intentional refresh of the committed per-(tier, kernel) f32/f64 ulp
+# budgets (splink_tpu/analysis/num_baselines.json, layer 6) after an
+# accepted numerics change or a new kernel. Only this tier's block is
+# rewritten (hardware tiers add their own); review the diff like a bench —
+# a wider budget means the f32 error bar grew.
+num-baselines:
+	JAX_PLATFORMS=cpu \
+		python -m splink_tpu.analysis --num-audit --update-num-baselines
 
 # Hardware smoke tier: real TPU lowering of Pallas kernels + pipeline.
 # Separate invocation because tests/conftest.py pins its process to CPU.
@@ -171,6 +183,15 @@ scale-smoke:
 fleet-smoke:
 	python scripts/fleet_smoke.py
 
+# Numerics smoke: the measured half of analysis layer 6. The corner-batch
+# audit (NA-FIN finite outputs, NA-ULP f32/f64 divergence inside committed
+# budgets, NA-MONO monotone match probabilities, NA-ORD pinned fold order)
+# passes against num_baselines.json on this tier, a doctored ulp budget
+# provably trips the gate, and the audit summary lands on the obs timeline
+# as a num_audit flight transition (docs/static_analysis.md#layer-6).
+num-smoke:
+	python scripts/num_smoke.py
+
 bench:
 	python bench.py
 
@@ -178,4 +199,4 @@ bench:
 bench-blocking:
 	python benchmarks/blocking_bench.py
 
-all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke wire-smoke thread-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke fleet-smoke bench
+all: lint test tpu-smoke blocking-smoke approx-smoke serve-smoke chaos-smoke wire-smoke thread-smoke trace-smoke warmup-smoke drift-smoke perf-smoke tf-smoke scale-smoke fleet-smoke num-smoke bench

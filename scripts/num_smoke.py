@@ -1,0 +1,136 @@
+"""Numerics smoke (`make num-smoke`): the measured half of analysis
+layer 6, end to end (docs/static_analysis.md#layer-6).
+
+Three steps, mirroring perf-smoke's audit half:
+
+  1. AUDIT CLEAN — `python -m splink_tpu.analysis --num-audit` passes
+     against the COMMITTED ``num_baselines.json`` on this tier: every
+     registered kernel survives its corner batches with finite outputs
+     (NA-FIN), stays inside its committed f32/f64 ulp budget (NA-ULP),
+     and the model-level monotonicity (NA-MONO) and fold-order (NA-ORD)
+     invariants hold.
+  2. FALSIFIABILITY — a DOCTORED copy of the baselines (the widest
+     committed ulp budget, lowered below its own measurement) must trip
+     NA-ULP with the budget-vs-measured diff rendered — proof the gate
+     can fail, so step 1's pass means something.
+  3. OBSERVABILITY — the audit summary goes out as a ``num_audit``
+     event (a flight-ring transition, like thread_audit) and
+     `obs summarize` renders the numerics section from the captured
+     record.
+
+Exits nonzero on any violation. Runs on any backend (CPU tier included).
+"""
+
+import copy
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from splink_tpu.analysis.num_audit import (
+        audit_kernel_numerics,
+        current_tier,
+        load_baselines,
+        run_num_audit,
+    )
+    from splink_tpu.analysis.trace_audit import (
+        REGISTRY,
+        _ensure_default_registry,
+    )
+    from splink_tpu.obs.cli import summarize_events
+    from splink_tpu.obs.events import (
+        EventSink,
+        read_events,
+        register_ambient,
+        unregister_ambient,
+    )
+
+    tier = current_tier()
+    baselines = load_baselines()
+    budgets = baselines.get("tiers", {}).get(tier, {}).get("kernels", {})
+    assert budgets, (
+        f"no committed ulp budgets for tier '{tier}' — run "
+        "`make num-baselines` and commit num_baselines.json"
+    )
+
+    # ---- 1: the measured audit against the COMMITTED baselines ----------
+    t0 = time.perf_counter()
+    findings, audited = run_num_audit(baselines=baselines)
+    audit_s = time.perf_counter() - t0
+    assert not findings, "num audit must pass committed baselines:\n" + \
+        "\n".join(f.format() for f in findings)
+    _ensure_default_registry()
+    assert set(budgets) == set(REGISTRY), (
+        "committed budgets must cover every registered kernel; missing: "
+        f"{sorted(set(REGISTRY) - set(budgets))}"
+    )
+    worst = max(
+        (float(cell["ulp_budget"]) for cell in budgets.values()), default=0.0
+    )
+    print(f"num 1 ok: audit clean — {audited} kernel(s)/surface(s) against "
+          f"committed tier-'{tier}' budgets (widest {worst:g} ulp) "
+          f"in {audit_s:.1f}s")
+
+    # ---- 2: a doctored budget must trip the gate -------------------------
+    victim = max(budgets, key=lambda k: float(budgets[k]["ulp_budget"]))
+    doctored = copy.deepcopy(budgets[victim])
+    doctored["ulp_budget"] = float(doctored["ulp_budget"]) - 1.0
+    tripped = audit_kernel_numerics(REGISTRY[victim], doctored)
+    ulp_hits = [f for f in tripped if f.rule == "NA-ULP"]
+    assert ulp_hits, (
+        f"doctored budget ({victim}: {doctored['ulp_budget']:g} ulp) "
+        "did not trip NA-ULP — the gate is not falsifiable"
+    )
+    rendered = ulp_hits[0].format()
+    assert "ulp: budget" in rendered and "measured" in rendered, rendered
+    print(f"num 2 ok: doctored budget trips the gate — {rendered}")
+
+    # ---- 3: the audit stamps the observability timeline ------------------
+    tmp = tempfile.mkdtemp(prefix="splink_num_")
+    events_path = os.path.join(tmp, "num_events.jsonl")
+    sink = EventSink(events_path, run_id="num-smoke")
+    register_ambient(sink)
+    try:
+        from splink_tpu.obs.events import publish
+
+        publish(
+            "num_audit",
+            kernels=audited,
+            tier=tier,
+            findings=len(findings),
+            worst_ulp=worst,
+        )
+    finally:
+        unregister_ambient(sink)
+        sink.close()
+    events = read_events(events_path)
+    report = summarize_events(events)
+    assert "numerics: 1 audit(s)" in report, report
+    assert f"on tier {tier}" in report, report
+    shutil.rmtree(tmp, ignore_errors=True)
+    print("num 3 ok: num_audit event captured and rendered by obs summarize")
+
+    print(json.dumps({
+        "metric": "num_smoke",
+        "tier": tier,
+        "kernels_audited": audited,
+        "audit_seconds": round(audit_s, 1),
+        "widest_ulp_budget": worst,
+        "doctored_kernel": victim,
+    }))
+    print("num-smoke OK: corner batches finite, ulp budgets hold on "
+          "committed baselines, doctored budget trips the gate, audit "
+          "stamped on the obs timeline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,7 @@
 """Static-analysis + jaxpr/SPMD-audit + measured-perf + concurrency-audit
-framework gating CI.
++ numerics-audit framework gating CI.
 
-Five layers, one finding model:
+Six layers, one finding model:
 
   * :mod:`.jaxlint` — AST lint pass over JAX hazard classes (host calls and
     syncs on traced values, Python branches on tracers, unpinned dtypes,
@@ -25,16 +25,30 @@ Five layers, one finding model:
     lifecycle), with ``# threadlint: disable=RULE`` suppressions; its
     dynamic half is :mod:`.lockwatch` (opt-in instrumented locks recording
     the observed acquisition order, gated by ``make thread-smoke``).
+  * :mod:`.numlint` + :mod:`.num_audit` — numerical safety. numlint is
+    the static half: AST rules over the log-space hazard classes (raw
+    logs on possibly-zero operands, unshifted exps, unguarded divisions,
+    linear-space probability products, float equality in traced code,
+    fold-order-breaking reductions, unclamped logit round-trips,
+    out-of-f32-range literals), with ``# numlint: disable=RULE``
+    suppressions. num_audit is the measured half: every registered
+    kernel runs on adversarial corner batches (NA-FIN) and against
+    committed per-tier f32/f64 ulp budgets (NA-ULP,
+    ``num_baselines.json``), plus model-level monotonicity (NA-MONO) and
+    fold-order pinning (NA-ORD) checks.
 
 CLI: ``python -m splink_tpu.analysis splink_tpu/ [--audit] [--shard-audit]
-[--perf-audit] [--thread-audit] [--json]``; ``make lint`` runs the static
-layers (plus the perf-plan listing), ``make perf-smoke`` runs the measured
+[--perf-audit] [--thread-audit] [--num-audit] [--json]``; ``make lint``
+runs the static layers (plus the perf-plan listing), ``make perf-smoke``
+runs the measured perf layer, ``make num-smoke`` the measured numerics
 layer, ``make thread-smoke`` the dynamic lock-order gate, and
 tests/test_codebase_clean.py gates tier-1 on a clean static run.
 """
 
 from .findings import Finding, Report
 from .jaxlint import lint_paths, lint_source
+from .num_audit import num_plan, run_num_audit
+from .numlint import NL_RULES, numlint_paths, numlint_source
 from .perf_audit import perf_plan, run_perf_audit
 from .rules import RULES, rule
 from .shard_audit import (
@@ -78,4 +92,9 @@ __all__ = [
     "build_lock_graph",
     "graph_cycles",
     "run_thread_audit",
+    "NL_RULES",
+    "numlint_paths",
+    "numlint_source",
+    "num_plan",
+    "run_num_audit",
 ]

@@ -111,6 +111,23 @@ def main(argv=None) -> int:
         "(implies --thread-audit)",
     )
     parser.add_argument(
+        "--num-audit",
+        action="store_true",
+        help="also run the measured numerics audit (layer 6): corner "
+        "batches + f32/f64 ulp divergence vs num_baselines.json",
+    )
+    parser.add_argument(
+        "--num-kernels",
+        help="comma-separated kernel names to numerics-audit (implies "
+        "--num-audit)",
+    )
+    parser.add_argument(
+        "--update-num-baselines",
+        action="store_true",
+        help="re-measure ulp budgets and rewrite this tier's block of "
+        "num_baselines.json (implies --num-audit)",
+    )
+    parser.add_argument(
         "--list-perf-kernels",
         action="store_true",
         help="print the perf-audit measurement plan (kernels, shapes, "
@@ -129,6 +146,9 @@ def main(argv=None) -> int:
     thread_requested = (
         args.thread_audit or args.thread_classes or args.lock_graph
     )
+    num_requested = (
+        args.num_audit or args.num_kernels or args.update_num_baselines
+    )
 
     if args.list_rules:
         for spec in sorted(RULES.values(), key=lambda s: s.id):
@@ -136,6 +156,10 @@ def main(argv=None) -> int:
         from .threadlint import TL_RULES
 
         for rule_id, (title, doc) in sorted(TL_RULES.items()):
+            print(f"{rule_id}  {title}\n       {doc}")
+        from .numlint import NL_RULES
+
+        for rule_id, (title, doc) in sorted(NL_RULES.items()):
             print(f"{rule_id}  {title}\n       {doc}")
         return 0
 
@@ -151,6 +175,7 @@ def main(argv=None) -> int:
         or shard_requested
         or perf_requested
         or thread_requested
+        or num_requested
     ):
         parser.print_usage(sys.stderr)
         print(
@@ -168,8 +193,23 @@ def main(argv=None) -> int:
         if args.rules
         else None
     )
+    # NL rules live in numlint, everything else in jaxlint; each engine
+    # rejects foreign ids, so an explicit --rules list is split by prefix
+    # (an unknown prefix falls through to jaxlint and exits 2 there).
+    nl_rules = jl_rules = None
+    if rules is not None:
+        nl_rules = [r for r in rules if r.upper().startswith("NL")]
+        jl_rules = [r for r in rules if not r.upper().startswith("NL")]
     try:
-        report = lint_paths(args.paths, rules) if args.paths else Report()
+        if args.paths:
+            report = lint_paths(args.paths, jl_rules)
+            from .numlint import numlint_paths
+
+            # same files, second rule set: merge findings only — the
+            # files_checked counter already covers these paths
+            report.extend(numlint_paths(args.paths, nl_rules).findings)
+        else:
+            report = Report()
     except (FileNotFoundError, KeyError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -266,6 +306,32 @@ def main(argv=None) -> int:
         if args.lock_graph:
             write_lock_graph(args.lock_graph, graph)
             print(f"wrote lock graph to {args.lock_graph}", file=sys.stderr)
+
+    if num_requested:
+        from .num_audit import current_tier, run_num_audit
+        from .num_audit import update_baselines as update_num_baselines
+
+        num_kernels = (
+            [k.strip() for k in args.num_kernels.split(",") if k.strip()]
+            if args.num_kernels
+            else None
+        )
+        try:
+            if args.update_num_baselines:
+                new = update_num_baselines(num_kernels)
+                tier = current_tier()
+                print(
+                    f"wrote ulp budgets for "
+                    f"{len(new['tiers'][tier]['kernels'])} kernel(s) "
+                    f"on tier '{tier}'",
+                    file=sys.stderr,
+                )
+            num_findings, num_audited = run_num_audit(num_kernels)
+        except KeyError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        report.extend(num_findings)
+        report.num_kernels_audited = num_audited
 
     print(report.format_json() if args.json else report.format_text())
     return 0 if report.clean else 1

@@ -42,6 +42,7 @@ class Report:
     shard_kernels_audited: int = 0
     perf_shapes_audited: int = 0
     thread_classes_audited: int = 0
+    num_kernels_audited: int = 0
 
     def extend(self, findings) -> None:
         self.findings.extend(findings)
@@ -71,6 +72,10 @@ class Report:
             tail += (
                 f", {self.thread_classes_audited} thread class(es) audited"
             )
+        if self.num_kernels_audited:
+            tail += (
+                f", {self.num_kernels_audited} kernel(s) numerics-audited"
+            )
         lines.append(tail)
         return "\n".join(lines)
 
@@ -83,6 +88,7 @@ class Report:
                 "shard_kernels_audited": self.shard_kernels_audited,
                 "perf_shapes_audited": self.perf_shapes_audited,
                 "thread_classes_audited": self.thread_classes_audited,
+                "num_kernels_audited": self.num_kernels_audited,
                 "clean": self.clean,
             },
             indent=2,

@@ -1,0 +1,705 @@
+"""numlint: AST lint pass over numerical-safety hazard classes (layer 6 of
+the analysis framework; its measured twin is :mod:`num_audit`).
+
+The whole pipeline is log-space Fellegi-Sunter arithmetic: probabilities
+that legitimately reach exactly 0 and 1 (the M-step zero-fills unseen
+levels), log-Bayes-factor folds whose accumulation ORDER is contractual
+(PR 13: ``jnp.sum``'s reduce tree diverges from ``fold_logit``'s running
+accumulator in the last ulp past ~2 columns), and count denominators that
+are empty on adversarial batches. jaxlint (layer 1) catches JAX-mechanics
+hazards; nothing catches ``jnp.log(p)`` where ``p`` can be 0, or
+``num / (num + den)`` where both products underflow. Each NL rule targets
+one such numerics class:
+
+  NL001  raw ``log``/``log2``/``log10`` on a possibly-zero operand
+  NL002  ``exp``/``expm1`` of an unbounded log-space quantity (no max-shift)
+  NL003  division without a denominator guard on a count/probability sum
+  NL004  linear-space probability product (``prod``/``cumprod`` on floats)
+  NL005  exact ``==``/``!=`` comparison against computed floats in traced code
+  NL006  reduce-tree reduction inside a fold-order-contracted scoring path
+  NL007  unclamped sigmoid->logit round-trip (``log(p / (1 - p))``)
+  NL008  float literal outside float32's normal range in traced code
+
+The engine reuses jaxlint's :class:`~.jaxlint.ModuleLint` (import-alias
+canonicalisation, traced-context analysis, parent links) and the shared
+:class:`~.findings.Finding` model, but keeps its OWN rule catalog and its
+own suppression prefix so a numerics waiver never silences a JAX-mechanics
+rule on the same line:
+
+  ``# numlint: disable=NL003``          on the line or the line above
+  ``# numlint: disable-file=NL001``     (or ``all``) in the first 10 lines
+
+Guard recognition is deliberately syntactic and local: an operand counts
+as guarded when it (or, for a bare name, any assignment to it in the same
+function) contains a flooring call (``maximum`` / ``clip`` / ``where`` /
+``max``), adds a positive constant (``df + 1.0``, ``(hk + 0.5) * c``),
+or references an eps/tiny-named value; a denominator additionally counts
+as guarded when a conditional or early-return in the same function tests
+the denominator's name (``if not total: return 0.0``). Anything subtler
+is a ``# numlint: disable=`` with a justification — the same contract the
+other five layers use.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+import numpy as np
+
+from .findings import Finding, Report
+from .jaxlint import ModuleLint, _bound_names, iter_python_files
+
+# ---------------------------------------------------------------------------
+# Rule catalog (threadlint idiom: id -> (title, doc); --list-rules renders it)
+# ---------------------------------------------------------------------------
+
+NL_RULES: dict[str, tuple[str, str]] = {
+    "NL001": (
+        "raw log on a possibly-zero operand",
+        "jnp.log/np.log (and log2/log10) of an unguarded operand: EM's "
+        "M-step zero-fills unseen gamma levels, so probabilities here "
+        "legitimately reach exactly 0 and log(0) = -inf poisons every "
+        "downstream fold. Floor the operand (jnp.maximum(x, "
+        "jnp.finfo(x.dtype).tiny)) or use models.fellegi_sunter._safe_log.",
+    ),
+    "NL002": (
+        "unshifted exp of an unbounded log-space quantity",
+        "jnp.exp/expm1 of a traced log-sum without a max-shift or clamp: "
+        "log-Bayes factors grow linearly in column count, and exp "
+        "overflows f32 at ~88.7. Subtract the max first (logsumexp "
+        "shift), clamp, or stay in log space (jnp.logaddexp).",
+    ),
+    "NL003": (
+        "division without a denominator guard",
+        "division by a count/probability accumulation (a sum() result or "
+        "an a + b of computed terms) with no floor, no positive-constant "
+        "offset and no branch testing it: empty buckets and all-null "
+        "batches make these denominators exactly 0. Floor it "
+        "(jnp.maximum(den, eps) / max(den, 1)) or branch on it first.",
+    ),
+    "NL004": (
+        "linear-space probability product",
+        "jnp.prod/cumprod over float probabilities in traced code: "
+        "products of per-column probabilities underflow f32 after a few "
+        "dozen small factors (the reference engine needed a tiny-number "
+        "regression test for exactly this). Accumulate _safe_log values "
+        "and exponentiate once, or fold in log space.",
+    ),
+    "NL005": (
+        "exact float equality in traced code",
+        "== / != against a float literal or a computed float inside a "
+        "traced function: values that differ across reduce orders or "
+        "precisions in the last ulp make the comparison "
+        "tier-dependent. Compare with a tolerance (jnp.abs(a - b) <= "
+        "tol) or restructure on integer codes.",
+    ),
+    "NL006": (
+        "reduce-tree reduction in a fold-order-contracted path",
+        "jnp.sum/prod/cumsum inside a function that participates in the "
+        "fold_logit contract: PR 13 established that jnp.sum's reduction "
+        "tree diverges from the fused kernel's left-to-right running "
+        "accumulator in the last ulp past ~2 comparison columns, which "
+        "silently breaks serve<->offline bit-parity. Accumulate column "
+        "by column in fold_logit's order instead.",
+    ),
+    "NL007": (
+        "unclamped sigmoid->logit round-trip",
+        "logit(p) / log(p / (1 - p)) without clamping p away from 0 and "
+        "1: match probabilities saturate to exactly 1.0 in f32 beyond "
+        "~17 logits of evidence, and the round-trip returns +/-inf. "
+        "Clamp into [eps, 1 - eps] first, or carry the logit itself "
+        "(match_logit / fold_logit) instead of re-deriving it.",
+    ),
+    "NL008": (
+        "float literal outside float32's normal range",
+        "a literal float in traced code whose magnitude exceeds f32's "
+        "finite range (silently inf on the f32 tier) or sits below its "
+        "smallest normal (silently flushed to 0/denormal): pinned-width "
+        "kernels evaluate the same source at f32 on hardware tiers. "
+        "Derive the constant from jnp.finfo(dtype) instead.",
+    ),
+}
+
+# ---------------------------------------------------------------------------
+# Suppression grammar (numlint's own prefix)
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*numlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*numlint:\s*disable-file=([A-Za-z0-9_,\s]+)"
+)
+
+
+def _file_suppressions(lines: list[str]) -> frozenset[str]:
+    ids: set[str] = set()
+    for line in lines[:10]:
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            ids |= {s.strip() for s in m.group(1).split(",") if s.strip()}
+    return frozenset(ids)
+
+
+def _suppressed(lines: list[str], file_ids: frozenset[str], f: Finding) -> bool:
+    if "all" in file_ids or f.rule in file_ids:
+        return True
+    for lineno in (f.line, f.line - 1):
+        if 1 <= lineno <= len(lines):
+            m = _SUPPRESS_RE.search(lines[lineno - 1])
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",")}
+                if f.rule in ids or "all" in ids:
+                    return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Shared guard recognition
+# ---------------------------------------------------------------------------
+
+_LOG_CALLS = {
+    "jax.numpy.log",
+    "jax.numpy.log2",
+    "jax.numpy.log10",
+    "numpy.log",
+    "numpy.log2",
+    "numpy.log10",
+}
+_EXP_CALLS = {
+    "jax.numpy.exp",
+    "jax.numpy.expm1",
+    "numpy.exp",
+    "numpy.expm1",
+}
+_PROD_CALLS = {"jax.numpy.prod", "jax.numpy.cumprod"}
+_ORDERED_REDUCE_CALLS = {
+    "jax.numpy.sum",
+    "jax.numpy.prod",
+    "jax.numpy.cumsum",
+}
+_SUM_CALLS = {"numpy.sum", "jax.numpy.sum"}
+
+# flooring/branching callables that make a zero-capable operand safe
+_GUARD_CALL_NAMES = {"maximum", "fmax", "clip", "where", "max"}
+# clamping callables that bound a log-space quantity before exp
+_CLAMP_CALL_NAMES = {"maximum", "minimum", "clip", "max", "amax", "logsumexp"}
+_GUARD_NAME_RE = re.compile(r"(eps|tiny|smooth|_MIN\b|_min\b)", re.IGNORECASE)
+
+_FLOAT_PRODUCERS = {
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "exp",
+    "expm1",
+    "sigmoid",
+    "logit",
+    "sum",
+    "mean",
+    "prod",
+    "divide",
+    "true_divide",
+    "sqrt",
+    "dot",
+    "einsum",
+    "logaddexp",
+}
+
+_FOLD_CONTRACT_NAMES = ("fold_logit", "tf_fold")
+
+
+def _call_name(mod: ModuleLint, call: ast.Call) -> str | None:
+    """Last path component of the callee (alias-resolved when possible)."""
+    canon = mod.canonical(call.func)
+    if canon:
+        return canon.rsplit(".", 1)[-1]
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _positive_const(node: ast.expr) -> bool:
+    """A positive numeric constant, possibly wrapped in one dtype
+    constructor call (``jnp.float32(0.5)``)."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) and node.value > 0
+    if isinstance(node, ast.Call) and len(node.args) == 1:
+        return _positive_const(node.args[0])
+    return False
+
+
+def _contains_guard(mod: ModuleLint, expr: ast.expr) -> bool:
+    """Whether an expression is floored away from zero: a guard call
+    anywhere inside it, a ``+ positive-constant`` offset, an eps/tiny
+    named value, or the expression being a positive constant itself."""
+    if _positive_const(expr):
+        return True
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            name = _call_name(mod, n)
+            if name in _GUARD_CALL_NAMES:
+                return True
+            if name and "safe" in name:
+                return True
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Add):
+            if _positive_const(n.left) or _positive_const(n.right):
+                return True
+        if isinstance(n, ast.Name) and _GUARD_NAME_RE.search(n.id):
+            return True
+        if isinstance(n, ast.Attribute) and _GUARD_NAME_RE.search(n.attr):
+            return True
+    return False
+
+
+def _assignments(mod: ModuleLint, fn: ast.AST | None, name: str):
+    """Values assigned to ``name`` in the given function scope (or at
+    module level when ``fn`` is None)."""
+    scope = fn if fn is not None else mod.tree
+    values: list[ast.expr] = []
+    for n in ast.walk(scope):
+        if fn is not None and mod.enclosing_fn(n) is not fn:
+            continue
+        if fn is None and mod.enclosing_fn(n) is not None:
+            continue
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)) and n.value:
+            targets = [n.target]
+        else:
+            continue
+        for t in targets:
+            if name in _bound_names(t):
+                values.append(n.value)
+                break
+    return values
+
+
+def _name_guarded(mod: ModuleLint, fn: ast.AST | None, name: str) -> bool:
+    """A bare name counts as guarded when at least one local assignment to
+    it is itself a guarded expression (flow-insensitive by design: the
+    floor-then-use idiom assigns the floored value back to the name)."""
+    return any(
+        _contains_guard(mod, v) for v in _assignments(mod, fn, name)
+    )
+
+
+def _mentions_name(mod: ModuleLint, test: ast.expr, names: set[str]) -> bool:
+    src = ast.get_source_segment(mod.source, test) or ""
+    return any(
+        re.search(rf"\b{re.escape(nm)}\b", src) for nm in names
+    )
+
+
+def _branch_guarded(
+    mod: ModuleLint, node: ast.AST, fn: ast.AST | None, names: set[str]
+) -> bool:
+    """Whether a conditional protects this use of the named values: an
+    ancestor if/ternary/while testing one of them, or an early-return /
+    raise / assert on one of them anywhere in the same function."""
+    if not names:
+        return False
+    cur: ast.AST | None = node
+    while cur is not None:
+        if isinstance(cur, (ast.If, ast.IfExp, ast.While)):
+            if _mentions_name(mod, cur.test, names):
+                return True
+        cur = mod.parents.get(cur)
+    scope = fn if fn is not None else mod.tree
+    for n in ast.walk(scope):
+        if fn is not None and mod.enclosing_fn(n) is not fn:
+            continue
+        if isinstance(n, ast.Assert) and _mentions_name(mod, n.test, names):
+            return True
+        if isinstance(n, ast.If) and _mentions_name(mod, n.test, names):
+            if any(
+                isinstance(s, (ast.Return, ast.Raise, ast.Continue))
+                for s in n.body
+            ):
+                return True
+    return False
+
+
+def _logit_ratio(arg: ast.expr) -> bool:
+    """The ``p / (1 - p)`` shape inside a log call (NL007's territory)."""
+    return (
+        isinstance(arg, ast.BinOp)
+        and isinstance(arg.op, ast.Div)
+        and isinstance(arg.right, ast.BinOp)
+        and isinstance(arg.right.op, ast.Sub)
+        and isinstance(arg.right.left, ast.Constant)
+        and arg.right.left.value == 1
+    )
+
+
+def _traced_info(mod: ModuleLint, node: ast.AST):
+    """FnInfo of the nearest enclosing traced function, else None."""
+    fn = mod.enclosing_fn(node)
+    while fn is not None:
+        info = mod.fns.get(fn)
+        if info is not None and info.traced:
+            return info
+        fn = mod.enclosing_fn(fn)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+def _check_nl001(mod: ModuleLint):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func)
+        if canon not in _LOG_CALLS or not node.args:
+            continue
+        arg = node.args[0]
+        if _logit_ratio(arg):
+            continue  # NL007 owns the logit round-trip shape
+        if _contains_guard(mod, arg):
+            continue
+        fn = mod.enclosing_fn(node)
+        if isinstance(arg, ast.Name) and _name_guarded(mod, fn, arg.id):
+            continue
+        short = canon.rsplit(".", 1)[-1]
+        yield mod.finding(
+            "NL001",
+            node,
+            f"raw {short}() on an unguarded operand: probabilities/counts "
+            "here can legitimately reach exactly 0, and log(0) = -inf",
+            hint="floor the operand (jnp.maximum(x, jnp.finfo(x.dtype)"
+            ".tiny)) or use models.fellegi_sunter._safe_log",
+        )
+
+
+def _check_nl002(mod: ModuleLint):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func)
+        if canon not in _EXP_CALLS or not node.args:
+            continue
+        info = _traced_info(mod, node)
+        if info is None:
+            continue
+        arg = node.args[0]
+        if not mod._mentions_traced(arg, set(info.traced_names)):
+            continue
+        clamped = any(
+            isinstance(n, ast.Call)
+            and _call_name(mod, n) in _CLAMP_CALL_NAMES
+            for n in ast.walk(arg)
+        )
+        if clamped:
+            continue
+        short = canon.rsplit(".", 1)[-1]
+        yield mod.finding(
+            "NL002",
+            node,
+            f"{short}() of an unbounded traced log-space quantity: "
+            "log-Bayes sums grow with column count and exp overflows "
+            "f32 at ~88.7",
+            hint="max-shift first (x - jnp.max(x)), clamp, or stay in "
+            "log space (jnp.logaddexp / logsumexp)",
+        )
+
+
+def _zero_capable(
+    mod: ModuleLint, fn: ast.AST | None, den: ast.expr
+) -> str | None:
+    """Why a denominator can be exactly zero, or None if it cannot be
+    classified as zero-capable from local syntax."""
+    if isinstance(den, ast.Call):
+        canon = mod.canonical(den.func)
+        is_sum = canon in _SUM_CALLS or (
+            canon is None
+            and isinstance(den.func, ast.Attribute)
+            and den.func.attr == "sum"
+        ) or (
+            isinstance(den.func, ast.Name) and den.func.id == "sum"
+        )
+        if is_sum:
+            # x.sum() where x itself was floored is fine
+            if (
+                isinstance(den.func, ast.Attribute)
+                and isinstance(den.func.value, ast.Name)
+                and _name_guarded(mod, fn, den.func.value.id)
+            ):
+                return None
+            return "a sum over possibly-empty/zero terms"
+        return None
+    if isinstance(den, ast.BinOp) and isinstance(den.op, ast.Add):
+        if not (
+            isinstance(den.left, ast.Constant)
+            or isinstance(den.right, ast.Constant)
+        ):
+            return "an a + b of computed terms that can both be 0"
+        return None
+    if isinstance(den, ast.Name):
+        vals = _assignments(mod, fn, den.id)
+        if not vals:
+            return None
+        if _name_guarded(mod, fn, den.id):
+            return None
+        for v in vals:
+            reason = _zero_capable(mod, fn, v)
+            if reason is not None:
+                return reason
+        return None
+    return None
+
+
+def _check_nl003(mod: ModuleLint):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.BinOp) or not isinstance(
+            node.op, ast.Div
+        ):
+            continue
+        den = node.right
+        fn = mod.enclosing_fn(node)
+        reason = _zero_capable(mod, fn, den)
+        if reason is None:
+            continue
+        if _contains_guard(mod, den):
+            continue
+        names = {
+            n.id for n in ast.walk(den) if isinstance(n, ast.Name)
+        }
+        if _branch_guarded(mod, node, fn, names):
+            continue
+        yield mod.finding(
+            "NL003",
+            node,
+            f"division by {reason} with no guard: empty buckets / "
+            "all-null batches make this denominator exactly 0",
+            hint="floor it (jnp.maximum(den, eps) on device, "
+            "max(den, 1) on host counts) or branch on it first",
+        )
+
+
+def _check_nl004(mod: ModuleLint):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func)
+        if canon not in _PROD_CALLS:
+            continue
+        if _traced_info(mod, node) is None:
+            continue
+        int_pinned = False
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                src = ast.get_source_segment(mod.source, kw.value) or ""
+                if "int" in src:
+                    int_pinned = True
+        if int_pinned:
+            continue
+        short = canon.rsplit(".", 1)[-1]
+        yield mod.finding(
+            "NL004",
+            node,
+            f"{short}() over float values in traced code: linear-space "
+            "probability products underflow f32 after a few dozen "
+            "small factors",
+            hint="accumulate _safe_log values and exponentiate once "
+            "(or pin an integer dtype if this is a counting product)",
+        )
+
+
+def _check_nl005(mod: ModuleLint):
+    def floaty(e: ast.expr) -> bool:
+        if isinstance(e, ast.Constant) and isinstance(e.value, float):
+            return True
+        if isinstance(e, ast.Call):
+            canon = mod.canonical(e.func)
+            if canon and mod.is_device_ns(canon):
+                for kw in e.keywords:
+                    if kw.arg == "dtype":
+                        src = (
+                            ast.get_source_segment(mod.source, kw.value)
+                            or ""
+                        )
+                        if "int" in src or "bool" in src:
+                            return False  # integer-pinned reduction
+                return canon.rsplit(".", 1)[-1] in _FLOAT_PRODUCERS
+        return False
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+            continue
+        if _traced_info(mod, node) is None:
+            continue
+        sides = [node.left, *node.comparators]
+        if not any(floaty(s) for s in sides):
+            continue
+        yield mod.finding(
+            "NL005",
+            node,
+            "exact ==/!= against a computed float in traced code: "
+            "last-ulp differences across reduce orders/precisions make "
+            "the comparison tier-dependent",
+            hint="compare with a tolerance (jnp.abs(a - b) <= tol) or "
+            "restructure on integer codes",
+        )
+
+
+def _check_nl006(mod: ModuleLint):
+    for fn_node, info in mod.fns.items():
+        in_contract = False
+        for n in ast.walk(fn_node):
+            ident = None
+            if isinstance(n, ast.Name):
+                ident = n.id
+            elif isinstance(n, ast.Attribute):
+                ident = n.attr
+            if ident and any(k in ident for k in _FOLD_CONTRACT_NAMES):
+                in_contract = True
+                break
+        if not in_contract:
+            continue
+        for n in ast.walk(fn_node):
+            if mod.enclosing_fn(n) is not fn_node:
+                continue
+            if not isinstance(n, ast.Call):
+                continue
+            canon = mod.canonical(n.func)
+            if canon not in _ORDERED_REDUCE_CALLS:
+                continue
+            short = canon.rsplit(".", 1)[-1]
+            yield mod.finding(
+                "NL006",
+                n,
+                f"{short}() inside `{info.qualname}`, a path bound to "
+                "fold_logit's left-to-right order: reduce trees diverge "
+                "from the running accumulator in the last ulp past ~2 "
+                "columns (the PR 13 bug class), silently breaking "
+                "serve<->offline bit-parity",
+            hint="accumulate column by column in fold_logit's order",
+            )
+
+
+def _check_nl007(mod: ModuleLint):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canonical(node.func)
+        arg: ast.expr | None = None
+        if canon == "jax.scipy.special.logit" and node.args:
+            arg = node.args[0]
+        elif canon in _LOG_CALLS and node.args and _logit_ratio(node.args[0]):
+            arg = node.args[0]
+        if arg is None:
+            continue
+        fn = mod.enclosing_fn(node)
+        clamped = (
+            _contains_guard(mod, arg)
+            or any(
+                isinstance(n, ast.Call)
+                and _call_name(mod, n) in ("clip", "minimum")
+                for n in ast.walk(arg)
+            )
+            or any(
+                isinstance(n, ast.Name) and _name_guarded(mod, fn, n.id)
+                for n in ast.walk(arg)
+            )
+        )
+        if clamped:
+            continue
+        yield mod.finding(
+            "NL007",
+            node,
+            "unclamped sigmoid->logit round-trip: match probabilities "
+            "saturate to exactly 1.0 in f32 beyond ~17 logits of "
+            "evidence, so log(p / (1 - p)) returns +/-inf",
+            hint="clamp into [eps, 1 - eps] first, or carry match_logit"
+            "/fold_logit instead of re-deriving the logit",
+        )
+
+
+_F32_MAX = float(np.finfo(np.float32).max)
+_F32_TINY = float(np.finfo(np.float32).tiny)
+
+
+def _check_nl008(mod: ModuleLint):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Constant):
+            continue
+        if not isinstance(node.value, float):
+            continue
+        v = abs(node.value)
+        if v == 0.0 or _F32_TINY <= v <= _F32_MAX:
+            continue
+        if _traced_info(mod, node) is None:
+            continue
+        kind = (
+            "overflows to inf" if v > _F32_MAX else "flushes to 0/denormal"
+        )
+        yield mod.finding(
+            "NL008",
+            node,
+            f"float literal {node.value!r} {kind} at float32: "
+            "pinned-width kernels evaluate this source at f32 on "
+            "hardware tiers",
+            hint="derive the constant from jnp.finfo(dtype) "
+            "(.tiny/.max/.eps) so it tracks the kernel's width",
+        )
+
+
+NL_CHECKS = {
+    "NL001": _check_nl001,
+    "NL002": _check_nl002,
+    "NL003": _check_nl003,
+    "NL004": _check_nl004,
+    "NL005": _check_nl005,
+    "NL006": _check_nl006,
+    "NL007": _check_nl007,
+    "NL008": _check_nl008,
+}
+
+# ---------------------------------------------------------------------------
+# Runners (mirror jaxlint.lint_source / lint_paths)
+# ---------------------------------------------------------------------------
+
+
+def numlint_source(path: str, source: str, rules=None) -> list[Finding]:
+    """Run the NL rules over one module's source; returns unsuppressed
+    findings. Unparseable sources return no findings here — jaxlint
+    already reports them as JL000 in the same CLI run."""
+    if rules is not None:
+        for rid in rules:
+            if rid not in NL_RULES:
+                raise KeyError(rid)
+    try:
+        mod = ModuleLint(path, source)
+    except (SyntaxError, ValueError):
+        return []
+    file_ids = _file_suppressions(mod.lines)
+    out: list[Finding] = []
+    for rid, check in NL_CHECKS.items():
+        if rules is not None and rid not in rules:
+            continue
+        for f in check(mod):
+            if not _suppressed(mod.lines, file_ids, f):
+                out.append(f)
+    return out
+
+
+def numlint_paths(paths, rules=None) -> Report:
+    """Numlint every .py file under the given paths into one Report."""
+    report = Report()
+    for file_path in iter_python_files(paths):
+        try:
+            with open(file_path, encoding="utf-8") as fh:
+                source = fh.read()
+        except UnicodeDecodeError:
+            # jaxlint reports the JL000 for the same file in the same run
+            report.files_checked += 1
+            continue
+        report.extend(numlint_source(file_path, source, rules))
+        report.files_checked += 1
+    return report

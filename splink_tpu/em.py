@@ -55,6 +55,40 @@ class _LoopState(NamedTuple):
     ll_hist: jnp.ndarray
 
 
+class EMNumericsError(RuntimeError):
+    """A non-finite value entered the EM trajectory.
+
+    Raised by :func:`run_em_checkpointed`'s host hook the moment an
+    update delivers NaN/Inf in lambda, m, u or the log likelihood —
+    BEFORE the poisoned values reach the histories, telemetry or a
+    checkpoint, so everything persisted stays finite. Carries the first
+    poisoned iteration, which fields were non-finite, the last finite
+    iteration, and (when the run checkpoints) the directory plus the
+    last boundary iteration already on disk — the state a caller
+    restarts from. The same facts go out as a structured
+    ``em_numerics`` degradation event (obs/events.publish) before the
+    raise, so the incident lands in the run record and the flight ring
+    even when the caller swallows the exception.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        iteration: int,
+        fields: list,
+        last_good_iteration: int,
+        checkpoint_dir=None,
+        last_checkpoint_iteration=None,
+    ):
+        super().__init__(message)
+        self.iteration = iteration
+        self.fields = fields
+        self.last_good_iteration = last_good_iteration
+        self.checkpoint_dir = checkpoint_dir
+        self.last_checkpoint_iteration = last_checkpoint_iteration
+
+
 # The active host hook for run_em(host_hook=True): a single module-level
 # trampoline keeps ONE compiled program per (shape, static args) — a
 # per-call closure passed as a static argument would recompile every call.
@@ -308,6 +342,10 @@ def run_em_checkpointed(
     # only process 0 persists it
     is_writer = jax.process_count() == 1 or jax.process_index() == 0
 
+    # the numerics guard reports the newest boundary already on disk as
+    # the restart point, so _save records what it persisted
+    last_saved = {"iteration": None}
+
     def _save(iteration, conv):
         if checkpoint_dir is None or not is_writer:
             return
@@ -345,6 +383,7 @@ def run_em_checkpointed(
                 dtype=np_dtype.name,
             ),
         )
+        last_saved["iteration"] = int(iteration)
 
     checkpoint_every = max(int(checkpoint_every), 1)
     start = done
@@ -364,6 +403,45 @@ def run_em_checkpointed(
             return
         try:
             it = start + int(it_rel)
+            # numerics guard: a NaN/Inf update halts the trajectory HERE,
+            # before the poisoned values can reach the histories, the
+            # telemetry stream or a checkpoint. Everything written so far
+            # passed this same check, so iteration it-1 is the last finite
+            # state — and the newest _save boundary holds it on disk.
+            bad = [
+                name
+                for name, v in (("lam", lam), ("m", m), ("u", u))
+                if not np.isfinite(np.asarray(v)).all()
+            ]
+            if compute_ll and not np.isfinite(ll_pre):
+                bad.append("ll")
+            if bad:
+                from .obs.events import publish
+
+                info = dict(
+                    iteration=it,
+                    fields=bad,
+                    last_good_iteration=it - 1,
+                    checkpoint_dir=(
+                        str(checkpoint_dir)
+                        if checkpoint_dir is not None
+                        else None
+                    ),
+                    last_checkpoint_iteration=last_saved["iteration"],
+                )
+                publish("em_numerics", **info)
+                where = (
+                    f"; last checkpoint at iteration "
+                    f"{last_saved['iteration']} in {checkpoint_dir}"
+                    if last_saved["iteration"] is not None
+                    else ""
+                )
+                raise EMNumericsError(
+                    f"non-finite EM update at iteration {it} "
+                    f"({', '.join(bad)}); last finite iteration "
+                    f"{it - 1}{where}",
+                    **info,
+                )
             lam_h[it] = lam
             m_h[it] = m
             u_h[it] = u

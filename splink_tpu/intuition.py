@@ -69,10 +69,15 @@ def intuition_report(row_dict, params: Params) -> str:
 
         prob_m = float(_row_get(row_dict, f"prob_{gk}_match"))
         prob_nm = float(_row_get(row_dict, f"prob_{gk}_non_match"))
-        adj = prob_m / (prob_m + prob_nm)
+        # zero-filled levels (EM never observed this gamma value) zero
+        # both probabilities: no evidence either way -> neutral 0.5, and
+        # the belief update keeps the prior unchanged
+        den = prob_m + prob_nm
+        adj = prob_m / den if den > 0 else 0.5
         a = adj * current_p
         b = (1 - adj) * (1 - current_p)
-        current_p = a / (a + b)
+        tot = a + b
+        current_p = a / tot if tot > 0 else current_p
 
         report += _COL.format(
             col_name=col_name,
@@ -96,7 +101,9 @@ def _get_adjustment_factors(row_dict, params: Params) -> list[dict]:
     for gk, col_params in params.params["π"].items():
         prob_m = float(_row_get(row_dict, f"prob_{gk}_match"))
         prob_nm = float(_row_get(row_dict, f"prob_{gk}_non_match"))
-        adj = prob_m / (prob_m + prob_nm)
+        # zero-filled levels carry no evidence: neutral 0.5 adjustment
+        den = prob_m + prob_nm
+        adj = prob_m / den if den > 0 else 0.5
         out.append(
             {
                 "gamma": gk,

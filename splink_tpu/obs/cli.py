@@ -112,6 +112,38 @@ def summarize_events(events: list[dict]) -> str:
                 f"{('yes' if ev.get('converged') else ''):>6}"
             )
 
+    # ---- numerics (analysis layer 6 + EM trajectory guard) ---------------
+    num_audits = [e for e in events if e.get("type") == "num_audit"]
+    em_halts = [e for e in events if e.get("type") == "em_numerics"]
+    if num_audits or em_halts:
+        lines.append("")
+        lines.append(
+            f"numerics: {len(num_audits)} audit(s), "
+            f"{len(em_halts)} EM halt(s)"
+        )
+        for ev in num_audits:
+            lines.append(
+                f"  audit: {_or0(ev.get('kernels'))} kernel(s) on tier "
+                f"{ev.get('tier') or '?'}, "
+                f"{_or0(ev.get('findings'))} finding(s), "
+                f"worst ulp {_or0(ev.get('worst_ulp'))}"
+            )
+        for ev in em_halts:
+            fields = ev.get("fields") or []
+            ckpt = ev.get("checkpoint_dir")
+            ref = (
+                f", checkpoint @{_or0(ev.get('last_checkpoint_iteration'))} "
+                f"in {ckpt}"
+                if ckpt
+                else ""
+            )
+            lines.append(
+                f"  EM HALT at iteration {_or0(ev.get('iteration'))} "
+                f"(non-finite: {', '.join(str(f) for f in fields) or '?'}); "
+                f"last finite iteration "
+                f"{_or0(ev.get('last_good_iteration'))}{ref}"
+            )
+
     # ---- request traces (serve tier, obs v2) -----------------------------
     traces = [e for e in events if e.get("type") == "request_trace"]
     if traces:

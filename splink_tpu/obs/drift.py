@@ -79,6 +79,10 @@ LONG_WINDOW_FACTOR = 5
 #: proportion floor for PSI/JS smoothing (a bin empty on one side must not
 #: produce an infinite statistic)
 PSI_EPS = 1e-4
+# identity floor under the log/log2 in psi()/js_divergence(): the eps
+# smoothing keeps every ratio >> tiny, so this never changes a value —
+# it pins the statistics finite even if a caller passes eps=0
+_LOG_TINY = float(np.finfo(np.float64).tiny)
 
 #: drains per short window (the ring's bucket cadence)
 DRAINS_PER_WINDOW = 4
@@ -336,7 +340,15 @@ def psi(expected, observed, eps: float = PSI_EPS) -> float | None:
     q = _proportions(observed, eps)
     if p is None or q is None:
         return None
-    return float(np.sum((q - p) * np.log(q / p)))
+    # _proportions floors every cell at eps, so the ratio is strictly
+    # positive and the tiny-floor below is the identity — it only exists
+    # to keep the log finite if the smoothing is ever disabled (eps=0)
+    return float(
+        np.sum(
+            (q - p)
+            * np.log(np.maximum(q, _LOG_TINY) / np.maximum(p, _LOG_TINY))
+        )
+    )
 
 
 def js_divergence(expected, observed, eps: float = PSI_EPS) -> float | None:
@@ -347,8 +359,14 @@ def js_divergence(expected, observed, eps: float = PSI_EPS) -> float | None:
     if p is None or q is None:
         return None
     m = 0.5 * (p + q)
-    kl_pm = np.sum(p * np.log2(p / m))
-    kl_qm = np.sum(q * np.log2(q / m))
+    # same identity floor as psi(): strictly positive ratios after the
+    # eps smoothing, finite even with smoothing disabled
+    kl_pm = np.sum(
+        p * np.log2(np.maximum(p, _LOG_TINY) / np.maximum(m, _LOG_TINY))
+    )
+    kl_qm = np.sum(
+        q * np.log2(np.maximum(q, _LOG_TINY) / np.maximum(m, _LOG_TINY))
+    )
     return float(0.5 * kl_pm + 0.5 * kl_qm)
 
 

@@ -81,6 +81,11 @@ TRANSITION_TYPES = (
     "fleet_net_alert",
     "fleet_net_clear",
     "incident_bundle",
+    # numerics (analysis layer 6 + em.py trajectory guard): a NaN/Inf
+    # halt of an EM run is a first-class incident, and the num-smoke
+    # audit summary stamps the timeline like thread_audit does
+    "em_numerics",
+    "num_audit",
 )
 
 _RECORDERS: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()

@@ -365,6 +365,10 @@ class Params:
 
 def _normalised(probs):
     s = sum(probs)
+    if s <= 0:
+        # an all-zero distribution (every level zero-filled) carries no
+        # information; renormalise to uniform rather than dividing by 0
+        return [1.0 / len(probs)] * len(probs)
     return [p / s for p in probs]
 
 

@@ -251,14 +251,20 @@ def make_score_fused_fn(layout: dict, comparison_columns, k: int,
     round-tripping through HBM per batch. Here each comparison's gamma
     levels fold into a running per-pair log-Bayes-factor the moment they
     are computed: one (Q*C,) accumulator crosses the comparisons, and the
-    per-comparison gamma vector dies inside the fusion. Every arithmetic
-    step mirrors the unfused expression tree exactly — the same
-    ``_safe_log`` probability tables, the same per-level compare-and-mask
-    lookup in the same level order, the same null (gamma = -1) masking,
-    the same left-to-right comparison accumulation order ``jnp.sum``
-    applies along the stacked axis — which is what makes the fused path
-    bit-identical, not merely close (gated by the parity tests and the
-    ``make warmup-smoke`` oracle comparison).
+    per-comparison gamma vector dies inside the fusion. Per comparison,
+    every arithmetic step mirrors the unfused expression tree exactly —
+    the same ``_safe_log`` probability tables, the same per-level
+    compare-and-mask lookup in the same level order, the same null
+    (gamma = -1) masking. ACROSS comparisons the accumulation order is
+    the pinned left-to-right fold of
+    :func:`~..models.fellegi_sunter.fold_logit` (the NA-ORD audit
+    invariant, docs/static_analysis.md#layer-6); ``match_probability``'s
+    ``jnp.sum`` reduction tree is not contractually that order past ~2
+    comparison columns, so fused-vs-unfused parity is bit-identical
+    UNDER the fold order and ulp-budgeted otherwise — the parity tests
+    and the ``make warmup-smoke`` oracle comparison gate bit-identity on
+    the tiers where the lowered reduction coincides, and the layer-6
+    numerics audit pins the fold order itself.
 
     With ``tf_spec`` the term-frequency u-probability fold rides the same
     fusion: per TF column ONE extra device gather (the reference token ids

@@ -114,6 +114,10 @@ _NON_COLUMN_DEFAULT_KEYS = [
 
 def normalise_prob_list(probs: list) -> list:
     total = sum(probs)
+    if total <= 0:
+        raise ValueError(
+            f"m/u probability list must have a positive sum, got {probs!r}"
+        )
     return [p / total for p in probs]
 
 

@@ -41,7 +41,15 @@ def bayes_combine(probs: list[np.ndarray]) -> np.ndarray:
         p = np.asarray(p, dtype=np.float64)
         num = num * p
         den = den * (1.0 - p)
-    return num / (num + den)
+    # contradictory evidence (some p exactly 1 AND some p exactly 0, or
+    # underflow of both products) drives num and den both to 0; 0.5 is
+    # the no-information posterior, matching the disagreeing-pair
+    # convention below. On every other input the guarded division is
+    # bit-identical to num / (num + den).
+    tot = num + den
+    return np.where(
+        tot > 0, num / np.maximum(tot, np.finfo(np.float64).tiny), 0.5
+    )
 
 
 def compute_token_adjustment(values_l, values_r, match_probability, base_lambda):
@@ -222,7 +230,15 @@ def compute_token_adjustment_device(
     # (/root/reference/splink/term_frequencies.py:60)
     num = tok_lambda * (1.0 - jnp.asarray(base_lambda, dtype))
     den = (1.0 - tok_lambda) * jnp.asarray(base_lambda, dtype)
-    adjusted = num / (num + den)
+    # tok_lambda and base_lambda both exactly 0 (or both exactly 1) zero
+    # both terms; 0.5 is the no-adjustment value the gather pads with.
+    # Everywhere else the guarded division is bit-identical.
+    tot = num + den
+    adjusted = jnp.where(
+        tot > 0,
+        num / jnp.maximum(tot, jnp.finfo(dtype).tiny),
+        jnp.asarray(0.5, dtype),
+    )
 
     gather_fn = _device_token_gather_fn(num_segments)
     adj = np.empty(n, np.float64)

@@ -1,0 +1,12 @@
+"""NL001 bad twin: raw log on possibly-zero probability tables."""
+
+import jax.numpy as jnp
+
+
+def log_table(m):
+    # m has zero-filled levels (EM never observed them): log(0) = -inf
+    return jnp.log(m)
+
+
+def log2_table(m):
+    return jnp.log2(m)  # numlint: disable=NL001

@@ -1,0 +1,13 @@
+"""NL001 good twin: the operand is floored before the log."""
+
+import jax.numpy as jnp
+
+EPS = 1e-12
+
+
+def log_table(m):
+    return jnp.log(jnp.maximum(m, jnp.finfo(m.dtype).tiny))
+
+
+def log2_table(m):
+    return jnp.log2(m + EPS)

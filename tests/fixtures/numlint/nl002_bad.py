@@ -1,0 +1,15 @@
+"""NL002 bad twin: exp of an unbounded traced log-space quantity."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def linear_weights(log_w):
+    # log-Bayes sums grow with column count; exp overflows f32 at ~88.7
+    return jnp.exp(log_w)
+
+
+@jax.jit
+def linear_weights_waived(log_w):
+    return jnp.exp(log_w)  # numlint: disable=NL002

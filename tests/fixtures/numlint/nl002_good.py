@@ -1,0 +1,9 @@
+"""NL002 good twin: max-shift before leaving log space."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def linear_weights(log_w):
+    return jnp.exp(log_w - jnp.max(log_w))

@@ -1,0 +1,13 @@
+"""NL003 bad twin: divisions by unguarded count/probability sums."""
+
+import numpy as np
+
+
+def match_rate(weights):
+    total = np.sum(weights)
+    # an all-zero/empty batch zeroes the denominator
+    return weights / total
+
+
+def bayes_posterior(num, den):
+    return num / (num + den)  # numlint: disable=NL003

@@ -1,0 +1,15 @@
+"""NL003 good twin: floored or branch-guarded denominators."""
+
+import numpy as np
+
+
+def match_rate(weights):
+    total = max(np.sum(weights), 1)
+    return weights / total
+
+
+def bayes_posterior(num, den):
+    tot = num + den
+    if tot <= 0:
+        return np.full_like(num, 0.5)
+    return num / tot

@@ -1,0 +1,15 @@
+"""NL004 bad twin: linear-space probability products in traced code."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def joint_prob(p):
+    # a few dozen small factors underflow f32
+    return jnp.prod(p, axis=-1)
+
+
+@jax.jit
+def joint_prob_waived(p):
+    return jnp.prod(p, axis=-1)  # numlint: disable=NL004

@@ -1,0 +1,15 @@
+"""NL005 bad twin: exact float equality in traced code."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def converged(delta, scores):
+    exact_zero = jnp.sum(scores) == 0.0
+    return exact_zero & (delta != 1.5)
+
+
+@jax.jit
+def converged_waived(scores):
+    return jnp.sum(scores) == 0.0  # numlint: disable=NL005

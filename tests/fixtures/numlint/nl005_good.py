@@ -1,0 +1,16 @@
+"""NL005 good twin: tolerance comparisons; integer-pinned reductions."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def converged(delta, scores, tol):
+    near_zero = jnp.abs(jnp.sum(scores)) <= tol
+    return near_zero & (jnp.abs(delta - 1.5) <= tol)
+
+
+@jax.jit
+def no_hits(mask):
+    # integer-pinned count: exact equality is well-defined
+    return jnp.sum(mask, dtype=jnp.int32) == 0

@@ -1,0 +1,18 @@
+"""NL006 bad twin: reduce-tree reduction inside a fold-order-contracted
+scoring path (the PR 13 bug class)."""
+
+import jax.numpy as jnp
+
+from splink_tpu.models.fellegi_sunter import fold_logit
+
+
+def tf_adjusted_logit(G, params, tf_deltas):
+    base = fold_logit(G, params)
+    # jnp.sum's reduce tree diverges from the running accumulator in the
+    # last ulp past ~2 columns
+    return base + jnp.sum(tf_deltas, axis=-1)
+
+
+def tf_adjusted_logit_waived(G, params, tf_deltas):
+    base = fold_logit(G, params)
+    return base + jnp.sum(tf_deltas, axis=-1)  # numlint: disable=NL006

@@ -1,0 +1,10 @@
+"""NL006 good twin: column-by-column accumulation in fold_logit's order."""
+
+from splink_tpu.models.fellegi_sunter import fold_logit
+
+
+def tf_adjusted_logit(G, params, tf_deltas):
+    base = fold_logit(G, params)
+    for ci in range(tf_deltas.shape[1]):
+        base = base + tf_deltas[:, ci]
+    return base

@@ -1,0 +1,12 @@
+"""NL007 bad twin: unclamped sigmoid->logit round-trips."""
+
+import jax.numpy as jnp
+
+
+def recovered_logit(p):
+    # p saturates to exactly 1.0 in f32 beyond ~17 logits of evidence
+    return jnp.log(p / (1.0 - p))
+
+
+def recovered_logit_waived(p):
+    return jnp.log(p / (1.0 - p))  # numlint: disable=NL007

@@ -1,0 +1,10 @@
+"""NL007 good twin: clamp into [eps, 1 - eps] before the round-trip."""
+
+import jax.numpy as jnp
+
+EPS = 1e-7
+
+
+def recovered_logit(p):
+    q = jnp.clip(p, EPS, 1.0 - EPS)
+    return jnp.log(q / (1.0 - q))

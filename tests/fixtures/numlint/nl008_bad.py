@@ -1,0 +1,15 @@
+"""NL008 bad twin: float literals outside float32's normal range in
+traced code."""
+
+import jax
+
+
+@jax.jit
+def smoothed(x):
+    # flushes to 0/denormal the moment this kernel runs at f32
+    return x + 1e-300
+
+
+@jax.jit
+def smoothed_waived(x):
+    return x + 1e-300  # numlint: disable=NL008

@@ -1,0 +1,9 @@
+"""NL008 good twin: width-tracking constants from jnp.finfo."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def smoothed(x):
+    return x + jnp.finfo(x.dtype).tiny

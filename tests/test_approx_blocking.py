@@ -15,7 +15,7 @@ The contract under test (ISSUE 10 / docs/blocking.md#approximate-tier):
     bucket returns approx-tagged candidates whose scores are BIT-identical
     to offline scoring of the same pairs, with zero steady-state
     recompiles;
-  * the new kernels audit clean in all three analysis layers AND the
+  * the new kernels audit clean in the jaxpr/shard analysis layers AND the
     registrations are falsifiable (broken twins trip TA-DTYPE / SA-COLL).
 """
 

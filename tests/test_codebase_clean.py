@@ -1,11 +1,14 @@
-"""The CI gate: splink_tpu/ itself must lint clean, every registered kernel
-must pass the jaxpr audit, AND every sharded kernel must pass the SPMD
-partition-safety audit against its committed budgets. This is the tier-1
-enforcement of the discipline all three analysis layers encode — a new
-hazard anywhere in the package (or a kernel regression that bakes in a
-constant / leaks float64 / adds an undeclared callback / replicates a pair
-array / grows a silent all-gather / blows a cost budget) fails the suite,
-not just ``make lint``.
+"""The CI gate: splink_tpu/ itself must lint clean (jaxlint AND numlint),
+every registered kernel must pass the jaxpr audit, every sharded kernel
+must pass the SPMD partition-safety audit against its committed budgets,
+the serve/obs thread fleet must pass threadlint, and every registered
+kernel must pass the measured numerics audit against its committed ulp
+budgets. This is the tier-1 enforcement of the discipline the analysis
+layers encode — a new hazard anywhere in the package (or a kernel
+regression that bakes in a constant / leaks float64 / adds an undeclared
+callback / replicates a pair array / grows a silent all-gather / blows a
+cost budget / races a counter / leaks a NaN through a corner batch /
+widens an f32 error bar) fails the suite, not just ``make lint``.
 
 The jaxpr audit forces x64 ON while tracing (unpinned constructors only
 reveal themselves as int64/float64 under x64); the shard audit forces x64
@@ -121,3 +124,45 @@ def test_bad_thread_fixtures_fail_the_gate():
             findings, _ = audit_source(path, fh.read())
         fired |= {f.rule for f in findings}
     assert fired == set(TL_RULES)
+
+
+def test_package_numlints_clean():
+    # layer 6 (static half): the package holds the log-space hygiene
+    # rules — a raw log of a possibly-zero operand or an unguarded
+    # division anywhere in splink_tpu/ fails the suite
+    from splink_tpu.analysis import numlint_paths
+
+    report = numlint_paths([PACKAGE])
+    assert report.files_checked > 40
+    assert report.clean, "\n" + "\n".join(
+        f.format() for f in report.sorted()
+    )
+
+
+def test_bad_numlint_fixtures_fail_the_gate():
+    # falsifiability for layer 6's static half: each bad twin trips
+    # exactly its rule (mirrors the threadlint fixture gate)
+    from splink_tpu.analysis import NL_RULES, numlint_source
+
+    fixtures = os.path.join(
+        os.path.dirname(__file__), "fixtures", "numlint"
+    )
+    fired = set()
+    for rule in NL_RULES:
+        path = os.path.join(fixtures, f"{rule.lower()}_bad.py")
+        with open(path, encoding="utf-8") as fh:
+            findings = numlint_source(path, fh.read())
+        fired |= {f.rule for f in findings}
+    assert fired == set(NL_RULES)
+
+
+def test_kernel_registry_numerics_audit_clean():
+    # layer 6 (measured half): every registered kernel survives its
+    # adversarial corner batches with finite outputs, stays inside its
+    # committed f32/f64 ulp budget (num_baselines.json), and the
+    # model-level monotonicity + pinned-fold-order invariants hold
+    from splink_tpu.analysis import run_num_audit
+
+    findings, audited = run_num_audit()
+    assert audited >= 25  # the full registry + the model-level checks
+    assert not findings, "\n" + "\n".join(f.format() for f in findings)

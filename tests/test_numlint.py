@@ -1,0 +1,195 @@
+"""numlint layer (layer 6, static half): every NL rule fires on its bad
+fixture twin, stays silent on the good twin and on suppressed lines;
+suppression syntax; guard recognition; CLI integration.
+
+The fixtures under tests/fixtures/numlint/ are DATA, not importable test
+code: each rule has an ``nlNNN_bad.py`` containing at least one violation
+plus one suppressed copy, and an ``nlNNN_good.py`` expressing the same
+numeric intent safely (guarded log, clamped round-trip, log-space sum)."""
+
+import json
+import os
+
+import pytest
+
+from splink_tpu.analysis import NL_RULES, numlint_paths, numlint_source
+from splink_tpu.analysis.__main__ import main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "numlint")
+RULE_IDS = sorted(NL_RULES)
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _lint_file(path):
+    with open(path) as fh:
+        return numlint_source(path, fh.read())
+
+
+def test_rule_catalog_complete():
+    # the advertised 8 numeric hazard classes, each with title + doc
+    assert RULE_IDS == [f"NL{i:03d}" for i in range(1, 9)]
+    for title, doc in NL_RULES.values():
+        assert title and doc
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_bad_twin_only(rule_id):
+    bad = _fixture(f"{rule_id.lower()}_bad.py")
+    good = _fixture(f"{rule_id.lower()}_good.py")
+
+    bad_findings = [f for f in _lint_file(bad) if f.rule == rule_id]
+    assert bad_findings, f"{rule_id} did not fire on {bad}"
+
+    # the suppressed copy inside the bad twin stays silent
+    with open(bad) as fh:
+        suppressed_lines = {
+            i + 1
+            for i, line in enumerate(fh)
+            if "numlint: disable" in line
+        }
+    assert suppressed_lines, f"{bad} must contain a suppressed violation"
+    hit = suppressed_lines & {f.line for f in bad_findings}
+    assert not hit, f"{rule_id} fired on suppressed line(s) {sorted(hit)}"
+
+    good_findings = _lint_file(good)
+    assert not good_findings, (
+        f"good twin {good} not clean: "
+        + "; ".join(f.format() for f in good_findings)
+    )
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_bad_twin_fires_no_foreign_rules(rule_id):
+    # precision: each bad twin trips EXACTLY its own rule, so a finding's
+    # rule id can be trusted as a diagnosis, not a shotgun blast
+    findings = _lint_file(_fixture(f"{rule_id.lower()}_bad.py"))
+    assert {f.rule for f in findings} == {rule_id}
+
+
+def test_name_dataflow_guard_recognised():
+    # a guard applied at ASSIGNMENT time (not inside the log argument)
+    # still silences NL001 — the dominant _safe_log idiom in the package
+    source = (
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def f(x):\n"
+        "    y = jnp.maximum(x, jnp.finfo(x.dtype).tiny)\n"
+        "    return jnp.log(y)\n"
+    )
+    assert numlint_source("x.py", source) == []
+
+
+def test_branch_guard_recognised():
+    # an early-return branch on the denominator silences NL003
+    source = (
+        "import numpy as np\n"
+        "\n"
+        "\n"
+        "def rate(good, total):\n"
+        "    tot = np.sum(total)\n"
+        "    if tot <= 0:\n"
+        "        return 0.0\n"
+        "    return np.sum(good) / tot\n"
+    )
+    assert numlint_source("x.py", source) == []
+
+
+def test_file_level_suppression():
+    source = (
+        "# numlint: disable-file=NL001\n"
+        "import numpy as np\n"
+        "\n"
+        "\n"
+        "def f(x):\n"
+        "    return np.log(x)\n"
+    )
+    assert numlint_source("x.py", source) == []
+    # without the pragma the same source is a finding
+    assert numlint_source("x.py", source.split("\n", 1)[1])
+
+
+def test_suppression_on_preceding_line():
+    source = (
+        "import numpy as np\n"
+        "\n"
+        "\n"
+        "def f(x):\n"
+        "    # numlint: disable=NL001\n"
+        "    return np.log(x)\n"
+    )
+    assert numlint_source("x.py", source) == []
+
+
+def test_unknown_rule_id_rejected():
+    with pytest.raises(KeyError):
+        numlint_paths([FIXTURES], rules=["NL999"])
+
+
+def test_syntax_errors_left_to_jaxlint(tmp_path):
+    # jaxlint owns the JL000 parse-failure finding; numlint must not
+    # duplicate it (the CLI runs both engines over the same file)
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    report = numlint_paths([str(p)])
+    assert report.files_checked == 1
+    assert report.findings == []
+
+
+def test_package_is_numlint_clean():
+    # the discipline the rules encode holds on the package itself
+    package = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "splink_tpu",
+    )
+    report = numlint_paths([package])
+    assert report.files_checked > 40
+    assert report.clean, "\n" + "\n".join(
+        f.format() for f in report.sorted()
+    )
+
+
+def test_cli_json_mode_on_bad_fixture(capsys):
+    rc = main([_fixture("nl001_bad.py"), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["clean"] is False
+    assert out["files_checked"] == 1
+    assert {f["rule"] for f in out["findings"]} == {"NL001"}
+    f = out["findings"][0]
+    assert set(f) >= {"rule", "path", "line", "message", "hint"}
+
+
+def test_cli_exit_zero_on_clean_path(capsys):
+    rc = main([_fixture("nl001_good.py")])
+    assert rc == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_cli_rule_filter_splits_engines(capsys):
+    # an NL-only --rules list silences the jaxlint side entirely and
+    # restricts numlint to the listed rules
+    rc = main([_fixture("nl001_bad.py"), "--rules", "NL002"])
+    assert rc == 0
+    capsys.readouterr()
+    # and a JL-only list silences numlint on the same fixture
+    rc = main([_fixture("nl001_bad.py"), "--rules", "JL005"])
+    assert rc == 0
+    capsys.readouterr()
+
+
+def test_cli_mixed_rule_filter(capsys):
+    rc = main([_fixture("nl001_bad.py"), "--rules", "JL005,NL001"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "NL001" in out
+
+
+def test_cli_list_rules_includes_nl(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in RULE_IDS:
+        assert rule_id in out

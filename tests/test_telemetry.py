@@ -282,6 +282,71 @@ def test_summarize_handles_null_numeric_fields(tmp_path):
     assert obs_cli(["summarize", str(p)]) == 0
 
 
+def test_summarize_renders_numerics_events(tmp_path):
+    """The numerics section: a num_audit stamp and an em_numerics halt
+    (em.py trajectory guard) render with their key facts inline."""
+    from splink_tpu.obs.cli import summarize_events
+    from splink_tpu.obs.events import EventSink, read_events
+
+    p = tmp_path / "run_num.jsonl"
+    sink = EventSink(p, "num")
+    sink.emit(
+        "num_audit", kernels=31, tier="cpu", findings=0, worst_ulp=24.0
+    )
+    sink.emit(
+        "em_numerics",
+        iteration=3,
+        fields=["lam", "m"],
+        last_good_iteration=2,
+        checkpoint_dir="/tmp/ckpt",
+        last_checkpoint_iteration=2,
+    )
+    sink.close()
+    out = summarize_events(read_events(p))
+    assert "numerics: 1 audit(s), 1 EM halt(s)" in out
+    assert "31 kernel(s) on tier cpu" in out
+    assert "EM HALT at iteration 3" in out
+    assert "non-finite: lam, m" in out
+    assert "last finite iteration 2" in out
+    assert "checkpoint @2 in /tmp/ckpt" in out
+    assert obs_cli(["summarize", str(p)]) == 0
+
+
+def test_summarize_tolerates_torn_numerics_events(tmp_path):
+    """Torn-record or-0 tolerance: numerics events with every field
+    missing still render (counts substitute 0, never crash)."""
+    from splink_tpu.obs.cli import summarize_events
+    from splink_tpu.obs.events import EventSink, read_events
+
+    p = tmp_path / "run_torn.jsonl"
+    sink = EventSink(p, "torn")
+    sink.emit("num_audit")
+    sink.emit("em_numerics")
+    sink.close()
+    out = summarize_events(read_events(p))
+    assert "numerics: 1 audit(s), 1 EM halt(s)" in out
+    assert "0 kernel(s)" in out
+    assert "EM HALT at iteration 0" in out
+    assert obs_cli(["summarize", str(p)]) == 0
+
+
+def test_numerics_events_are_flight_transitions():
+    """Both layer-6 incident types ride the flight ring: an EM halt and
+    a numerics-audit stamp must appear on the incident timeline."""
+    from splink_tpu.obs.flight import TRANSITION_TYPES, FlightRecorder
+
+    assert "em_numerics" in TRANSITION_TYPES
+    assert "num_audit" in TRANSITION_TYPES
+    rec = FlightRecorder(capacity=8, name="svc")
+    try:
+        rec.emit("em_numerics", iteration=1, fields=["lam"])
+        rec.emit("num_audit", kernels=31, findings=0)
+        kinds = [r.get("type") for r in rec.snapshot()]
+        assert "em_numerics" in kinds and "num_audit" in kinds
+    finally:
+        rec.close()
+
+
 def test_block_stats_bound_matches_estimator():
     """block_size_stats and estimate_pair_upper_bound share one per-rule
     definition: their pair bounds must agree."""
